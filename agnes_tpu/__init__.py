@@ -1,0 +1,30 @@
+"""agnes-tpu: a TPU-native BFT consensus framework.
+
+A brand-new implementation of the capabilities of the reference engine
+(Liamsi/agnes, a pure Tendermint state-machine-replication core in Rust,
+see /root/reference): the pure State/Event/Message consensus state machine
+is kept semantically identical (reference src/state_machine.rs), while the
+Event-*producer* side — signature verification, vote tally, polka/commit
+threshold detection — is a JAX/TPU data plane: batched Ed25519 verification,
+vmapped verify+tally kernels with psum over the validator mesh axis, a
+device-resident validator pubkey table, and thousands of concurrent
+(height, round) consensus instances.
+
+Layout (mirrors SURVEY.md §7):
+  core/      pure-Python oracle core + C++ native runtime (ctypes)
+  device/    JAX data plane: int-encoded state machine, tally kernels
+  crypto/    Ed25519: python oracle, JAX batched verify, Pallas kernels
+  parallel/  mesh/sharding: instance-DP × validator-TP, XLA collectives
+  bridge/    host<->device vote-batch ingestion ABI
+  harness/   event-stream simulator, Byzantine schedules, benchmark configs
+  utils/     tracing, checkpoint/resume, metrics
+"""
+
+__version__ = "0.1.0"
+
+from agnes_tpu.types import (  # noqa: F401
+    NIL,
+    Proposal,
+    Vote,
+    VoteType,
+)

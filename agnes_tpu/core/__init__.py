@@ -1,0 +1,1 @@
+"""Pure consensus core (host oracle) + native C++ runtime bindings."""

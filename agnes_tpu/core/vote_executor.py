@@ -1,0 +1,125 @@
+"""Event producer: votes in, state-machine events out.
+
+Reference parity: src/vote_executor.rs (37 LoC).  `VoteExecutor` adds a
+vote to the tally and maps the resulting (vote type, threshold) pair to a
+state-machine event via the exact table at vote_executor.rs:26-36 —
+including the deliberate asymmetry that a precommit-nil quorum produces
+**no** event (vote_executor.rs:33; the spec reaches round skip through
+TimeoutPrecommit instead).
+
+Two reference TODOs completed here (SURVEY.md §2.4):
+
+* **Multi-round.**  The reference tracks round 0 only (vote_executor.rs:9,
+  :14 "TODO more rounds").  `HeightVotes` keeps a `RoundVotes` per round,
+  created on first vote for that round — this is also the `HeightVotes {}`
+  placeholder of consensus_executor.rs:5 made real.
+
+* **Edge-triggered events.**  The reference re-emits the threshold event on
+  every vote after a quorum is crossed (recomputed each add,
+  vote_executor.rs:20-23); the state machine's guards make duplicates
+  harmless, but at 10k-instance scale re-firing is wasted work
+  (SURVEY.md §2.4).  With ``edge_triggered=True`` (default) an event fires
+  only on the add that first crosses its threshold.  ``False`` restores
+  reference behavior exactly (used by the parity tests).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from agnes_tpu.core import state_machine as sm
+from agnes_tpu.core.round_votes import (
+    Equivocation,
+    RoundVotes,
+    Thresh,
+    ThreshKind,
+    is_one_third,
+)
+from agnes_tpu.types import Vote, VoteType
+
+
+def to_event(typ: VoteType, thresh: Thresh) -> Optional[sm.Event]:
+    """Map a (vote type, threshold) pair to a state-machine event
+    (reference: vote_executor.rs:26-36)."""
+    if thresh.kind == ThreshKind.INIT:
+        return None
+    if typ == VoteType.PREVOTE:
+        if thresh.kind == ThreshKind.ANY:
+            return sm.Event.polka_any()
+        if thresh.kind == ThreshKind.NIL:
+            return sm.Event.polka_nil()
+        return sm.Event.polka_value(thresh.value)
+    # precommits
+    if thresh.kind == ThreshKind.ANY:
+        return sm.Event.precommit_any()
+    if thresh.kind == ThreshKind.NIL:
+        return None  # deliberate: no PrecommitNil event (vote_executor.rs:33)
+    return sm.Event.precommit_value(thresh.value)
+
+
+@dataclass
+class HeightVotes:
+    """Per-round tallies for one height — the realization of the
+    `HeightVotes {}` placeholder (consensus_executor.rs:5)."""
+
+    height: int
+    total: int
+    rounds: Dict[int, RoundVotes] = field(default_factory=dict)
+
+    def round(self, r: int) -> RoundVotes:
+        rv = self.rounds.get(r)
+        if rv is None:
+            rv = self.rounds[r] = RoundVotes(self.height, r, self.total)
+        return rv
+
+    def equivocations(self) -> List[Equivocation]:
+        out: List[Equivocation] = []
+        for rv in self.rounds.values():
+            out.extend(rv.equivocations)
+        return out
+
+
+@dataclass
+class VoteExecutor:
+    """Adds votes, produces events (reference: vote_executor.rs:6-23)."""
+
+    height: int
+    total_weight: int
+    edge_triggered: bool = True
+    votes: HeightVotes = None  # type: ignore[assignment]
+    # (round, typ, thresh-kind, value) already emitted — edge-trigger record
+    _emitted: Set[Tuple[int, VoteType, ThreshKind, Optional[int]]] = field(
+        default_factory=set)
+    # rounds for which RoundSkip was already emitted
+    _skipped: Set[int] = field(default_factory=set)
+
+    def __post_init__(self):
+        if self.votes is None:
+            self.votes = HeightVotes(self.height, self.total_weight)
+
+    def apply(self, vote: Vote, weight: int) -> Optional[sm.Event]:
+        """Add the vote to its round's tally; return the event its class's
+        threshold maps to, if any (reference: vote_executor.rs:20-23)."""
+        thresh = self.votes.round(vote.round).add_vote(vote, weight)
+        event = to_event(vote.typ, thresh)
+        if event is None or not self.edge_triggered:
+            return event
+        key = (vote.round, vote.typ, thresh.kind, thresh.value)
+        if key in self._emitted:
+            return None
+        self._emitted.add(key)
+        return event
+
+    def check_round_skip(self, current_round: int) -> Optional[int]:
+        """Return the lowest round r > current_round that has accumulated
+        more than 1/3 of total weight, if any — the RoundSkip trigger
+        (state_machine.rs:106/210; detection absent in the reference).
+        Each qualifying round fires at most once."""
+        for r in sorted(self.votes.rounds):
+            if r <= current_round or r in self._skipped:
+                continue
+            if is_one_third(self.votes.round(r).skip_weight(), self.total_weight):
+                self._skipped.add(r)
+                return r
+        return None

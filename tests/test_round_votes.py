@@ -1,0 +1,126 @@
+"""Tally tests: reference parity anchor + the two documented fixes
+(per-value buckets, per-validator dedup / equivocation)."""
+
+from agnes_tpu.core.round_votes import (
+    RoundVotes,
+    Thresh,
+    is_one_third,
+    is_quorum,
+)
+from agnes_tpu.types import Vote
+
+VAL = 7
+OTHER = 9
+
+
+def test_add_votes_parity():
+    """Parity anchor: round_votes.rs:107-132.  Identity-free votes are not
+    deduplicated, exactly like the reference (which double-counts the
+    repeated vote; only the threshold outcome is observable)."""
+    total = 4
+    rv = RoundVotes(height=1, round=0, total=total)
+    weight = 1
+
+    vote = Vote.new_prevote(0, VAL)
+    assert rv.add_vote(vote, weight) == Thresh.init()
+    # add it again — reference accumulates weight but threshold unchanged
+    assert rv.add_vote(vote, weight) == Thresh.init()
+    # a nil vote: combined weight 3 of 4 → 9 > 8 → Any
+    assert rv.add_vote(Vote.new_prevote(0, None), weight) == Thresh.any()
+    # another value vote: value weight 3 → Value
+    assert rv.add_vote(vote, weight) == Thresh.for_value(VAL)
+
+
+def test_quorum_predicate():
+    """3v > 2t, strict (round_votes.rs:31-33)."""
+    assert not is_quorum(2, 3)
+    assert is_quorum(3, 4)
+    assert not is_quorum(66, 100)
+    assert is_quorum(67, 100)
+    assert not is_one_third(1, 3)
+    assert is_one_third(2, 4)
+
+
+def test_nil_quorum():
+    rv = RoundVotes(height=1, round=0, total=3)
+    assert rv.add_vote(Vote.new_prevote(0, None), 1) == Thresh.init()
+    assert rv.add_vote(Vote.new_prevote(0, None), 1) == Thresh.init()
+    assert rv.add_vote(Vote.new_prevote(0, None), 1) == Thresh.nil()
+
+
+def test_prevotes_and_precommits_tallied_separately():
+    """round_votes.rs:92-97 dispatches on vote type."""
+    rv = RoundVotes(height=1, round=0, total=3)
+    rv.add_vote(Vote.new_prevote(0, VAL), 2)
+    assert rv.add_vote(Vote.new_precommit(0, VAL), 1) == Thresh.init()
+    assert rv.add_vote(Vote.new_precommit(0, VAL), 2) == Thresh.for_value(VAL)
+
+
+def test_multi_value_buckets_not_conflated():
+    """Fix 1 (SURVEY.md §2.3): votes for different values must not pool
+    into one bucket.  4 of 6 split 2/2 across values → Init, not Value."""
+    rv = RoundVotes(height=1, round=0, total=6)
+    rv.add_vote(Vote.new_prevote(0, VAL), 2)
+    t = rv.add_vote(Vote.new_prevote(0, OTHER), 2)
+    assert t == Thresh.init()  # no single value has quorum
+    # one more for VAL (4/6 seen) → 3*4 > 2*6 false... add nil to reach Any
+    t = rv.add_vote(Vote.new_prevote(0, None), 1)
+    assert t == Thresh.any()  # 5 of 6 seen, mixed
+    t = rv.add_vote(Vote.new_prevote(0, VAL), 3)
+    assert t == Thresh.for_value(VAL)  # VAL bucket now 5 of 6
+
+
+def test_validator_dedup():
+    """Fix 2: a validator's weight counts once per (round, type)."""
+    rv = RoundVotes(height=1, round=0, total=3)
+    v = Vote.new_prevote(0, VAL, validator=0)
+    assert rv.add_vote(v, 1) == Thresh.init()
+    assert rv.add_vote(v, 1) == Thresh.init()  # duplicate ignored
+    assert rv.add_vote(v, 1) == Thresh.init()  # still 1 of 3
+    assert rv.prevotes.value_weight(VAL) == 1
+    rv.add_vote(Vote.new_prevote(0, VAL, validator=1), 1)
+    assert rv.add_vote(Vote.new_prevote(0, VAL, validator=2), 1) \
+        == Thresh.for_value(VAL)
+
+
+def test_equivocation_detected_first_vote_counts():
+    """Conflicting vote = evidence; the first vote keeps counting."""
+    rv = RoundVotes(height=1, round=0, total=3)
+    rv.add_vote(Vote.new_prevote(0, VAL, validator=0), 1)
+    rv.add_vote(Vote.new_prevote(0, OTHER, validator=0), 1)
+    assert len(rv.equivocations) == 1
+    ev = rv.equivocations[0]
+    assert ev.validator == 0
+    assert ev.first_value == VAL and ev.second_value == OTHER
+    assert rv.prevotes.value_weight(VAL) == 1
+    assert rv.prevotes.value_weight(OTHER) == 0
+    # same validator, other vote TYPE is not equivocation
+    rv.add_vote(Vote.new_precommit(0, VAL, validator=0), 1)
+    assert len(rv.equivocations) == 1
+
+
+def test_skip_weight_counts_distinct_voters():
+    rv = RoundVotes(height=1, round=2, total=4)
+    rv.add_vote(Vote.new_prevote(2, VAL, validator=0), 1)
+    rv.add_vote(Vote.new_precommit(2, VAL, validator=0), 1)
+    assert rv.skip_weight() == 1  # same voter, both types
+    rv.add_vote(Vote.new_prevote(2, None, validator=1), 1)
+    assert rv.skip_weight() == 2
+
+
+def test_equivocation_evidence_not_duplicated_on_redelivery():
+    """Redelivered conflicting votes must not grow the evidence list."""
+    rv = RoundVotes(height=1, round=0, total=3)
+    rv.add_vote(Vote.new_prevote(0, VAL, validator=0), 1)
+    for _ in range(5):
+        rv.add_vote(Vote.new_prevote(0, OTHER, validator=0), 1)
+    assert len(rv.equivocations) == 1
+
+
+def test_skip_weight_mixed_identity_and_anon():
+    """Identity-free weight still counts toward RoundSkip when identified
+    votes are present in the same round."""
+    rv = RoundVotes(height=1, round=2, total=6)
+    rv.add_vote(Vote.new_prevote(2, VAL, validator=0), 1)
+    rv.add_vote(Vote.new_prevote(2, VAL), 2)  # anonymous
+    assert rv.skip_weight() == 3

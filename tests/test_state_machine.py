@@ -1,0 +1,220 @@
+"""Pure-core state machine tests.
+
+`test_happy_case` is the exact parity anchor for the reference's shipped
+test (state_machine.rs:331-345); the rest pin down the subtleties listed
+in SURVEY.md §2.2 that the differential tests against the device plane and
+the native core rely on.
+"""
+
+from agnes_tpu.core.state_machine import (
+    Event,
+    Message,
+    MsgTag,
+    State,
+    Step,
+    TimeoutStep,
+    apply,
+)
+
+VAL = 7
+OTHER = 9
+
+
+def test_happy_case():
+    """Parity anchor: state_machine.rs:331-345 — proposer drives one height
+    to decision in 4 events."""
+    s = State.new(1)
+    s, m = apply(s, 0, Event.new_round_proposer(VAL))
+    assert m == Message.proposal_msg(0, VAL, -1)
+    s, m = apply(s, 0, Event.proposal(-1, VAL))
+    assert m == Message.prevote(0, VAL)
+    s, m = apply(s, 0, Event.polka_value(VAL))
+    assert m == Message.precommit(0, VAL)
+    s, m = apply(s, 0, Event.precommit_value(VAL))
+    assert m == Message.decision_msg(0, VAL)
+    assert s.step == Step.COMMIT
+
+
+def test_non_proposer_schedules_timeout_propose():
+    """state_machine.rs:188, 278-281 (spec 11/20)."""
+    s = State.new(1)
+    s, m = apply(s, 0, Event.new_round())
+    assert s.step == Step.PROPOSE
+    assert m == Message.timeout_msg(0, TimeoutStep.PROPOSE)
+
+
+def test_wrong_round_events_ignored():
+    """Most arms are guarded by eqr (state_machine.rs:184)."""
+    s = State.new(1)
+    for ev in (Event.new_round(), Event.new_round_proposer(VAL)):
+        s2, m = apply(s, 1, ev)
+        assert (s2, m) == (s, None)
+
+
+def test_invalid_pol_round_rejected():
+    """Proposal guard requires -1 <= vr < round (state_machine.rs:170-172,
+    191)."""
+    s = State.new(1)
+    s, _ = apply(s, 0, Event.new_round())
+    assert s.step == Step.PROPOSE
+    # vr = 0 == round → invalid; vr = -2 → invalid
+    for vr in (0, 5, -2):
+        s2, m = apply(s, 0, Event.proposal(vr, VAL))
+        assert (s2, m) == (s, None)
+    s2, m = apply(s, 0, Event.proposal(-1, VAL))
+    assert m == Message.prevote(0, VAL)
+
+
+def test_proposal_invalid_and_timeout_prevote_nil():
+    """state_machine.rs:192-193 (spec 22/25, 57)."""
+    for ev in (Event.proposal_invalid(), Event.timeout_propose()):
+        s = State.new(1)
+        s, _ = apply(s, 0, Event.new_round())
+        s, m = apply(s, 0, ev)
+        assert s.step == Step.PREVOTE
+        assert m == Message.prevote(0, None)
+
+
+def _to_prevote_step(round=0):
+    s = State.new(1)
+    s, _ = apply(s, 0, Event.new_round())
+    s, _ = apply(s, 0, Event.proposal(-1, VAL))
+    return s
+
+
+def test_polka_any_schedules_timeout_without_step_change():
+    """state_machine.rs:196, 287-289: no step advance (spec 34)."""
+    s = _to_prevote_step()
+    s2, m = apply(s, 0, Event.polka_any())
+    assert s2.step == Step.PREVOTE
+    assert m == Message.timeout_msg(0, TimeoutStep.PREVOTE)
+
+
+def test_polka_nil_and_timeout_precommit_nil():
+    """state_machine.rs:197,199 (spec 44, 61)."""
+    for ev in (Event.polka_nil(), Event.timeout_prevote()):
+        s = _to_prevote_step()
+        s2, m = apply(s, 0, ev)
+        assert s2.step == Step.PRECOMMIT
+        assert m == Message.precommit(0, None)
+
+
+def test_polka_value_locks_and_precommits():
+    """precommit sets BOTH locked and valid (state_machine.rs:261-264)."""
+    s = _to_prevote_step()
+    s2, m = apply(s, 0, Event.polka_value(VAL))
+    assert s2.step == Step.PRECOMMIT
+    assert s2.locked is not None and s2.locked.value == VAL and s2.locked.round == 0
+    assert s2.valid is not None and s2.valid.value == VAL and s2.valid.round == 0
+    assert m == Message.precommit(0, VAL)
+
+
+def test_polka_value_at_precommit_sets_valid_only_no_message():
+    """set_valid_value: valid only, no message (state_machine.rs:304-306)."""
+    s = _to_prevote_step()
+    s, _ = apply(s, 0, Event.timeout_prevote())  # now Precommit, no lock
+    s2, m = apply(s, 0, Event.polka_value(VAL))
+    assert m is None
+    assert s2.valid.value == VAL
+    assert s2.locked is None
+
+
+def test_commit_from_any_round_and_any_step():
+    """PrecommitValue has no round guard (state_machine.rs:211, spec 49)."""
+    s = State.new(1)  # NewRound step, round 0
+    s2, m = apply(s, 5, Event.precommit_value(VAL))
+    assert s2.step == Step.COMMIT
+    assert s2.round == 0  # commit does not touch the round field
+    assert m == Message.decision_msg(5, VAL)  # decision carries event round
+
+
+def test_commit_step_absorbs_everything():
+    """state_machine.rs:205."""
+    s = State.new(1)
+    s, _ = apply(s, 0, Event.precommit_value(VAL))
+    assert s.step == Step.COMMIT
+    for r in (0, 1):
+        for ev in (Event.new_round(), Event.precommit_value(OTHER),
+                   Event.round_skip(), Event.timeout_precommit()):
+            s2, m = apply(s, r, ev)
+            assert (s2, m) == (s, None)
+
+
+def test_precommit_any_schedules_timeout_from_any_noncommit_step():
+    """state_machine.rs:208 (spec 47)."""
+    s = State.new(1)  # NewRound
+    s2, m = apply(s, 0, Event.precommit_any())
+    assert s2.step == Step.NEW_ROUND
+    assert m == Message.timeout_msg(0, TimeoutStep.PRECOMMIT)
+
+
+def test_timeout_precommit_advances_round():
+    """round_skip to round+1, step back to NewRound (state_machine.rs:209,
+    314-316, spec 65)."""
+    s = _to_prevote_step()
+    s2, m = apply(s, 0, Event.timeout_precommit())
+    assert s2.round == 1
+    assert s2.step == Step.NEW_ROUND
+    assert m == Message.new_round(1)
+
+
+def test_round_skip_requires_higher_round():
+    """state_machine.rs:210 (spec 55)."""
+    s = State.new(1)
+    s2, m = apply(s, 0, Event.round_skip())  # same round: no-op
+    assert (s2, m) == (s, None)
+    s2, m = apply(s, 3, Event.round_skip())
+    assert s2.round == 3 and s2.step == Step.NEW_ROUND
+    assert m == Message.new_round(3)
+
+
+def test_lock_rule():
+    """The four-way lock rule (state_machine.rs:239-244)."""
+    # lock VAL at round 0, then reach Propose at round 1
+    s = _to_prevote_step()
+    s, _ = apply(s, 0, Event.polka_value(VAL))       # locked=(0, VAL)
+    s, _ = apply(s, 0, Event.timeout_precommit())    # round 1, NewRound
+    s, _ = apply(s, 1, Event.new_round())            # Propose
+
+    # (a) locked.round (0) <= vr (0) → unlock, prevote proposed
+    s2, m = apply(s, 1, Event.proposal(0, OTHER))
+    assert m == Message.prevote(1, OTHER)
+    # (b) locked on same value at higher round than vr → prevote value
+    s2, m = apply(s, 1, Event.proposal(-1, VAL))
+    assert m == Message.prevote(1, VAL)
+    # (c) locked on different value, vr < locked.round → prevote nil
+    s2, m = apply(s, 1, Event.proposal(-1, OTHER))
+    assert m == Message.prevote(1, None)
+
+
+def test_proposer_reuses_valid_value():
+    """propose uses (valid.value, valid.round) when set
+    (state_machine.rs:222-229)."""
+    s = _to_prevote_step()
+    s, _ = apply(s, 0, Event.polka_value(VAL))       # valid=(0, VAL)
+    s, _ = apply(s, 0, Event.timeout_precommit())    # round 1, NewRound
+    s2, m = apply(s, 1, Event.new_round_proposer(OTHER))
+    assert m == Message.proposal_msg(1, VAL, 0)      # not OTHER
+
+
+def test_decision_in_later_round():
+    """Full two-round run: round 0 fails, round 1 decides."""
+    s = State.new(1)
+    s, m = apply(s, 0, Event.new_round())
+    assert m.tag == MsgTag.TIMEOUT
+    s, m = apply(s, 0, Event.timeout_propose())
+    assert m == Message.prevote(0, None)
+    s, m = apply(s, 0, Event.polka_any())
+    s, m = apply(s, 0, Event.timeout_prevote())
+    assert m == Message.precommit(0, None)
+    s, m = apply(s, 0, Event.precommit_any())
+    s, m = apply(s, 0, Event.timeout_precommit())
+    assert m == Message.new_round(1)
+    s, m = apply(s, 1, Event.new_round())
+    s, m = apply(s, 1, Event.proposal(-1, VAL))
+    assert m == Message.prevote(1, VAL)
+    s, m = apply(s, 1, Event.polka_value(VAL))
+    assert m == Message.precommit(1, VAL)
+    s, m = apply(s, 1, Event.precommit_value(VAL))
+    assert m == Message.decision_msg(1, VAL)
+    assert s.step == Step.COMMIT

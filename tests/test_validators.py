@@ -1,0 +1,91 @@
+"""Validator set + proposer rotation tests (validators.rs intent,
+SURVEY.md §2.6)."""
+
+import numpy as np
+
+from agnes_tpu.core.validators import (
+    ProposerRotation,
+    Validator,
+    ValidatorSet,
+    proposer_table,
+)
+
+
+def _v(key_byte: int, power: int = 1) -> Validator:
+    return Validator(bytes([key_byte]) + bytes(31), power)
+
+
+def test_sorted_by_address():
+    vs = ValidatorSet([_v(3), _v(1), _v(2)])
+    assert [v.public_key[0] for v in vs] == [1, 2, 3]
+
+
+def test_dedup_by_address_keeps_latest():
+    vs = ValidatorSet([_v(1, 10), _v(1, 20)])
+    assert len(vs) == 1
+    assert vs[0].voting_power == 20
+
+
+def test_add_update_remove():
+    vs = ValidatorSet([_v(1, 1), _v(2, 2)])
+    vs.add(_v(3, 3))
+    assert len(vs) == 3 and vs.total_power == 6
+    vs.update(_v(2, 5))
+    assert vs.total_power == 9
+    vs.remove(_v(1).address)
+    assert len(vs) == 2
+    assert vs.index_of(_v(3).address) == 1
+
+
+def test_hash_changes_with_set():
+    vs = ValidatorSet([_v(1), _v(2)])
+    h1 = vs.hash()
+    vs.add(_v(3))
+    assert vs.hash() != h1
+
+
+def test_device_arrays():
+    vs = ValidatorSet([_v(2, 5), _v(1, 3)])
+    keys, powers = vs.device_arrays()
+    assert keys.shape == (2, 32) and keys.dtype == np.uint8
+    assert powers.tolist() == [3, 5]  # address-sorted
+    assert keys[0, 0] == 1 and keys[1, 0] == 2
+
+
+def test_rotation_proportional_to_power():
+    vs = ValidatorSet([_v(1, 1), _v(2, 2), _v(3, 3)])
+    rot = ProposerRotation(vs)
+    counts = [0, 0, 0]
+    for _ in range(600):
+        counts[rot.step()] += 1
+    assert counts == [100, 200, 300]
+
+
+def test_rotation_deterministic_and_table_aligned():
+    vs = ValidatorSet([_v(1, 1), _v(2, 2)])
+    t1 = proposer_table(vs, 4, 3)
+    t2 = proposer_table(vs, 4, 3)
+    assert (t1 == t2).all()
+    # start_height offsets into the same global sequence
+    t3 = proposer_table(vs, 2, 3, start_height=2)
+    assert (t1[2:] == t3).all()
+
+
+def test_validator_key_length_enforced():
+    import pytest
+    with pytest.raises(ValueError):
+        Validator(b"\x01" * 33, 1)
+    with pytest.raises(ValueError):
+        Validator(b"\x01" * 31, 1)
+    with pytest.raises(ValueError):
+        Validator(b"\x01" * 32, -1)
+
+
+def test_rotation_survives_set_mutation():
+    vs = ValidatorSet([_v(1, 1), _v(2, 1)])
+    rot = ProposerRotation(vs)
+    rot.step()
+    vs.add(_v(3, 1))
+    assert 0 <= rot.step() < 3  # no IndexError; new validator joins rotation
+    vs.remove(_v(1).address)
+    assert 0 <= rot.step() < 2

@@ -1,0 +1,70 @@
+"""Event-producer tests: the to_event table (vote_executor.rs:26-36),
+multi-round tracking, edge-triggered emission, round-skip detection."""
+
+from agnes_tpu.core import state_machine as sm
+from agnes_tpu.core.round_votes import Thresh
+from agnes_tpu.core.vote_executor import VoteExecutor, to_event
+from agnes_tpu.types import Vote, VoteType
+
+VAL = 7
+
+
+def test_to_event_table():
+    """Exact mapping, incl. the Precommit+Nil → None asymmetry
+    (vote_executor.rs:33)."""
+    E, T = sm.EventTag, Thresh
+    assert to_event(VoteType.PREVOTE, T.init()) is None
+    assert to_event(VoteType.PRECOMMIT, T.init()) is None
+    assert to_event(VoteType.PREVOTE, T.any()).tag == E.POLKA_ANY
+    assert to_event(VoteType.PREVOTE, T.nil()).tag == E.POLKA_NIL
+    ev = to_event(VoteType.PREVOTE, T.for_value(VAL))
+    assert ev.tag == E.POLKA_VALUE and ev.value == VAL
+    assert to_event(VoteType.PRECOMMIT, T.any()).tag == E.PRECOMMIT_ANY
+    assert to_event(VoteType.PRECOMMIT, T.nil()) is None
+    ev = to_event(VoteType.PRECOMMIT, T.for_value(VAL))
+    assert ev.tag == E.PRECOMMIT_VALUE and ev.value == VAL
+
+
+def test_apply_reference_refire_mode():
+    """edge_triggered=False reproduces the reference's level-triggered
+    re-fire on every vote after crossing (vote_executor.rs:20-23)."""
+    ve = VoteExecutor(height=1, total_weight=4, edge_triggered=False)
+    assert ve.apply(Vote.new_prevote(0, VAL), 1) is None
+    assert ve.apply(Vote.new_prevote(0, VAL), 1) is None
+    assert ve.apply(Vote.new_prevote(0, VAL), 1).tag == sm.EventTag.POLKA_VALUE
+    # re-fires
+    assert ve.apply(Vote.new_prevote(0, VAL), 1).tag == sm.EventTag.POLKA_VALUE
+
+
+def test_apply_edge_triggered():
+    """Default mode fires each distinct threshold once (SURVEY.md §2.4)."""
+    ve = VoteExecutor(height=1, total_weight=4)
+    ve.apply(Vote.new_prevote(0, VAL), 1)
+    ve.apply(Vote.new_prevote(0, VAL), 1)
+    ev = ve.apply(Vote.new_prevote(0, VAL), 1)
+    assert ev.tag == sm.EventTag.POLKA_VALUE
+    assert ve.apply(Vote.new_prevote(0, VAL), 1) is None  # no re-fire
+
+
+def test_multi_round_tallies_independent():
+    """The reference's "TODO more rounds" (vote_executor.rs:9,14) done."""
+    ve = VoteExecutor(height=1, total_weight=3)
+    ve.apply(Vote.new_precommit(0, VAL), 2)
+    # round 1 votes don't inherit round 0 weight
+    assert ve.apply(Vote.new_precommit(1, VAL), 1) is None
+    ev = ve.apply(Vote.new_precommit(0, VAL), 1)
+    assert ev.tag == sm.EventTag.PRECOMMIT_VALUE
+
+
+def test_round_skip_detection():
+    """+1/3 of weight on a higher round triggers RoundSkip, once."""
+    ve = VoteExecutor(height=1, total_weight=6)
+    ve.apply(Vote.new_prevote(3, VAL, validator=0), 2)
+    assert ve.check_round_skip(0) is None  # 2 of 6 is not > 1/3
+    ve.apply(Vote.new_prevote(3, None, validator=1), 1)
+    assert ve.check_round_skip(0) == 3     # 3 of 6 > 1/3... (3*3 > 6)
+    assert ve.check_round_skip(0) is None  # fires once
+    # rounds at or below current never trigger
+    ve2 = VoteExecutor(height=1, total_weight=3)
+    ve2.apply(Vote.new_prevote(2, VAL, validator=0), 3)
+    assert ve2.check_round_skip(2) is None
