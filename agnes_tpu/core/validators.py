@@ -184,17 +184,21 @@ class ProposerRotation:
 
 
 def proposer_table(vset: ValidatorSet, n_heights: int, n_rounds: int,
-                   start_height: int = 0) -> np.ndarray:
+                   start_height: int = 0,
+                   rotation: Optional[ProposerRotation] = None) -> np.ndarray:
     """Precompute proposer indices for a [n_heights, n_rounds] window —
     uploaded to the device so 10k vmapped instances can resolve
     NewRound vs NewRoundProposer without host round-trips.
 
     The rotation is a single global sequence walked in (height, round)
-    order starting from genesis; `start_height` rows before the window are
-    replayed to keep the sequence aligned across windows."""
-    rot = ProposerRotation(vset)
-    for _ in range(start_height * n_rounds):
-        rot.step()
+    order starting from genesis.  For sliding windows pass the `rotation`
+    carried over from the previous call (it is advanced in place) instead
+    of `start_height`, which replays start_height*n_rounds steps from
+    genesis and is only meant for small offsets/tests."""
+    rot = rotation if rotation is not None else ProposerRotation(vset)
+    if rotation is None:
+        for _ in range(start_height * n_rounds):
+            rot.step()
     table = np.zeros((n_heights, n_rounds), dtype=np.int32)
     for h in range(n_heights):
         for r in range(n_rounds):

@@ -16,11 +16,17 @@ Two reference TODOs completed here (SURVEY.md §2.4):
 
 * **Edge-triggered events.**  The reference re-emits the threshold event on
   every vote after a quorum is crossed (recomputed each add,
-  vote_executor.rs:20-23); the state machine's guards make duplicates
-  harmless, but at 10k-instance scale re-firing is wasted work
-  (SURVEY.md §2.4).  With ``edge_triggered=True`` (default) an event fires
-  only on the add that first crosses its threshold.  ``False`` restores
-  reference behavior exactly (used by the parity tests).
+  vote_executor.rs:20-23); at 10k-instance scale re-firing is wasted work
+  (SURVEY.md §2.4).  With ``edge_triggered=True`` an event fires only on
+  the add that first crosses its threshold.  Edge-triggering alone would
+  be a liveness bug, though: a threshold that fires while the state
+  machine is in a step that ignores it (e.g. POLKA_VALUE arriving before
+  the delayed proposal, state still at Propose) would be consumed and
+  never re-fire.  The reference's level-triggered re-fire masks this; an
+  edge-triggered consumer MUST call :meth:`threshold_events` to re-query
+  reached thresholds whenever the machine's (round, step) changes — the
+  ConsensusExecutor does exactly that.  The default is ``False``
+  (reference semantics, safe for naive consumers).
 """
 
 from __future__ import annotations
@@ -86,7 +92,7 @@ class VoteExecutor:
 
     height: int
     total_weight: int
-    edge_triggered: bool = True
+    edge_triggered: bool = False
     votes: HeightVotes = None  # type: ignore[assignment]
     # (round, typ, thresh-kind, value) already emitted — edge-trigger record
     _emitted: Set[Tuple[int, VoteType, ThreshKind, Optional[int]]] = field(
@@ -100,7 +106,13 @@ class VoteExecutor:
 
     def apply(self, vote: Vote, weight: int) -> Optional[sm.Event]:
         """Add the vote to its round's tally; return the event its class's
-        threshold maps to, if any (reference: vote_executor.rs:20-23)."""
+        threshold maps to, if any (reference: vote_executor.rs:20-23).
+
+        Votes stamped with a different height are ignored — the reference
+        has no height on votes at all (lib.rs:23-27); here a cross-height
+        vote must not count toward this height's quorums."""
+        if vote.height is not None and vote.height != self.height:
+            return None
         thresh = self.votes.round(vote.round).add_vote(vote, weight)
         event = to_event(vote.typ, thresh)
         if event is None or not self.edge_triggered:
@@ -110,6 +122,22 @@ class VoteExecutor:
             return None
         self._emitted.add(key)
         return event
+
+    def threshold_events(self, round: int) -> List[sm.Event]:
+        """Events for every threshold *currently* reached in `round` —
+        the re-query path an edge-triggered consumer must call after the
+        state machine changes (round, step), so a threshold consumed in a
+        step that ignored it is not lost (see module docstring)."""
+        rv = self.votes.rounds.get(round)
+        if rv is None:
+            return []
+        events = []
+        for typ, count in ((VoteType.PREVOTE, rv.prevotes),
+                           (VoteType.PRECOMMIT, rv.precommits)):
+            ev = to_event(typ, count.thresh())
+            if ev is not None:
+                events.append(ev)
+        return events
 
     def check_round_skip(self, current_round: int) -> Optional[int]:
         """Return the lowest round r > current_round that has accumulated

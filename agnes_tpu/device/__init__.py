@@ -1,0 +1,14 @@
+"""JAX data plane: int-encoded consensus instances on device.
+
+The entire transition table of the pure state machine is a function of a
+6-field int state and a 13-way event tag (SURVEY.md §2.2 "TPU mapping"),
+so it compiles to a branch-free select chain that `vmap` runs over
+thousands of concurrent (height, round) instances.
+"""
+
+from agnes_tpu.device.encoding import (  # noqa: F401
+    DeviceEvent,
+    DeviceMessage,
+    DeviceState,
+)
+from agnes_tpu.device.state_machine import apply_batch, apply_scalar  # noqa: F401

@@ -1,0 +1,126 @@
+"""Integer encodings of State/Event/Message for the device plane.
+
+The enum codes are the canonical ones from `core.state_machine` (Step,
+EventTag, MsgTag, TimeoutStep) — this module only defines the *array
+layout* and host<->device conversion helpers used by tests and the
+bridge.
+
+Layout decisions:
+
+* `Option<RoundValue>` (locked/valid, state_machine.rs:29-30) flattens to
+  a (round, value) int pair with round == -1 meaning None — legal because
+  a real locked/valid round is always >= 0 (set_locked/set_valid use the
+  current round, state_machine.rs:78-89).
+* Nil values (`Option<Value>::None`, lib.rs:26) are value id -1 (NIL_ID).
+* A Message flattens to (tag, round, value, aux) where aux carries the
+  proposal's pol_round, the vote's type, or the timeout's step; tag NONE
+  encodes Rust's Option::None return (state_machine.rs:174).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from agnes_tpu.core import state_machine as sm
+from agnes_tpu.types import NIL_ID, Vote, VoteType
+
+I32 = jnp.int32
+
+
+class DeviceState(NamedTuple):
+    """Per-instance consensus state; every leaf is an int32 array of the
+    same (possibly empty) batch shape."""
+
+    round: jnp.ndarray
+    step: jnp.ndarray
+    locked_round: jnp.ndarray   # -1 = not locked
+    locked_value: jnp.ndarray
+    valid_round: jnp.ndarray    # -1 = no valid value
+    valid_value: jnp.ndarray
+
+    @classmethod
+    def new(cls, batch_shape: Tuple[int, ...] = ()) -> "DeviceState":
+        """Fresh instances at round 0, NewRound (state_machine.rs:35-43)."""
+        z = jnp.zeros(batch_shape, I32)
+        neg = jnp.full(batch_shape, -1, I32)
+        return cls(round=z, step=z, locked_round=neg, locked_value=neg,
+                   valid_round=neg, valid_value=neg)
+
+
+class DeviceEvent(NamedTuple):
+    """An event plus the round it belongs to (the `round` argument of
+    apply, state_machine.rs:183)."""
+
+    tag: jnp.ndarray
+    round: jnp.ndarray
+    value: jnp.ndarray      # NIL_ID when the tag carries no value
+    pol_round: jnp.ndarray  # PROPOSAL only; -1 otherwise
+
+
+class DeviceMessage(NamedTuple):
+    tag: jnp.ndarray
+    round: jnp.ndarray
+    value: jnp.ndarray  # NIL_ID = nil vote / no value
+    aux: jnp.ndarray    # pol_round | vote type | timeout step
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device conversion (tests, bridge, checkpointing)
+# ---------------------------------------------------------------------------
+
+
+def encode_state(s: sm.State) -> DeviceState:
+    """Host State -> numpy int32 leaves (cheap; no device dispatch)."""
+    def rv(x):
+        return (x.round, x.value) if x is not None else (-1, -1)
+
+    lr, lv = rv(s.locked)
+    vr, vv = rv(s.valid)
+    a = lambda x: np.int32(x)  # noqa: E731
+    return DeviceState(a(s.round), a(int(s.step)), a(lr), a(lv), a(vr), a(vv))
+
+
+def decode_state(d: DeviceState, height: int = 0) -> sm.State:
+    g = lambda x: int(np.asarray(x))  # noqa: E731
+    locked = (sm.RoundValue(g(d.locked_round), g(d.locked_value))
+              if g(d.locked_round) >= 0 else None)
+    valid = (sm.RoundValue(g(d.valid_round), g(d.valid_value))
+             if g(d.valid_round) >= 0 else None)
+    return sm.State(height=height, round=g(d.round), step=sm.Step(g(d.step)),
+                    locked=locked, valid=valid)
+
+
+def encode_event(round: int, ev: sm.Event) -> DeviceEvent:
+    a = lambda x: np.int32(x)  # noqa: E731
+    value = ev.value if ev.value is not None else NIL_ID
+    return DeviceEvent(a(int(ev.tag)), a(round), a(value), a(ev.pol_round))
+
+
+def stack_pytree(items):
+    """Stack a list of same-type NamedTuples of scalars into one NamedTuple
+    of [n] numpy int32 arrays."""
+    t = type(items[0])
+    return t(*[np.asarray([getattr(e, f) for e in items], dtype=np.int32)
+               for f in t._fields])
+
+
+def decode_message(m: DeviceMessage) -> Optional[sm.Message]:
+    g = lambda x: int(np.asarray(x))  # noqa: E731
+    tag = sm.MsgTag(g(m.tag))
+    rnd, val, aux = g(m.round), g(m.value), g(m.aux)
+    if tag == sm.MsgTag.NONE:
+        return None
+    if tag == sm.MsgTag.NEW_ROUND:
+        return sm.Message.new_round(rnd)
+    if tag == sm.MsgTag.PROPOSAL:
+        return sm.Message.proposal_msg(rnd, val, aux)
+    if tag == sm.MsgTag.VOTE:
+        value = None if val == NIL_ID else val
+        vote = Vote(typ=VoteType(aux), round=rnd, value=value)
+        return sm.Message(sm.MsgTag.VOTE, round=rnd, vote=vote)
+    if tag == sm.MsgTag.TIMEOUT:
+        return sm.Message.timeout_msg(rnd, sm.TimeoutStep(aux))
+    return sm.Message.decision_msg(rnd, val)

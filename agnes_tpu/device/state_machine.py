@@ -1,0 +1,149 @@
+"""Branch-free Tendermint state machine for the device plane.
+
+Semantically identical to `core.state_machine.apply` (itself identical
+to the reference, src/state_machine.rs:183-214) — pinned by the
+exhaustive differential test in tests/test_device_sm.py over the full
+Step × Event × guard space.
+
+Design (SURVEY.md §2.2 "TPU mapping"): the match expression compiles to
+an *arm selector* — one boolean per reference match arm, first-true-wins
+via argmax over the stacked predicates, exactly reproducing Rust match
+priority — followed by `lax.select_n` over the per-arm candidate
+(state', message) tuples.  Every candidate is computed unconditionally;
+they are a handful of int ops each, so the whole transition is a few
+dozen VPU ops with no data-dependent control flow, which is what lets
+`jax.vmap` drive 10k+ instances in lockstep under one `jit`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from agnes_tpu.core.state_machine import EventTag, MsgTag, Step, TimeoutStep
+from agnes_tpu.device.encoding import I32, DeviceEvent, DeviceMessage, DeviceState
+from agnes_tpu.types import NIL_ID, VoteType
+
+_S = Step
+_E = EventTag
+_M = MsgTag
+
+
+def _msg(tag: int, round, value=NIL_ID, aux=0) -> DeviceMessage:
+    i = partial(jnp.asarray, dtype=I32)
+    return DeviceMessage(i(tag), i(round), i(value), i(aux))
+
+
+def apply_scalar(s: DeviceState, ev: DeviceEvent
+                 ) -> Tuple[DeviceState, DeviceMessage]:
+    """One instance, one event.  vmap over this for batches."""
+    eqr = s.round == ev.round
+    step, tag = s.step, ev.tag
+
+    def at(st: Step):
+        return step == int(st)
+
+    def on(t: EventTag):
+        return tag == int(t)
+
+    # valid_vr: -1 <= vr < round (state_machine.rs:170-172)
+    vr_ok = (ev.pol_round >= -1) & (ev.pol_round < s.round)
+
+    # --- arm predicates, in reference match order (state_machine.rs:185-213)
+    arms = jnp.stack([
+        at(_S.NEW_ROUND) & on(_E.NEW_ROUND_PROPOSER) & eqr,          # 0 propose
+        at(_S.NEW_ROUND) & on(_E.NEW_ROUND) & eqr,                   # 1 sched t.propose
+        at(_S.PROPOSE) & on(_E.PROPOSAL) & eqr & vr_ok,              # 2 prevote
+        at(_S.PROPOSE) & on(_E.PROPOSAL_INVALID) & eqr,              # 3 prevote nil
+        at(_S.PROPOSE) & on(_E.TIMEOUT_PROPOSE) & eqr,               # 4 prevote nil
+        at(_S.PREVOTE) & on(_E.POLKA_ANY) & eqr,                     # 5 sched t.prevote
+        at(_S.PREVOTE) & on(_E.POLKA_NIL) & eqr,                     # 6 precommit nil
+        at(_S.PREVOTE) & on(_E.POLKA_VALUE) & eqr,                   # 7 precommit
+        at(_S.PREVOTE) & on(_E.TIMEOUT_PREVOTE) & eqr,               # 8 precommit nil
+        at(_S.PRECOMMIT) & on(_E.POLKA_VALUE) & eqr,                 # 9 set valid
+        at(_S.COMMIT),                                               # 10 absorb
+        on(_E.PRECOMMIT_ANY) & eqr,                                  # 11 sched t.precommit
+        on(_E.TIMEOUT_PRECOMMIT) & eqr,                              # 12 skip round+1
+        on(_E.ROUND_SKIP) & (s.round < ev.round),                    # 13 skip ev.round
+        on(_E.PRECOMMIT_VALUE),                                      # 14 commit (no eqr!)
+        jnp.ones_like(eqr),                                          # 15 no-op
+    ])
+    arm = jnp.argmax(arms)  # first true wins == Rust match priority
+
+    # --- shared pieces
+    # next_step saturates at Precommit; Commit unchanged (state_machine.rs:58-66)
+    stepped = jnp.where(step < int(_S.PRECOMMIT), step + 1, step)
+    s_next = s._replace(step=stepped)
+    has_valid = s.valid_round >= 0
+
+    # --- candidates per arm
+    # 0: propose (state_machine.rs:222-229): valid value/round if set, else
+    #    the event's value with pol_round -1
+    prop_val = jnp.where(has_valid, s.valid_value, ev.value)
+    prop_pol = jnp.where(has_valid, s.valid_round, jnp.asarray(-1, I32))
+    c0 = (s_next, _msg(_M.PROPOSAL, s.round, prop_val, prop_pol))
+
+    # 1: schedule timeout propose (state_machine.rs:278-281)
+    c1 = (s_next, _msg(_M.TIMEOUT, s.round, NIL_ID, int(TimeoutStep.PROPOSE)))
+
+    # 2: prevote with the lock rule (state_machine.rs:237-246)
+    lock_ok = ((s.locked_round < 0)                 # not locked
+               | (s.locked_round <= ev.pol_round)   # unlock
+               | (s.locked_value == ev.value))      # same value
+    pv_val = jnp.where(lock_ok, ev.value, jnp.asarray(NIL_ID, I32))
+    c2 = (s_next, _msg(_M.VOTE, s.round, pv_val, int(VoteType.PREVOTE)))
+
+    # 3/4: prevote nil (state_machine.rs:250-253)
+    c3 = (s_next, _msg(_M.VOTE, s.round, NIL_ID, int(VoteType.PREVOTE)))
+
+    # 5: schedule timeout prevote — NO step change (state_machine.rs:287-289)
+    c5 = (s, _msg(_M.TIMEOUT, s.round, NIL_ID, int(TimeoutStep.PREVOTE)))
+
+    # 6/8: precommit nil (state_machine.rs:268-271)
+    c6 = (s_next, _msg(_M.VOTE, s.round, NIL_ID, int(VoteType.PRECOMMIT)))
+
+    # 7: precommit value: lock + valid at current round (state_machine.rs:261-264)
+    s7 = s._replace(step=stepped,
+                    locked_round=s.round, locked_value=ev.value,
+                    valid_round=s.round, valid_value=ev.value)
+    c7 = (s7, _msg(_M.VOTE, s.round, ev.value, int(VoteType.PRECOMMIT)))
+
+    # 9: set valid value only, no message (state_machine.rs:304-306)
+    s9 = s._replace(valid_round=s.round, valid_value=ev.value)
+    c9 = (s9, _msg(_M.NONE, 0))
+
+    # 10/15: absorb / no-op
+    c10 = (s, _msg(_M.NONE, 0))
+
+    # 11: schedule timeout precommit — no step change (state_machine.rs:293-295)
+    c11 = (s, _msg(_M.TIMEOUT, s.round, NIL_ID, int(TimeoutStep.PRECOMMIT)))
+
+    # 12/13: round skip → NewRound at target round (state_machine.rs:314-316)
+    def skip(r):
+        return (s._replace(round=r, step=jnp.asarray(int(_S.NEW_ROUND), I32)),
+                _msg(_M.NEW_ROUND, r))
+
+    c12 = skip(ev.round + 1)
+    c13 = skip(ev.round)
+
+    # 14: commit: step only; Decision carries the EVENT round
+    #     (state_machine.rs:320-322)
+    s14 = s._replace(step=jnp.asarray(int(_S.COMMIT), I32))
+    c14 = (s14, _msg(_M.DECISION, ev.round, ev.value))
+
+    cands = [c0, c1, c2, c3, c3, c5, c6, c7, c6, c9, c10, c11, c12, c13, c14, c10]
+
+    def sel(*leaves):
+        return lax.select_n(arm, *leaves)
+
+    state_out = jax.tree.map(sel, *[c[0] for c in cands])
+    msg_out = jax.tree.map(sel, *[c[1] for c in cands])
+    return state_out, msg_out
+
+
+# Batched transition: one event per instance, [n] leaves.
+apply_batch = jax.jit(jax.vmap(apply_scalar))

@@ -1,18 +1,31 @@
 """Test configuration.
 
-Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
-exercised without TPU hardware (the driver separately dry-runs the real
-multi-chip path via __graft_entry__.dryrun_multichip).  The env vars must
-be set before jax is imported anywhere.
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths
+are exercised without TPU hardware (the driver separately dry-runs the
+real multi-chip path via __graft_entry__.dryrun_multichip).
+
+This environment's sitecustomize registers an `axon` TPU backend in
+every interpreter and forces jax_platforms="axon,cpu", so setting env
+vars alone is not enough: we must also override the config in-process
+*before any backend is initialized* (importing jax here, first, does
+that — pytest imports conftest before any test module).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS is read when the CPU client is created (first backend use),
+# which is after this file runs — env assignment here is early enough.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pure-core tests don't need jax
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,112 @@
+"""Exhaustive differential test: device state machine vs python oracle.
+
+Enumerates the full Step × EventTag space crossed with the guard-relevant
+state/payload configurations (round relation, lock/valid configs,
+pol_round validity) — every reference match arm and every guard polarity
+is hit many times.  ~25k cases run as ONE vmapped device call.
+"""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agnes_tpu.core import state_machine as sm
+from agnes_tpu.core.state_machine import Event, EventTag, Step
+from agnes_tpu.device.encoding import (
+    decode_message,
+    decode_state,
+    encode_event,
+    encode_state,
+    stack_pytree,
+)
+from agnes_tpu.device.state_machine import apply_batch
+from agnes_tpu.types import NIL_ID
+
+VAL, OTHER = 7, 9
+
+
+def _cases():
+    rounds = [0, 2]
+    lock_cfgs = [None, (0, VAL), (1, VAL), (0, OTHER), (2, OTHER)]
+    valid_cfgs = [None, (0, VAL)]
+    pol_rounds = [-2, -1, 0, 1]
+    values = [VAL, OTHER]
+    for (step, tag, s_round, lock, valid) in itertools.product(
+            Step, EventTag, rounds, lock_cfgs, valid_cfgs):
+        state = sm.State(
+            height=1, round=s_round, step=step,
+            locked=sm.RoundValue(*lock) if lock else None,
+            valid=sm.RoundValue(*valid) if valid else None)
+        for ev_round in (s_round - 1, s_round, s_round + 1):
+            if ev_round < 0:
+                continue
+            if tag == EventTag.PROPOSAL:
+                for pol, v in itertools.product(pol_rounds, values):
+                    yield state, ev_round, Event.proposal(pol, v)
+            elif tag in (EventTag.NEW_ROUND_PROPOSER, EventTag.POLKA_VALUE,
+                         EventTag.PRECOMMIT_VALUE):
+                for v in values:
+                    yield state, ev_round, Event(tag, value=v)
+            else:
+                yield state, ev_round, Event(tag)
+
+
+def test_exhaustive_differential():
+    cases = list(_cases())
+    assert len(cases) > 5000  # full Step×Event×guard enumeration
+
+    # oracle outputs
+    expected = [sm.apply(s, r, ev) for (s, r, ev) in cases]
+
+    # one batched device call
+    batch_state = stack_pytree([encode_state(s) for (s, _, _) in cases])
+    batch_event = stack_pytree([encode_event(r, ev) for (_, r, ev) in cases])
+    out_state, out_msg = apply_batch(batch_state, batch_event)
+
+    os = [np.asarray(x) for x in out_state]
+    om = [np.asarray(x) for x in out_msg]
+
+    mismatches = 0
+    for i, ((s0, r, ev), (exp_s, exp_m)) in enumerate(zip(cases, expected)):
+        got_s = decode_state(
+            type(out_state)(*[leaf[i] for leaf in os]), height=1)
+        got_m = decode_message(type(out_msg)(*[leaf[i] for leaf in om]))
+        # python oracle keeps height; device state has no height field
+        exp_cmp = sm.State(height=1, round=exp_s.round, step=exp_s.step,
+                           locked=exp_s.locked, valid=exp_s.valid)
+        # device flattens locked/valid: a lock set then never read keeps its
+        # encoding; decode_state reproduces it exactly, so compare directly
+        if got_s != exp_cmp or got_m != exp_m:
+            mismatches += 1
+            if mismatches <= 5:
+                print(f"case {i}: state={s0} round={r} ev={ev}")
+                print(f"  expected: {exp_cmp} / {exp_m}")
+                print(f"  got:      {got_s} / {got_m}")
+    assert mismatches == 0, f"{mismatches} mismatching cases"
+
+
+def test_device_happy_case():
+    """The reference's shipped trace through the device path
+    (state_machine.rs:331-345)."""
+    s = encode_state(sm.State.new(1))
+    trace = [
+        (0, Event.new_round_proposer(VAL)),
+        (0, Event.proposal(-1, VAL)),
+        (0, Event.polka_value(VAL)),
+        (0, Event.precommit_value(VAL)),
+    ]
+    msgs = []
+    for r, ev in trace:
+        s, m = apply_batch(
+            type(s)(*[jnp.asarray(x)[None] for x in s]),
+            type(encode_event(r, ev))(
+                *[jnp.asarray(x)[None] for x in encode_event(r, ev)]))
+        s = type(s)(*[x[0] for x in s])
+        msgs.append(decode_message(type(m)(*[x[0] for x in m])))
+    assert msgs[0] == sm.Message.proposal_msg(0, VAL, -1)
+    assert msgs[1] == sm.Message.prevote(0, VAL)
+    assert msgs[2] == sm.Message.precommit(0, VAL)
+    assert msgs[3] == sm.Message.decision_msg(0, VAL)
+    assert int(s.step) == int(Step.COMMIT)

@@ -28,7 +28,7 @@ def test_to_event_table():
 def test_apply_reference_refire_mode():
     """edge_triggered=False reproduces the reference's level-triggered
     re-fire on every vote after crossing (vote_executor.rs:20-23)."""
-    ve = VoteExecutor(height=1, total_weight=4, edge_triggered=False)
+    ve = VoteExecutor(height=1, total_weight=4)  # level-triggered default
     assert ve.apply(Vote.new_prevote(0, VAL), 1) is None
     assert ve.apply(Vote.new_prevote(0, VAL), 1) is None
     assert ve.apply(Vote.new_prevote(0, VAL), 1).tag == sm.EventTag.POLKA_VALUE
@@ -38,7 +38,7 @@ def test_apply_reference_refire_mode():
 
 def test_apply_edge_triggered():
     """Default mode fires each distinct threshold once (SURVEY.md §2.4)."""
-    ve = VoteExecutor(height=1, total_weight=4)
+    ve = VoteExecutor(height=1, total_weight=4, edge_triggered=True)
     ve.apply(Vote.new_prevote(0, VAL), 1)
     ve.apply(Vote.new_prevote(0, VAL), 1)
     ev = ve.apply(Vote.new_prevote(0, VAL), 1)
@@ -68,3 +68,28 @@ def test_round_skip_detection():
     ve2 = VoteExecutor(height=1, total_weight=3)
     ve2.apply(Vote.new_prevote(2, VAL, validator=0), 3)
     assert ve2.check_round_skip(2) is None
+
+
+def test_cross_height_votes_ignored():
+    """A vote stamped with another height must not count here."""
+    ve = VoteExecutor(height=1, total_weight=3)
+    assert ve.apply(Vote.new_precommit(0, VAL, height=2), 3) is None
+    assert ve.votes.round(0).precommits.value_weight(VAL) == 0
+    # un-stamped and same-height votes count
+    ve.apply(Vote.new_precommit(0, VAL, height=1), 2)
+    assert ve.apply(Vote.new_precommit(0, VAL), 1).tag \
+        == sm.EventTag.PRECOMMIT_VALUE
+
+
+def test_threshold_events_requery_after_missed_edge():
+    """Edge-triggered consumers re-query reached thresholds on state
+    change, so an event consumed in the wrong step is not lost."""
+    ve = VoteExecutor(height=1, total_weight=3, edge_triggered=True)
+    for i in range(3):
+        ev = ve.apply(Vote.new_prevote(0, VAL, validator=i), 1)
+    assert ev.tag == sm.EventTag.POLKA_VALUE     # fired once...
+    assert ve.apply(Vote.new_prevote(0, VAL, validator=0), 1) is None
+    # ...but remains queryable for a consumer whose step just advanced
+    evs = ve.threshold_events(0)
+    assert [e.tag for e in evs] == [sm.EventTag.POLKA_VALUE]
+    assert ve.threshold_events(5) == []
