@@ -2,10 +2,11 @@
 
 Reference parity: src/vote_executor.rs (37 LoC).  `VoteExecutor` adds a
 vote to the tally and maps the resulting (vote type, threshold) pair to a
-state-machine event via the exact table at vote_executor.rs:26-36 —
-including the deliberate asymmetry that a precommit-nil quorum produces
-**no** event (vote_executor.rs:33; the spec reaches round skip through
-TimeoutPrecommit instead).
+state-machine event via the table at vote_executor.rs:26-36.  There is
+still no "PrecommitNil" event, but one cell deviates deliberately: a
+precommit-NIL quorum maps to PRECOMMIT_ANY (the reference maps it to no
+event at all, which starves the spec line 47 timeout and stalls the
+round on a pure-nil precommit quorum — see :func:`to_event`).
 
 Two reference TODOs completed here (SURVEY.md §2.4):
 
@@ -47,7 +48,18 @@ from agnes_tpu.types import Vote, VoteType
 
 def to_event(typ: VoteType, thresh: Thresh) -> Optional[sm.Event]:
     """Map a (vote type, threshold) pair to a state-machine event
-    (reference: vote_executor.rs:26-36)."""
+    (reference: vote_executor.rs:26-36).
+
+    One deliberate deviation: the reference maps (Precommit, Nil) to no
+    event (vote_executor.rs:33, "spec handles +2/3 precommit-nil via
+    TimeoutPrecommit").  But TimeoutPrecommit is only ever *scheduled* by
+    PrecommitAny (spec line 47, which counts precommits "for *" — nil
+    included), and the tally's Nil-over-Any priority
+    (round_votes.rs:58-66) shadows Any whenever the quorum is pure nil —
+    so in the reference a pure-nil precommit quorum produces no event at
+    all and the round stalls.  Here (Precommit, Nil) maps to
+    PRECOMMIT_ANY: still no "PrecommitNil" event (parity), and the spec's
+    timeout path actually triggers."""
     if thresh.kind == ThreshKind.INIT:
         return None
     if typ == VoteType.PREVOTE:
@@ -57,10 +69,8 @@ def to_event(typ: VoteType, thresh: Thresh) -> Optional[sm.Event]:
             return sm.Event.polka_nil()
         return sm.Event.polka_value(thresh.value)
     # precommits
-    if thresh.kind == ThreshKind.ANY:
+    if thresh.kind in (ThreshKind.ANY, ThreshKind.NIL):
         return sm.Event.precommit_any()
-    if thresh.kind == ThreshKind.NIL:
-        return None  # deliberate: no PrecommitNil event (vote_executor.rs:33)
     return sm.Event.precommit_value(thresh.value)
 
 
@@ -94,8 +104,11 @@ class VoteExecutor:
     total_weight: int
     edge_triggered: bool = False
     votes: HeightVotes = None  # type: ignore[assignment]
-    # (round, typ, thresh-kind, value) already emitted — edge-trigger record
-    _emitted: Set[Tuple[int, VoteType, ThreshKind, Optional[int]]] = field(
+    # (round, produced-event tag, value) already emitted — edge-trigger
+    # record.  Keyed on the EVENT, not the threshold kind: ANY and NIL
+    # precommit thresholds both produce PRECOMMIT_ANY, which must fire at
+    # most once per round (spec line 47 "for the first time").
+    _emitted: Set[Tuple[int, sm.EventTag, Optional[int]]] = field(
         default_factory=set)
     # rounds for which RoundSkip was already emitted
     _skipped: Set[int] = field(default_factory=set)
@@ -117,7 +130,7 @@ class VoteExecutor:
         event = to_event(vote.typ, thresh)
         if event is None or not self.edge_triggered:
             return event
-        key = (vote.round, vote.typ, thresh.kind, thresh.value)
+        key = (vote.round, event.tag, event.value)
         if key in self._emitted:
             return None
         self._emitted.add(key)

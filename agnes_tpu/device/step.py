@@ -1,0 +1,153 @@
+"""The fused per-instance consensus step — the flagship device kernel.
+
+One call advances a batch of I independent consensus instances through
+one delivery phase, reproducing the reference's intended top-level loop
+(consensus_executor.rs:24-49, SURVEY.md §3.3) as a fixed pipeline of
+seven branch-free stages, each an `apply` of the vmapped state machine:
+
+  0. external event   — harness/bridge-injected Proposal /
+                        ProposalInvalid / Timeout* (the reference's
+                        inbound wire alphabet, consensus_executor.rs:16-20)
+  1. vote ingestion   — dense tally phase -> edge-triggered threshold
+                        event (stack §3.2: the verify+tally hot path)
+  2. round skip       — +1/3 weight on a higher round -> RoundSkip
+  3. re-query prevote — level-triggered catch-up of the current round's
+     /4. precommit      thresholds, so an edge consumed in a step that
+                        ignored it is never lost (liveness; see
+                        device/tally.py docstring)
+  5. round entry      — step == NewRound -> NewRound/NewRoundProposer
+                        from the precomputed proposer table (fills the
+                        "check if we're the proposer" stub,
+                        consensus_executor.rs:31-33)
+  6. self-proposal    — the proposer processes its own Proposal message
+                        immediately (the re-entrant "call execute"
+                        intent, consensus_executor.rs:36-41)
+
+Every stage emits a DeviceMessage batch; the step returns them stacked
+on a leading stage axis.  The harness/bridge routes VOTE messages back
+into the next phase's dense matrices (self-votes take the same path as
+peer votes, exactly the reference's intent), TIMEOUT to the timer
+wheel, DECISION to the decided log.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from agnes_tpu.core.state_machine import EventTag, MsgTag, Step
+from agnes_tpu.device.encoding import I32, DeviceEvent, DeviceMessage, DeviceState
+from agnes_tpu.device.state_machine import apply_scalar
+from agnes_tpu.device.tally import (
+    _EVENT_TABLE,
+    TallyState,
+    add_votes,
+    current_threshold,
+)
+from agnes_tpu.types import NIL_ID, VoteType
+
+NULL_EVENT = -1  # matches no transition arm -> guaranteed no-op
+
+_apply = jax.vmap(apply_scalar)
+
+
+class VotePhase(NamedTuple):
+    """One dense delivery phase (see device/tally.py)."""
+
+    round: jnp.ndarray   # [I]
+    typ: jnp.ndarray     # [I]
+    slots: jnp.ndarray   # [I, V]
+    mask: jnp.ndarray    # [I, V]
+
+
+class ExtEvent(NamedTuple):
+    """Harness-injected events (tag NULL_EVENT = none)."""
+
+    tag: jnp.ndarray        # [I]
+    round: jnp.ndarray      # [I]
+    value: jnp.ndarray      # [I]
+    pol_round: jnp.ndarray  # [I]
+
+    @classmethod
+    def none(cls, n: int) -> "ExtEvent":
+        z = jnp.zeros((n,), I32)
+        return cls(jnp.full((n,), NULL_EVENT, I32), z, z, z - 1)
+
+
+class StepOutputs(NamedTuple):
+    state: DeviceState
+    tally: TallyState
+    msgs: DeviceMessage  # [n_stages, I] leaves
+
+
+def consensus_step(state: DeviceState,
+                   tally: TallyState,
+                   ext: ExtEvent,
+                   phase: VotePhase,
+                   powers: jnp.ndarray,         # [V]
+                   total_power: jnp.ndarray,    # scalar
+                   proposer_flag: jnp.ndarray,  # [I, W] this node proposes (h,r)
+                   propose_value: jnp.ndarray,  # [I] fresh value to propose
+                   ) -> StepOutputs:
+    msgs = []
+
+    def apply_ev(st, tag, round_, value, pol):
+        ev = DeviceEvent(tag.astype(I32), round_.astype(I32),
+                         value.astype(I32), pol.astype(I32))
+        st, m = _apply(st, ev)
+        msgs.append(m)
+        return st
+
+    # --- 0. external event
+    state = apply_ev(state, ext.tag, ext.round, ext.value, ext.pol_round)
+
+    # --- 1. vote ingestion
+    tally, tev = add_votes(tally, powers, total_power, phase.round, phase.typ,
+                           phase.slots, phase.mask, state.round)
+    neg1 = jnp.full_like(tev.tag, -1)
+    state = apply_ev(state, tev.tag, tev.round, tev.value_slot, neg1)
+
+    # --- 2. round skip
+    skip_tag = jnp.where(tev.skip_round >= 0, int(EventTag.ROUND_SKIP),
+                         NULL_EVENT)
+    state = apply_ev(state, skip_tag, tev.skip_round,
+                     jnp.full_like(skip_tag, NIL_ID), neg1)
+
+    # --- 3./4. re-query current-round thresholds (prevote then precommit)
+    for typ_code in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
+        typ_arr = jnp.full_like(state.round, typ_code)
+        code, vslot = current_threshold(tally, state.round, typ_arr,
+                                        total_power)
+        tag = _EVENT_TABLE[typ_arr, code]
+        state = apply_ev(state, tag, state.round, vslot, neg1)
+
+    # --- 5. round entry
+    W = proposer_flag.shape[1]
+    round_c = jnp.clip(state.round, 0, W - 1)
+    is_prop = jnp.take_along_axis(proposer_flag, round_c[:, None],
+                                  axis=1)[:, 0]
+    at_new_round = state.step == int(Step.NEW_ROUND)
+    entry_tag = jnp.where(
+        at_new_round,
+        jnp.where(is_prop, int(EventTag.NEW_ROUND_PROPOSER),
+                  int(EventTag.NEW_ROUND)),
+        NULL_EVENT)
+    state = apply_ev(state, entry_tag, state.round, propose_value, neg1)
+
+    # --- 6. self-proposal: the proposer processes its own proposal
+    prop_msg = msgs[-1]
+    was_proposal = prop_msg.tag == int(MsgTag.PROPOSAL)
+    self_tag = jnp.where(was_proposal, int(EventTag.PROPOSAL), NULL_EVENT)
+    state = apply_ev(state, self_tag, prop_msg.round, prop_msg.value,
+                     prop_msg.aux)
+
+    stacked = DeviceMessage(*[jnp.stack([getattr(m, f) for m in msgs])
+                              for f in DeviceMessage._fields])
+    return StepOutputs(state=state, tally=tally, msgs=stacked)
+
+
+consensus_step_jit = jax.jit(consensus_step)
+
+N_STAGES = 7

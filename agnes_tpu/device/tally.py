@@ -1,0 +1,245 @@
+"""Vote tally + threshold detection on device: the hot loop of SURVEY §3.2.
+
+Semantics are `core.round_votes`'s (which fixes the reference's
+round_votes.rs single-bucket/no-dedup limitations, SURVEY.md §2.3):
+per-value weight buckets, per-validator dedup, equivocation evidence,
+quorum predicate `3*v > 2*total` (round_votes.rs:31-33), threshold
+priority Value > Nil > Any > Init (round_votes.rs:58-66), `Any` computed
+over all weight seen (round_votes.rs:62).
+
+TPU-first formulation (SURVEY.md §2.3 "TPU mapping"): instead of the
+reference's one-`add_vote`-per-message hot path (round_votes.rs:48-67),
+votes are ingested as **dense per-phase matrices** — one row per
+instance, one column per validator, one call per (round, vote-class)
+phase.  The tally is then a masked one-hot segment-sum over the
+validator axis (an [I,V]×[V,S] contraction XLA maps onto the MXU), the
+threshold check a handful of vectorized compares, and dedup/equivocation
+a gather/compare/scatter against the per-validator vote record.  The
+bridge densifies sparse wire votes into these matrices on the host.
+
+Events are **edge-triggered** here (unlike the reference's re-fire on
+every vote, vote_executor.rs:20-23): `emitted` records the highest
+threshold code already fired per (instance, round, class), and a call
+emits only codes strictly above it.  Weights only grow, and dedup
+bounds per-class weight by total power, so threshold codes are
+monotone — at most one value slot can ever hold a quorum.  The missed-
+edge hazard (threshold fired while the state machine's step ignored it)
+is handled by the instance driver re-querying `current_threshold`.
+
+Slots: a value *slot* is an instance-local dense index for a value id;
+slot -1 is nil (NIL_ID).  The bridge owns the slot<->value-id mapping.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from agnes_tpu.core.state_machine import EventTag
+from agnes_tpu.device.encoding import I32
+from agnes_tpu.types import VoteType
+
+# threshold codes, ordered by priority (round_votes.rs:58-66)
+TH_INIT, TH_ANY, TH_NIL, TH_VALUE = 0, 1, 2, 3
+# voted-record sentinels
+NOT_VOTED = -2
+VOTED_NIL = -1
+# "no event" tag
+NO_EVENT = -1
+
+
+class TallyConfig(NamedTuple):
+    """Static shapes: V validators, W rounds in the tracked window,
+    S value slots per instance."""
+
+    n_validators: int
+    n_rounds: int = 4
+    n_slots: int = 4
+
+
+class TallyState(NamedTuple):
+    """Per-instance tally arrays.  I = batch of instances.
+
+    weights  [I, W, 2, S+1] — voting power per (round, class, slot);
+                              slot index 0 is nil, slot s is column s+1.
+    voted    [I, W, 2, V]   — what each validator voted (NOT_VOTED /
+                              VOTED_NIL / slot) — the dedup +
+                              equivocation record (SURVEY.md §2.3 fix 2).
+    emitted  [I, W, 2]      — highest threshold code already emitted.
+    skipped  [I, W]         — RoundSkip already fired for this round.
+    equiv    [I, V]         — validator produced conflicting votes.
+    """
+
+    weights: jnp.ndarray
+    voted: jnp.ndarray
+    emitted: jnp.ndarray
+    skipped: jnp.ndarray
+    equiv: jnp.ndarray
+
+    @classmethod
+    def new(cls, n_instances: int, cfg: TallyConfig) -> "TallyState":
+        I_, W, S, V = n_instances, cfg.n_rounds, cfg.n_slots, cfg.n_validators
+        return cls(
+            weights=jnp.zeros((I_, W, 2, S + 1), I32),
+            voted=jnp.full((I_, W, 2, V), NOT_VOTED, I32),
+            emitted=jnp.zeros((I_, W, 2), I32),
+            skipped=jnp.zeros((I_, W), jnp.bool_),
+            equiv=jnp.zeros((I_, V), jnp.bool_),
+        )
+
+
+class TallyEvents(NamedTuple):
+    """Per-instance outputs of one ingestion phase.
+
+    tag        [I] — EventTag code or NO_EVENT.
+    value_slot [I] — slot for *_VALUE events, else -1.
+    round      [I] — the round the event belongs to.
+    skip_round [I] — lowest round whose +1/3 skip threshold newly fired,
+                     or -1 (maps to Event::RoundSkip, state_machine.rs:106).
+    """
+
+    tag: jnp.ndarray
+    value_slot: jnp.ndarray
+    round: jnp.ndarray
+    skip_round: jnp.ndarray
+
+
+def _thresh_code(weights_row: jnp.ndarray, total: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """weights_row [..., S+1] -> (code, value_slot).
+
+    Priority Value > Nil > Any (round_votes.rs:58-66); `Any` is quorum of
+    all weight seen in the class (round_votes.rs:62)."""
+    nil_w = weights_row[..., 0]
+    val_w = weights_row[..., 1:]
+    q = lambda w: 3 * w > 2 * total  # noqa: E731  (round_votes.rs:31-33)
+    val_q = q(val_w)
+    has_val = jnp.any(val_q, axis=-1)
+    # at most one slot can hold >2/3 when weights are deduped; argmax of the
+    # masked weights breaks ties for adversarial identity-free streams
+    vslot = jnp.argmax(jnp.where(val_q, val_w, -1), axis=-1).astype(I32)
+    code = jnp.where(
+        has_val, TH_VALUE,
+        jnp.where(q(nil_w), TH_NIL,
+                  jnp.where(q(jnp.sum(weights_row, axis=-1)), TH_ANY, TH_INIT)))
+    return code.astype(I32), jnp.where(has_val, vslot, -1)
+
+
+# (class, code) -> EventTag, the vote_executor.rs:26-36 table.  There is
+# no PrecommitNil event; a pure-nil precommit quorum maps to
+# PRECOMMIT_ANY so spec line 47's timeout actually triggers (see
+# core.vote_executor.to_event for the full rationale).
+_EVENT_TABLE = jnp.asarray([
+    # INIT       ANY                        NIL                      VALUE
+    [NO_EVENT, int(EventTag.POLKA_ANY), int(EventTag.POLKA_NIL),
+     int(EventTag.POLKA_VALUE)],
+    [NO_EVENT, int(EventTag.PRECOMMIT_ANY), int(EventTag.PRECOMMIT_ANY),
+     int(EventTag.PRECOMMIT_VALUE)],
+], dtype=jnp.int32)
+
+
+def add_votes(tally: TallyState,
+              powers: jnp.ndarray,        # [V] voting power
+              total_power: jnp.ndarray,   # scalar
+              round_idx: jnp.ndarray,     # [I] round being ingested
+              typ: jnp.ndarray,           # [I] VoteType code
+              slots: jnp.ndarray,         # [I, V] value slot or VOTED_NIL
+              mask: jnp.ndarray,          # [I, V] vote present
+              cur_round: jnp.ndarray,     # [I] instance's current round
+              ) -> Tuple[TallyState, TallyEvents]:
+    """Ingest one dense vote phase; returns the updated tally and the
+    newly crossed threshold events (the fused verify+tally hot path of
+    the north star, minus signatures which are checked upstream)."""
+    I_, W, _, S1 = tally.weights.shape
+    V = powers.shape[0]
+
+    # --- gather this phase's (round, class) rows
+    onehot_w = (jnp.arange(W)[None, :] == round_idx[:, None])        # [I, W]
+    onehot_t = (jnp.arange(2)[None, :] == typ[:, None])              # [I, 2]
+    sel_wt = onehot_w[:, :, None] & onehot_t[:, None, :]             # [I, W, 2]
+
+    # one-hot gather of the selected row; records are shifted by +3 so a
+    # real value (>= NOT_VOTED = -2) is never confused with the zeroed
+    # non-selected rows
+    voted_row = jnp.sum(
+        jnp.where(sel_wt[:, :, :, None], tally.voted + 3, 0), axis=(1, 2)
+    ) - 3                                                            # [I, V]
+
+    # --- dedup + equivocation (SURVEY.md §2.3 fix 2)
+    fresh = mask & (voted_row == NOT_VOTED)
+    conflict = mask & (voted_row != NOT_VOTED) & (voted_row != slots)
+    voted_row_new = jnp.where(fresh, slots, voted_row)
+
+    # --- masked one-hot segment-sum over the validator axis
+    # column 0 = nil (slot -1), column s+1 = slot s
+    col = jnp.clip(slots + 1, 0, S1 - 1)                             # [I, V]
+    onehot_s = (jnp.arange(S1)[None, None, :] == col[:, :, None])    # [I, V, S1]
+    contrib = jnp.where(fresh, powers[None, :], 0).astype(I32)       # [I, V]
+    delta = jnp.einsum("ivs,iv->is", onehot_s.astype(I32), contrib)  # [I, S1]
+
+    weights_row = jnp.sum(
+        jnp.where(sel_wt[:, :, :, None], tally.weights, 0), axis=(1, 2))
+    weights_row_new = weights_row + delta
+
+    # --- threshold detection + edge-triggered event
+    code, vslot = _thresh_code(weights_row_new, total_power)
+    emitted_row = jnp.sum(jnp.where(sel_wt, tally.emitted, 0), axis=(1, 2))
+    # fire only when the code rises AND maps to a different event: the
+    # precommit class maps both ANY and NIL codes to PRECOMMIT_ANY, which
+    # must fire at most once per round (spec line 47 "for the first time")
+    rising = ((code > emitted_row)
+              & (_EVENT_TABLE[typ, code] != _EVENT_TABLE[typ, emitted_row]))
+    tag = jnp.where(rising, _EVENT_TABLE[typ, code], NO_EVENT).astype(I32)
+    value_slot = jnp.where(tag >= 0, vslot, -1).astype(I32)
+
+    # --- scatter rows back
+    weights = jnp.where(sel_wt[:, :, :, None],
+                        weights_row_new[:, None, None, :], tally.weights)
+    voted = jnp.where(sel_wt[:, :, :, None],
+                      voted_row_new[:, None, None, :], tally.voted)
+    emitted = jnp.where(sel_wt, jnp.maximum(emitted_row, code)[:, None, None],
+                        tally.emitted)
+    equiv = tally.equiv | conflict
+
+    # --- RoundSkip: +1/3 of distinct-voter weight on a round above the
+    # instance's current one (state_machine.rs:106; detection absent in
+    # the reference).  Weight per round from the voted record, one vote
+    # per validator regardless of class.
+    seen_any = jnp.any(voted != NOT_VOTED, axis=2)                   # [I, W, V]
+    w_skip = jnp.einsum("iwv,v->iw", seen_any.astype(I32),
+                        powers.astype(I32))                          # [I, W]
+    eligible = ((3 * w_skip > total_power)
+                & (jnp.arange(W)[None, :] > cur_round[:, None])
+                & ~tally.skipped)                                    # [I, W]
+    any_skip = jnp.any(eligible, axis=1)
+    skip_round = jnp.where(
+        any_skip,
+        jnp.argmax(eligible, axis=1).astype(I32),  # lowest eligible round
+        -1)
+    skipped = tally.skipped | (jnp.arange(W)[None, :] == skip_round[:, None])
+
+    new_tally = TallyState(weights=weights, voted=voted, emitted=emitted,
+                           skipped=skipped, equiv=equiv)
+    events = TallyEvents(tag=tag, value_slot=value_slot,
+                         round=round_idx.astype(I32), skip_round=skip_round)
+    return new_tally, events
+
+
+def current_threshold(tally: TallyState, round_idx: jnp.ndarray,
+                      typ: jnp.ndarray, total_power: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(code, value_slot) currently reached at [I] (round, class) — the
+    re-query path for consumers that advanced step/round after an edge
+    was consumed (mirrors core.vote_executor.threshold_events)."""
+    W = tally.weights.shape[1]
+    onehot_w = (jnp.arange(W)[None, :] == round_idx[:, None])
+    onehot_t = (jnp.arange(2)[None, :] == typ[:, None])
+    sel_wt = onehot_w[:, :, None] & onehot_t[:, None, :]
+    weights_row = jnp.sum(
+        jnp.where(sel_wt[:, :, :, None], tally.weights, 0), axis=(1, 2))
+    return _thresh_code(weights_row, total_power)
+
+
+add_votes_jit = jax.jit(add_votes)

@@ -1,0 +1,187 @@
+"""Fused consensus-step tests: batches of instances driven to decision
+through the 7-stage device pipeline (BASELINE config 1 via the device
+path — the minimum end-to-end slice of SURVEY.md §7)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from agnes_tpu.core.state_machine import EventTag, MsgTag, Step, TimeoutStep
+from agnes_tpu.device.encoding import DeviceState
+from agnes_tpu.device.step import (
+    ExtEvent,
+    N_STAGES,
+    VotePhase,
+    consensus_step_jit,
+)
+from agnes_tpu.device.tally import TallyConfig, TallyState
+from agnes_tpu.types import VoteType
+
+I, V = 8, 4
+CFG = TallyConfig(n_validators=V, n_rounds=4, n_slots=4)
+POWERS = jnp.ones((V,), jnp.int32)
+TOTAL = jnp.asarray(V, jnp.int32)
+VAL = 2  # value slot this height's proposals use
+
+
+def _empty_phase():
+    return VotePhase(jnp.zeros(I, jnp.int32), jnp.zeros(I, jnp.int32),
+                     jnp.full((I, V), -1, jnp.int32),
+                     jnp.zeros((I, V), bool))
+
+
+def _phase(round_, typ, votes):
+    slots = np.full((I, V), -1, np.int32)
+    mask = np.zeros((I, V), bool)
+    for v, s in votes.items():
+        slots[:, v] = s
+        mask[:, v] = True
+    return VotePhase(jnp.full(I, round_, jnp.int32),
+                     jnp.full(I, int(typ), jnp.int32),
+                     jnp.asarray(slots), jnp.asarray(mask))
+
+
+def _step(state, tally, ext=None, phase=None, proposer=True):
+    return consensus_step_jit(
+        state, tally,
+        ext if ext is not None else ExtEvent.none(I),
+        phase if phase is not None else _empty_phase(),
+        POWERS, TOTAL,
+        jnp.full((I, CFG.n_rounds), proposer, bool),
+        jnp.full(I, VAL, jnp.int32))
+
+
+def _msgs_at(msgs, stage):
+    return {f: np.asarray(getattr(msgs, f))[stage] for f in msgs._fields}
+
+
+def test_proposer_decides_in_three_steps():
+    """Happy path: this node proposes; peers echo votes; decision."""
+    state = DeviceState.new((I,))
+    tally = TallyState.new(I, CFG)
+
+    # step 1: round entry -> proposal -> self-prevote
+    state, tally, msgs = _step(state, tally)
+    entry = _msgs_at(msgs, 5)
+    assert (entry["tag"] == int(MsgTag.PROPOSAL)).all()
+    assert (entry["value"] == VAL).all()
+    selfp = _msgs_at(msgs, 6)
+    assert (selfp["tag"] == int(MsgTag.VOTE)).all()
+    assert (selfp["aux"] == int(VoteType.PREVOTE)).all()
+    assert (np.asarray(state.step) == int(Step.PREVOTE)).all()
+
+    # step 2: deliver everyone's prevotes (incl. our own, validator 0)
+    state, tally, msgs = _step(state, tally,
+                               phase=_phase(0, VoteType.PREVOTE,
+                                            {0: VAL, 1: VAL, 2: VAL}))
+    polka = _msgs_at(msgs, 1)
+    assert (polka["tag"] == int(MsgTag.VOTE)).all()
+    assert (polka["aux"] == int(VoteType.PRECOMMIT)).all()
+    assert (polka["value"] == VAL).all()
+    assert (np.asarray(state.step) == int(Step.PRECOMMIT)).all()
+    assert (np.asarray(state.locked_round) == 0).all()
+
+    # step 3: deliver precommits -> decision
+    state, tally, msgs = _step(state, tally,
+                               phase=_phase(0, VoteType.PRECOMMIT,
+                                            {0: VAL, 1: VAL, 2: VAL}))
+    dec = _msgs_at(msgs, 1)
+    assert (dec["tag"] == int(MsgTag.DECISION)).all()
+    assert (dec["value"] == VAL).all()
+    assert (np.asarray(state.step) == int(Step.COMMIT)).all()
+
+
+def test_non_proposer_times_out_to_nil_and_skips_round():
+    """Liveness path: no proposal arrives; timeouts drive nil votes and a
+    round skip into round 1 (spec lines 57/61/65)."""
+    state = DeviceState.new((I,))
+    tally = TallyState.new(I, CFG)
+
+    # round entry as non-proposer -> schedule timeout propose
+    state, tally, msgs = _step(state, tally, proposer=False)
+    entry = _msgs_at(msgs, 5)
+    assert (entry["tag"] == int(MsgTag.TIMEOUT)).all()
+    assert (entry["aux"] == int(TimeoutStep.PROPOSE)).all()
+
+    # timeout fires (harness timer wheel) -> prevote nil
+    ext = ExtEvent(jnp.full(I, int(EventTag.TIMEOUT_PROPOSE), jnp.int32),
+                   jnp.zeros(I, jnp.int32), jnp.zeros(I, jnp.int32),
+                   jnp.full(I, -1, jnp.int32))
+    state, tally, msgs = _step(state, tally, ext=ext, proposer=False)
+    m = _msgs_at(msgs, 0)
+    assert (m["tag"] == int(MsgTag.VOTE)).all()
+    assert (m["value"] == -1).all()  # nil
+
+    # everyone prevotes nil -> polka nil -> precommit nil
+    state, tally, msgs = _step(
+        state, tally, phase=_phase(0, VoteType.PREVOTE, {0: -1, 1: -1, 2: -1}),
+        proposer=False)
+    m = _msgs_at(msgs, 1)
+    assert (m["tag"] == int(MsgTag.VOTE)).all()
+    assert (m["aux"] == int(VoteType.PRECOMMIT)).all()
+    assert (m["value"] == -1).all()
+
+    # everyone precommits nil: no event (vote_executor.rs:33), but
+    # PrecommitAny requery (stage 4) schedules timeout precommit
+    state, tally, msgs = _step(
+        state, tally,
+        phase=_phase(0, VoteType.PRECOMMIT, {0: -1, 1: -1, 2: -1}),
+        proposer=False)
+    m = _msgs_at(msgs, 4)
+    assert (m["tag"] == int(MsgTag.TIMEOUT)).all()
+    assert (m["aux"] == int(TimeoutStep.PRECOMMIT)).all()
+
+    # timeout precommit -> round 1, re-entry as non-proposer
+    ext = ExtEvent(jnp.full(I, int(EventTag.TIMEOUT_PRECOMMIT), jnp.int32),
+                   jnp.zeros(I, jnp.int32), jnp.zeros(I, jnp.int32),
+                   jnp.full(I, -1, jnp.int32))
+    state, tally, msgs = _step(state, tally, ext=ext, proposer=False)
+    assert (np.asarray(state.round) == 1).all()
+    entry = _msgs_at(msgs, 5)
+    assert (entry["tag"] == int(MsgTag.TIMEOUT)).all()
+    assert (np.asarray(state.step) == int(Step.PROPOSE)).all()
+
+
+def test_round_skip_via_higher_round_votes():
+    """+1/3 of voters on round 2 pulls a lagging instance forward."""
+    state = DeviceState.new((I,))
+    tally = TallyState.new(I, CFG)
+    state, tally, _ = _step(state, tally, proposer=False)  # enter round 0
+
+    state, tally, msgs = _step(
+        state, tally, phase=_phase(2, VoteType.PREVOTE, {1: VAL, 2: VAL}),
+        proposer=False)
+    m = _msgs_at(msgs, 2)
+    assert (m["tag"] == int(MsgTag.NEW_ROUND)).all()
+    assert (np.asarray(state.round) == 2).all()
+    # entry stage re-enters the new round in the same step
+    entry = _msgs_at(msgs, 5)
+    assert (entry["tag"] == int(MsgTag.TIMEOUT)).all()
+
+
+def test_missed_edge_recovered_by_requery():
+    """Polka crosses while the proposal is still in flight (state at
+    Propose ignores it); the re-query stage delivers it after the
+    proposal advances the step — the liveness hazard of edge-triggering,
+    closed (see device/tally.py docstring)."""
+    state = DeviceState.new((I,))
+    tally = TallyState.new(I, CFG)
+    state, tally, _ = _step(state, tally, proposer=False)  # Propose step
+
+    # prevotes arrive BEFORE the proposal: edge fires, state ignores it
+    state, tally, msgs = _step(
+        state, tally, phase=_phase(0, VoteType.PREVOTE, {1: VAL, 2: VAL, 3: VAL}),
+        proposer=False)
+    assert (np.asarray(state.step) == int(Step.PROPOSE)).all()  # still waiting
+
+    # proposal finally arrives -> prevote stage, then requery delivers the
+    # polka in the SAME step -> precommit + lock
+    ext = ExtEvent(jnp.full(I, int(EventTag.PROPOSAL), jnp.int32),
+                   jnp.zeros(I, jnp.int32), jnp.full(I, VAL, jnp.int32),
+                   jnp.full(I, -1, jnp.int32))
+    state, tally, msgs = _step(state, tally, ext=ext, proposer=False)
+    assert (np.asarray(state.step) == int(Step.PRECOMMIT)).all()
+    assert (np.asarray(state.locked_round) == 0).all()
+    m = _msgs_at(msgs, 3)
+    assert (m["tag"] == int(MsgTag.VOTE)).all()
+    assert (m["aux"] == int(VoteType.PRECOMMIT)).all()
+    assert (m["value"] == VAL).all()

@@ -1,0 +1,216 @@
+"""Device tally tests: scenario tests for thresholds/dedup/equivocation/
+round-skip, plus a randomized differential against the Python tally."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from agnes_tpu.core.round_votes import RoundVotes
+from agnes_tpu.core.state_machine import EventTag
+from agnes_tpu.device.tally import (
+    NO_EVENT,
+    NOT_VOTED,
+    TH_ANY,
+    TH_INIT,
+    TH_NIL,
+    TH_VALUE,
+    TallyConfig,
+    TallyState,
+    add_votes_jit,
+    current_threshold,
+)
+from agnes_tpu.types import Vote, VoteType
+
+CFG = TallyConfig(n_validators=4, n_rounds=3, n_slots=3)
+POWERS = jnp.asarray([1, 1, 1, 1], jnp.int32)
+TOTAL = jnp.asarray(4, jnp.int32)
+
+
+def _phase(tally, round_, typ, votes, cur_round=0, n=1):
+    """votes: {validator: slot} (-1 = nil); returns (tally, events)."""
+    slots = np.full((n, CFG.n_validators), -1, np.int32)
+    mask = np.zeros((n, CFG.n_validators), bool)
+    for v, s in votes.items():
+        slots[:, v] = s
+        mask[:, v] = True
+    return add_votes_jit(
+        tally, POWERS, TOTAL,
+        jnp.full((n,), round_, jnp.int32), jnp.full((n,), int(typ), jnp.int32),
+        jnp.asarray(slots), jnp.asarray(mask),
+        jnp.full((n,), cur_round, jnp.int32))
+
+
+def test_value_quorum_event():
+    t = TallyState.new(1, CFG)
+    t, ev = _phase(t, 0, VoteType.PREVOTE, {0: 2, 1: 2, 2: 2})
+    assert int(ev.tag[0]) == int(EventTag.POLKA_VALUE)
+    assert int(ev.value_slot[0]) == 2
+    assert int(ev.round[0]) == 0
+    # weights: slot 2 -> column 3
+    assert int(t.weights[0, 0, 0, 3]) == 3
+
+
+def test_edge_triggered_and_dedup():
+    t = TallyState.new(1, CFG)
+    t, ev = _phase(t, 0, VoteType.PREVOTE, {0: 1, 1: 1, 2: 1})
+    assert int(ev.tag[0]) == int(EventTag.POLKA_VALUE)
+    # same votes again: deduped (no weight growth) and no re-fire
+    t, ev = _phase(t, 0, VoteType.PREVOTE, {0: 1, 1: 1, 2: 1})
+    assert int(ev.tag[0]) == NO_EVENT
+    assert int(t.weights[0, 0, 0, 2]) == 3
+    # re-query path still reports the reached threshold
+    code, vslot = current_threshold(
+        t, jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.int32), TOTAL)
+    assert int(code[0]) == TH_VALUE and int(vslot[0]) == 1
+
+
+def test_any_then_nil_then_value_ladder():
+    t = TallyState.new(1, CFG)
+    # 2 for slot 0, 1 nil: 3 of 4 seen -> Any
+    t, ev = _phase(t, 0, VoteType.PREVOTE, {0: 0, 1: 0, 2: -1})
+    assert int(ev.tag[0]) == int(EventTag.POLKA_ANY)
+    # one more for slot 0 -> Value (3 of 4)
+    t, ev = _phase(t, 0, VoteType.PREVOTE, {3: 0})
+    assert int(ev.tag[0]) == int(EventTag.POLKA_VALUE)
+    assert int(ev.value_slot[0]) == 0
+
+
+def test_nil_quorum():
+    t = TallyState.new(1, CFG)
+    t, ev = _phase(t, 1, VoteType.PREVOTE, {0: -1, 1: -1, 2: -1})
+    assert int(ev.tag[0]) == int(EventTag.POLKA_NIL)
+
+
+def test_precommit_nil_maps_to_precommit_any():
+    """No PrecommitNil event exists (vote_executor.rs:33 parity); a
+    pure-nil precommit quorum fires PRECOMMIT_ANY so the spec line 47
+    timeout path triggers (see core.vote_executor.to_event)."""
+    t = TallyState.new(1, CFG)
+    t, ev = _phase(t, 0, VoteType.PRECOMMIT, {0: -1, 1: -1, 2: -1})
+    assert int(ev.tag[0]) == int(EventTag.PRECOMMIT_ANY)
+    # but the threshold itself is recorded (for TimeoutPrecommit flows)
+    code, _ = current_threshold(
+        t, jnp.zeros(1, jnp.int32), jnp.ones(1, jnp.int32), TOTAL)
+    assert int(code[0]) == TH_NIL
+
+
+def test_precommit_any_event():
+    t = TallyState.new(1, CFG)
+    t, ev = _phase(t, 0, VoteType.PRECOMMIT, {0: 0, 1: 1, 2: -1})
+    assert int(ev.tag[0]) == int(EventTag.PRECOMMIT_ANY)
+
+
+def test_equivocation_detection():
+    t = TallyState.new(1, CFG)
+    t, _ = _phase(t, 0, VoteType.PREVOTE, {0: 1})
+    t, _ = _phase(t, 0, VoteType.PREVOTE, {0: 2})  # conflict!
+    assert bool(t.equiv[0, 0])
+    assert not bool(t.equiv[0, 1])
+    # first vote kept, second not counted
+    assert int(t.weights[0, 0, 0, 2]) == 1  # slot 1
+    assert int(t.weights[0, 0, 0, 3]) == 0  # slot 2
+    # same validator voting the other CLASS is not equivocation
+    t2 = TallyState.new(1, CFG)
+    t2, _ = _phase(t2, 0, VoteType.PREVOTE, {0: 1})
+    t2, _ = _phase(t2, 0, VoteType.PRECOMMIT, {0: 2})
+    assert not bool(t2.equiv[0, 0])
+
+
+def test_round_skip_fires_once():
+    t = TallyState.new(1, CFG)
+    # 2 of 4 voters (not > 1/3) at round 2: no skip
+    t, ev = _phase(t, 2, VoteType.PREVOTE, {0: 1}, cur_round=0)
+    assert int(ev.skip_round[0]) == -1
+    # third distinct voter pushes past 1/3 (3*2 > 4)
+    t, ev = _phase(t, 2, VoteType.PREVOTE, {1: 1}, cur_round=0)
+    assert int(ev.skip_round[0]) == 2
+    # fires once
+    t, ev = _phase(t, 2, VoteType.PRECOMMIT, {2: 1}, cur_round=0)
+    assert int(ev.skip_round[0]) == -1
+    # rounds at/below current never skip
+    t2 = TallyState.new(1, CFG)
+    t2, ev = _phase(t2, 1, VoteType.PREVOTE, {0: 1, 1: 1}, cur_round=1)
+    assert int(ev.skip_round[0]) == -1
+
+
+def test_differential_vs_python_tally():
+    """Random dense phases through both tallies; final weights, threshold
+    codes and equivocation sets must agree exactly."""
+    rng = np.random.default_rng(42)
+    I, V, W, S = 6, 5, 3, 3
+    cfg = TallyConfig(n_validators=V, n_rounds=W, n_slots=S)
+    powers_np = rng.integers(1, 4, size=V).astype(np.int32)
+    total = int(powers_np.sum())
+    powers = jnp.asarray(powers_np)
+
+    dev = TallyState.new(I, cfg)
+    py = [{(w, t): RoundVotes(height=0, round=w, total=total)
+           for w in range(W) for t in range(2)} for _ in range(I)]
+
+    for _ in range(12):
+        round_ = rng.integers(0, W, size=I).astype(np.int32)
+        typ = rng.integers(0, 2, size=I).astype(np.int32)
+        slots = rng.integers(-1, S, size=(I, V)).astype(np.int32)
+        mask = rng.random((I, V)) < 0.6
+        dev, _ = add_votes_jit(
+            dev, powers, jnp.asarray(total, jnp.int32), jnp.asarray(round_),
+            jnp.asarray(typ), jnp.asarray(slots), jnp.asarray(mask),
+            jnp.zeros(I, jnp.int32))
+        for i in range(I):
+            rv = py[i][(int(round_[i]), int(typ[i]))]
+            for v in range(V):
+                if not mask[i, v]:
+                    continue
+                value = None if slots[i, v] < 0 else int(slots[i, v])
+                vt = VoteType(int(typ[i]))
+                vote = (Vote.new_prevote if vt == VoteType.PREVOTE
+                        else Vote.new_precommit)(int(round_[i]), value,
+                                                 validator=v)
+                rv.add_vote(vote, int(powers_np[v]))
+
+    wts = np.asarray(dev.weights)
+    eqv = np.asarray(dev.equiv)
+    kind_to_code = {0: TH_INIT, 1: TH_ANY, 2: TH_NIL, 3: TH_VALUE}
+    for i in range(I):
+        equivocators = set()
+        for (w, t), rv in py[i].items():
+            count = rv.prevotes if t == 0 else rv.precommits
+            assert wts[i, w, t, 0] == count.nil, (i, w, t)
+            for s in range(S):
+                assert wts[i, w, t, s + 1] == count.value_weight(s), (i, w, t, s)
+            code, vslot = current_threshold(
+                dev, jnp.full(I, w, jnp.int32), jnp.full(I, t, jnp.int32),
+                jnp.asarray(total, jnp.int32))
+            th = count.thresh()
+            assert int(code[i]) == kind_to_code[int(th.kind)], (i, w, t)
+            if th.value is not None:
+                assert int(vslot[i]) == th.value
+            equivocators |= {e.validator for e in rv.equivocations}
+        assert set(np.nonzero(eqv[i])[0]) == equivocators, i
+
+
+def test_device_precommit_any_fires_once_across_any_then_nil():
+    """Device mirror of the ANY->NIL no-refire rule (spec line 47):
+    a mixed precommit quorum fires PRECOMMIT_ANY; when nil alone later
+    crosses 2/3 (threshold code rises ANY->NIL) the same event must NOT
+    fire again."""
+    cfg = TallyConfig(n_validators=4, n_rounds=2, n_slots=2)
+    powers = jnp.asarray([40, 30, 40, 40], jnp.int32)
+    total = jnp.asarray(150, jnp.int32)  # quorum needs weight > 100
+    t = TallyState.new(1, cfg)
+
+    def ph(t, votes):
+        slots = np.full((1, 4), -1, np.int32)
+        mask = np.zeros((1, 4), bool)
+        for v, s in votes.items():
+            slots[:, v] = s
+            mask[:, v] = True
+        return add_votes_jit(t, powers, total, jnp.zeros(1, jnp.int32),
+                             jnp.ones(1, jnp.int32), jnp.asarray(slots),
+                             jnp.asarray(mask), jnp.zeros(1, jnp.int32))
+
+    # mixed: value 40 + nil 70 = 110 > 100 seen, nil 70 <= 100 -> ANY
+    t, ev = ph(t, {0: 0, 1: -1, 2: -1})
+    assert int(ev.tag[0]) == int(EventTag.PRECOMMIT_ANY)
+    # nil now 110 > 100: code rises to NIL, event is the same -> silent
+    t, ev = ph(t, {3: -1})
+    assert int(ev.tag[0]) == NO_EVENT

@@ -20,7 +20,9 @@ def test_to_event_table():
     ev = to_event(VoteType.PREVOTE, T.for_value(VAL))
     assert ev.tag == E.POLKA_VALUE and ev.value == VAL
     assert to_event(VoteType.PRECOMMIT, T.any()).tag == E.PRECOMMIT_ANY
-    assert to_event(VoteType.PRECOMMIT, T.nil()) is None
+    # pure-nil precommit quorum triggers the spec line 47 timeout path —
+    # documented deviation from vote_executor.rs:33 (see to_event docstring)
+    assert to_event(VoteType.PRECOMMIT, T.nil()).tag == E.PRECOMMIT_ANY
     ev = to_event(VoteType.PRECOMMIT, T.for_value(VAL))
     assert ev.tag == E.PRECOMMIT_VALUE and ev.value == VAL
 
@@ -93,3 +95,15 @@ def test_threshold_events_requery_after_missed_edge():
     evs = ve.threshold_events(0)
     assert [e.tag for e in evs] == [sm.EventTag.POLKA_VALUE]
     assert ve.threshold_events(5) == []
+
+
+def test_precommit_any_fires_once_across_any_then_nil_threshold():
+    """ANY and NIL precommit thresholds both map to PRECOMMIT_ANY; the
+    edge-trigger must not re-fire it when the code rises ANY -> NIL
+    (spec line 47 'for the first time')."""
+    ve = VoteExecutor(height=1, total_weight=100, edge_triggered=True)
+    ve.apply(Vote.new_precommit(0, VAL, validator=0), 40)
+    ev = ve.apply(Vote.new_precommit(0, None, validator=1), 30)
+    assert ev.tag == sm.EventTag.PRECOMMIT_ANY  # mixed quorum: 70 of 100
+    ev = ve.apply(Vote.new_precommit(0, None, validator=2), 40)
+    assert ev is None  # nil alone now has quorum; same event, no re-fire
