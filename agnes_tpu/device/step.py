@@ -42,13 +42,15 @@ from agnes_tpu.device.encoding import I32, DeviceEvent, DeviceMessage, DeviceSta
 from agnes_tpu.device.state_machine import apply_scalar
 from agnes_tpu.device.tally import (
     _EVENT_TABLE,
+    NO_EVENT,
     TallyState,
     add_votes,
     current_threshold,
 )
 from agnes_tpu.types import NIL_ID, VoteType
 
-NULL_EVENT = -1  # matches no transition arm -> guaranteed no-op
+# "no event" tag: matches no transition arm -> guaranteed no-op
+NULL_EVENT = NO_EVENT
 
 _apply = jax.vmap(apply_scalar)
 
@@ -90,6 +92,7 @@ def consensus_step(state: DeviceState,
                    total_power: jnp.ndarray,    # scalar
                    proposer_flag: jnp.ndarray,  # [I, W] this node proposes (h,r)
                    propose_value: jnp.ndarray,  # [I] fresh value to propose
+                   axis_name: str | None = None,  # validator mesh axis (psum)
                    ) -> StepOutputs:
     msgs = []
 
@@ -105,8 +108,21 @@ def consensus_step(state: DeviceState,
 
     # --- 1. vote ingestion
     tally, tev = add_votes(tally, powers, total_power, phase.round, phase.typ,
-                           phase.slots, phase.mask, state.round)
+                           phase.slots, phase.mask, state.round,
+                           axis_name=axis_name)
     neg1 = jnp.full_like(tev.tag, -1)
+    # precommit-class events are consumed on first in-round delivery
+    # (their arms are step-independent, state_machine.rs:208,:211) —
+    # record that so they are never re-delivered (one TimeoutPrecommit
+    # schedule per round, spec line 47 "for the first time")
+    is_pc_ev = ((tev.tag == int(EventTag.PRECOMMIT_ANY))
+                | (tev.tag == int(EventTag.PRECOMMIT_VALUE)))
+    consumed = is_pc_ev & ((tev.round == state.round)
+                           | (tev.tag == int(EventTag.PRECOMMIT_VALUE)))
+    W_t = tally.pc_done.shape[1]
+    pc_hit = ((jnp.arange(W_t)[None, :] == tev.round[:, None])
+              & consumed[:, None])
+    tally = tally._replace(pc_done=tally.pc_done | pc_hit)
     state = apply_ev(state, tev.tag, tev.round, tev.value_slot, neg1)
 
     # --- 2. round skip
@@ -115,20 +131,43 @@ def consensus_step(state: DeviceState,
     state = apply_ev(state, skip_tag, tev.skip_round,
                      jnp.full_like(skip_tag, NIL_ID), neg1)
 
-    # --- 3./4. re-query current-round thresholds (prevote then precommit)
+    # --- 3./4. re-query current-round thresholds (prevote then precommit),
+    # at most once per state-machine (round, step): the q_round/q_step
+    # cursor records the state the re-query stages last ran against, so a
+    # standing threshold cannot re-schedule its timeout every step (spec
+    # line 47 "for the first time") — it re-fires only after the state
+    # machine actually moved, which is exactly when a previously ignored
+    # edge may have become applicable (the missed-edge hazard).
     for typ_code in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
         typ_arr = jnp.full_like(state.round, typ_code)
         code, vslot = current_threshold(tally, state.round, typ_arr,
                                         total_power)
-        tag = _EVENT_TABLE[typ_arr, code]
+        moved = (state.round != tally.q_round) | (state.step != tally.q_step)
+        tag = jnp.where(moved, _EVENT_TABLE[typ_arr, code], NULL_EVENT)
+        # suppress re-delivery of the event stage 1 just delivered for
+        # the same round (same-call duplicate, cursor not yet advanced)
+        tag = jnp.where((tag == tev.tag) & (state.round == tev.round),
+                        NULL_EVENT, tag)
+        if typ_code == int(VoteType.PRECOMMIT):
+            round_c_t = jnp.clip(state.round, 0, W_t - 1)
+            done = jnp.take_along_axis(tally.pc_done, round_c_t[:, None],
+                                       axis=1)[:, 0]
+            tag = jnp.where(done, NULL_EVENT, tag)
+            fired = (tag != NULL_EVENT) & (state.round < W_t)
+            pc_hit = ((jnp.arange(W_t)[None, :] == state.round[:, None])
+                      & fired[:, None])
+            tally = tally._replace(pc_done=tally.pc_done | pc_hit)
         state = apply_ev(state, tag, state.round, vslot, neg1)
+    tally = tally._replace(q_round=state.round, q_step=state.step)
 
-    # --- 5. round entry
+    # --- 5. round entry (only for rounds inside the proposer-table /
+    # tally window; the host driver rotates the window for rounds beyond)
     W = proposer_flag.shape[1]
     round_c = jnp.clip(state.round, 0, W - 1)
     is_prop = jnp.take_along_axis(proposer_flag, round_c[:, None],
                                   axis=1)[:, 0]
-    at_new_round = state.step == int(Step.NEW_ROUND)
+    at_new_round = ((state.step == int(Step.NEW_ROUND))
+                    & (state.round < W))
     entry_tag = jnp.where(
         at_new_round,
         jnp.where(is_prop, int(EventTag.NEW_ROUND_PROPOSER),

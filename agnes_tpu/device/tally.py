@@ -70,6 +70,22 @@ class TallyState(NamedTuple):
     emitted  [I, W, 2]      — highest threshold code already emitted.
     skipped  [I, W]         — RoundSkip already fired for this round.
     equiv    [I, V]         — validator produced conflicting votes.
+    q_round  [I]            — (round, step) the re-query stages last ran
+    q_step   [I]              against; each state-machine state is
+                              re-queried at most once, so level-triggered
+                              catch-up cannot re-schedule timeouts forever
+                              (spec line 47 "for the first time").
+    pc_done  [I, W]         — a precommit-class threshold event for this
+                              round was already *consumed* by the state
+                              machine.  PRECOMMIT_ANY/PRECOMMIT_VALUE
+                              arms are step-independent (state_machine.rs
+                              :208,:211), so first delivery at the right
+                              round consumes them for good — exactly one
+                              TimeoutPrecommit schedule per round.
+    skip_w   [I, W]         — distinct-voter weight per round (either
+                              class), maintained incrementally so the
+                              round-skip check needs no O(W*V) sweep of
+                              the voted record per phase.
     """
 
     weights: jnp.ndarray
@@ -77,6 +93,10 @@ class TallyState(NamedTuple):
     emitted: jnp.ndarray
     skipped: jnp.ndarray
     equiv: jnp.ndarray
+    q_round: jnp.ndarray
+    q_step: jnp.ndarray
+    pc_done: jnp.ndarray
+    skip_w: jnp.ndarray
 
     @classmethod
     def new(cls, n_instances: int, cfg: TallyConfig) -> "TallyState":
@@ -87,6 +107,10 @@ class TallyState(NamedTuple):
             emitted=jnp.zeros((I_, W, 2), I32),
             skipped=jnp.zeros((I_, W), jnp.bool_),
             equiv=jnp.zeros((I_, V), jnp.bool_),
+            q_round=jnp.full((I_,), -1, I32),
+            q_step=jnp.full((I_,), -1, I32),
+            pc_done=jnp.zeros((I_, W), jnp.bool_),
+            skip_w=jnp.zeros((I_, W), I32),
         )
 
 
@@ -140,6 +164,25 @@ _EVENT_TABLE = jnp.asarray([
 ], dtype=jnp.int32)
 
 
+def _sel_wt(W: int, round_idx: jnp.ndarray, typ: jnp.ndarray) -> jnp.ndarray:
+    """[I, W, 2] one-hot selector of each instance's (round, class) row.
+    All-false when round_idx is outside the tracked window [0, W)."""
+    onehot_w = (jnp.arange(W)[None, :] == round_idx[:, None])
+    onehot_t = (jnp.arange(2)[None, :] == typ[:, None])
+    return onehot_w[:, :, None] & onehot_t[:, None, :]
+
+
+def _gather_row(arr: jnp.ndarray, sel_wt: jnp.ndarray,
+                fill: int = 0) -> jnp.ndarray:
+    """One-hot gather of the selected [I, ...] row of an [I, W, 2, ...]
+    (or [I, W, 2]) array; rows outside the window read as `fill`.
+
+    Values are shifted so real entries are never confused with the
+    zeroed non-selected rows, whatever `fill` is."""
+    sel = sel_wt.reshape(sel_wt.shape + (1,) * (arr.ndim - 3))
+    return jnp.sum(jnp.where(sel, arr - fill, 0), axis=(1, 2)) + fill
+
+
 def add_votes(tally: TallyState,
               powers: jnp.ndarray,        # [V] voting power
               total_power: jnp.ndarray,   # scalar
@@ -148,24 +191,32 @@ def add_votes(tally: TallyState,
               slots: jnp.ndarray,         # [I, V] value slot or VOTED_NIL
               mask: jnp.ndarray,          # [I, V] vote present
               cur_round: jnp.ndarray,     # [I] instance's current round
+              axis_name: str | None = None,
               ) -> Tuple[TallyState, TallyEvents]:
     """Ingest one dense vote phase; returns the updated tally and the
     newly crossed threshold events (the fused verify+tally hot path of
-    the north star, minus signatures which are checked upstream)."""
+    the north star, minus signatures which are checked upstream).
+
+    Under `shard_map` over a validator-sharded mesh axis, pass
+    `axis_name` and per-device V-shards of `powers`/`slots`/`mask`/
+    `tally.voted`/`tally.equiv`: the two validator-axis reductions
+    (weight delta and round-skip weight) become `psum`s over the axis —
+    quorum aggregation rides the ICI, everything else stays local
+    (SURVEY.md §2.7 "validator-axis data parallelism")."""
     I_, W, _, S1 = tally.weights.shape
-    V = powers.shape[0]
 
-    # --- gather this phase's (round, class) rows
-    onehot_w = (jnp.arange(W)[None, :] == round_idx[:, None])        # [I, W]
-    onehot_t = (jnp.arange(2)[None, :] == typ[:, None])              # [I, 2]
-    sel_wt = onehot_w[:, :, None] & onehot_t[:, None, :]             # [I, W, 2]
-
-    # one-hot gather of the selected row; records are shifted by +3 so a
-    # real value (>= NOT_VOTED = -2) is never confused with the zeroed
-    # non-selected rows
-    voted_row = jnp.sum(
-        jnp.where(sel_wt[:, :, :, None], tally.voted + 3, 0), axis=(1, 2)
-    ) - 3                                                            # [I, V]
+    # --- gather this phase's (round, class) rows; votes for rounds
+    # outside the tracked window [0, W) are dropped entirely (the host
+    # driver rotates the window / handles far-future rounds) — they must
+    # not tally, fire events, or flag equivocation
+    in_window = (round_idx >= 0) & (round_idx < W)                   # [I]
+    # invalid slots (outside [VOTED_NIL, S)) are dropped too — clipping
+    # them into a real bucket would manufacture a quorum for a value
+    # nobody voted for, which arm 14 would commit unconditionally
+    valid_slot = (slots >= VOTED_NIL) & (slots < S1 - 1)             # [I, V]
+    mask = mask & in_window[:, None] & valid_slot
+    sel_wt = _sel_wt(W, round_idx, typ)                              # [I, W, 2]
+    voted_row = _gather_row(tally.voted, sel_wt, fill=NOT_VOTED)     # [I, V]
 
     # --- dedup + equivocation (SURVEY.md §2.3 fix 2)
     fresh = mask & (voted_row == NOT_VOTED)
@@ -178,18 +229,19 @@ def add_votes(tally: TallyState,
     onehot_s = (jnp.arange(S1)[None, None, :] == col[:, :, None])    # [I, V, S1]
     contrib = jnp.where(fresh, powers[None, :], 0).astype(I32)       # [I, V]
     delta = jnp.einsum("ivs,iv->is", onehot_s.astype(I32), contrib)  # [I, S1]
+    if axis_name is not None:
+        delta = jax.lax.psum(delta, axis_name)
 
-    weights_row = jnp.sum(
-        jnp.where(sel_wt[:, :, :, None], tally.weights, 0), axis=(1, 2))
+    weights_row = _gather_row(tally.weights, sel_wt)
     weights_row_new = weights_row + delta
 
     # --- threshold detection + edge-triggered event
     code, vslot = _thresh_code(weights_row_new, total_power)
-    emitted_row = jnp.sum(jnp.where(sel_wt, tally.emitted, 0), axis=(1, 2))
+    emitted_row = _gather_row(tally.emitted, sel_wt)
     # fire only when the code rises AND maps to a different event: the
     # precommit class maps both ANY and NIL codes to PRECOMMIT_ANY, which
     # must fire at most once per round (spec line 47 "for the first time")
-    rising = ((code > emitted_row)
+    rising = (in_window & (code > emitted_row)
               & (_EVENT_TABLE[typ, code] != _EVENT_TABLE[typ, emitted_row]))
     tag = jnp.where(rising, _EVENT_TABLE[typ, code], NO_EVENT).astype(I32)
     value_slot = jnp.where(tag >= 0, vslot, -1).astype(I32)
@@ -205,11 +257,18 @@ def add_votes(tally: TallyState,
 
     # --- RoundSkip: +1/3 of distinct-voter weight on a round above the
     # instance's current one (state_machine.rs:106; detection absent in
-    # the reference).  Weight per round from the voted record, one vote
-    # per validator regardless of class.
-    seen_any = jnp.any(voted != NOT_VOTED, axis=2)                   # [I, W, V]
-    w_skip = jnp.einsum("iwv,v->iw", seen_any.astype(I32),
-                        powers.astype(I32))                          # [I, W]
+    # the reference).  One vote per validator regardless of class;
+    # maintained incrementally: a fresh vote adds its power iff the
+    # validator was unseen in the round's OTHER class too (the phase's
+    # own class dedup is already `fresh`).
+    sel_other = _sel_wt(W, round_idx, 1 - typ)
+    other_row = _gather_row(tally.voted, sel_other, fill=NOT_VOTED)  # [I, V]
+    new_voter = fresh & (other_row == NOT_VOTED)
+    dskip = jnp.sum(jnp.where(new_voter, powers[None, :], 0), axis=1)  # [I]
+    if axis_name is not None:
+        dskip = jax.lax.psum(dskip, axis_name)
+    onehot_r = (jnp.arange(W)[None, :] == round_idx[:, None])        # [I, W]
+    w_skip = tally.skip_w + jnp.where(onehot_r, dskip[:, None], 0)
     eligible = ((3 * w_skip > total_power)
                 & (jnp.arange(W)[None, :] > cur_round[:, None])
                 & ~tally.skipped)                                    # [I, W]
@@ -220,8 +279,8 @@ def add_votes(tally: TallyState,
         -1)
     skipped = tally.skipped | (jnp.arange(W)[None, :] == skip_round[:, None])
 
-    new_tally = TallyState(weights=weights, voted=voted, emitted=emitted,
-                           skipped=skipped, equiv=equiv)
+    new_tally = tally._replace(weights=weights, voted=voted, emitted=emitted,
+                               skipped=skipped, equiv=equiv, skip_w=w_skip)
     events = TallyEvents(tag=tag, value_slot=value_slot,
                          round=round_idx.astype(I32), skip_round=skip_round)
     return new_tally, events
@@ -232,13 +291,11 @@ def current_threshold(tally: TallyState, round_idx: jnp.ndarray,
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(code, value_slot) currently reached at [I] (round, class) — the
     re-query path for consumers that advanced step/round after an edge
-    was consumed (mirrors core.vote_executor.threshold_events)."""
+    was consumed (mirrors core.vote_executor.threshold_events).
+    Out-of-window rounds read as empty (code TH_INIT)."""
     W = tally.weights.shape[1]
-    onehot_w = (jnp.arange(W)[None, :] == round_idx[:, None])
-    onehot_t = (jnp.arange(2)[None, :] == typ[:, None])
-    sel_wt = onehot_w[:, :, None] & onehot_t[:, None, :]
-    weights_row = jnp.sum(
-        jnp.where(sel_wt[:, :, :, None], tally.weights, 0), axis=(1, 2))
+    sel_wt = _sel_wt(W, round_idx, typ)
+    weights_row = _gather_row(tally.weights, sel_wt)
     return _thresh_code(weights_row, total_power)
 
 

@@ -1,0 +1,21 @@
+"""Mesh/sharding layer: instance-DP x validator-TP over XLA collectives.
+
+The reference has no parallelism or communication backend of any kind
+(SURVEY.md §2.7 — zero deps, single synchronous call chain); these are
+new first-class components.  The two scaling axes of a consensus fleet
+are *instances* (independent (height, round) machines — embarrassingly
+parallel, sharded as data parallelism) and *validators* (the tally /
+signature axis — sharded as tensor parallelism whose quorum reductions
+are `psum`s over the mesh axis, riding ICI intra-slice and DCN across
+slices).
+"""
+
+from agnes_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    VAL_AXIS,
+    make_mesh,
+)
+from agnes_tpu.parallel.sharded import (  # noqa: F401
+    make_sharded_step,
+    shard_step_args,
+)

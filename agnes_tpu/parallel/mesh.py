@@ -1,0 +1,37 @@
+"""Device mesh construction for the consensus data plane.
+
+Axes:
+  * ``data`` — instance axis: independent consensus instances, no
+    cross-talk, pure data parallelism.
+  * ``val``  — validator axis: the vote tally's reduction axis; partial
+    tallies are combined with `psum` (SURVEY.md §2.3 "TPU mapping").
+
+On a real slice, lay ``val`` on the innermost (fastest-ICI) mesh dim —
+it carries the per-phase quorum psums; ``data`` shards never
+communicate, so they can span DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+VAL_AXIS = "val"
+
+
+def make_mesh(n_data: int, n_val: int,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A (data=n_data, val=n_val) mesh over the given (default: all)
+    devices."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_data * n_val
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {n_data}x{n_val} needs {need} devices, have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_data, n_val)
+    return Mesh(grid, (DATA_AXIS, VAL_AXIS))

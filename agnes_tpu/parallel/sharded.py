@@ -1,0 +1,103 @@
+"""shard_map-wrapped consensus step: dp over instances, tp over validators.
+
+Sharding layout (I = instances, V = validators, W = rounds, S = slots):
+
+  =================  ==================  =========================
+  array              shape               PartitionSpec
+  =================  ==================  =========================
+  DeviceState.*      [I]                 (data,)
+  tally.weights      [I, W, 2, S+1]      (data,)        replicated over val
+  tally.voted        [I, W, 2, V]        (data,,,val)   the per-validator record
+  tally.emitted      [I, W, 2]           (data,)
+  tally.skipped      [I, W]              (data,)
+  tally.equiv        [I, V]              (data, val)
+  ExtEvent.*         [I]                 (data,)
+  phase.round/typ    [I]                 (data,)
+  phase.slots/mask   [I, V]              (data, val)
+  powers             [V]                 (val,)
+  total_power        []                  ()
+  proposer_flag      [I, W]              (data,)
+  propose_value      [I]                 (data,)
+  msgs out           [n_stages, I]       (None, data)
+  =================  ==================  =========================
+
+Only the tally's two validator reductions communicate (psum over
+``val``, see device/tally.py); the state machine replicates over the
+val axis — its per-instance state is a handful of ints, so replicating
+beats communicating.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from agnes_tpu.device.step import (
+    ExtEvent,
+    StepOutputs,
+    VotePhase,
+    consensus_step,
+)
+from agnes_tpu.device.tally import TallyState
+from agnes_tpu.parallel.mesh import DATA_AXIS, VAL_AXIS
+
+_DATA = P(DATA_AXIS)
+_SCALAR = P()
+
+_STATE_SPEC_LEAF = _DATA
+_TALLY_SPEC = TallyState(
+    weights=_DATA,
+    voted=P(DATA_AXIS, None, None, VAL_AXIS),
+    emitted=_DATA,
+    skipped=_DATA,
+    equiv=P(DATA_AXIS, VAL_AXIS),
+    q_round=_DATA,
+    q_step=_DATA,
+    pc_done=_DATA,
+    skip_w=_DATA,
+)
+_EXT_SPEC = ExtEvent(tag=_DATA, round=_DATA, value=_DATA, pol_round=_DATA)
+_PHASE_SPEC = VotePhase(round=_DATA, typ=_DATA,
+                        slots=P(DATA_AXIS, VAL_AXIS),
+                        mask=P(DATA_AXIS, VAL_AXIS))
+
+
+def _state_spec():
+    from agnes_tpu.device.encoding import DeviceState
+
+    return DeviceState(*([_STATE_SPEC_LEAF] * len(DeviceState._fields)))
+
+
+def _in_specs():
+    """One source of truth for the step's argument shardings — used both
+    by shard_map and by shard_step_args placement, so they cannot
+    silently disagree."""
+    return (_state_spec(), _TALLY_SPEC, _EXT_SPEC, _PHASE_SPEC,
+            P(VAL_AXIS), _SCALAR, _DATA, _DATA)
+
+
+def make_sharded_step(mesh: Mesh):
+    """A jitted consensus_step sharded over `mesh`; call with arrays
+    already placed by `shard_step_args` (or let jit reshard)."""
+    out_specs = StepOutputs(state=_state_spec(), tally=_TALLY_SPEC,
+                            msgs=P(None, DATA_AXIS))
+    fn = jax.shard_map(
+        partial(consensus_step, axis_name=VAL_AXIS),
+        mesh=mesh, in_specs=_in_specs(), out_specs=out_specs,
+        check_vma=False)
+    return jax.jit(fn)
+
+
+def shard_step_args(mesh: Mesh, state, tally, ext, phase, powers,
+                    total_power, proposer_flag, propose_value):
+    """Place the step arguments on the mesh per the layout table."""
+    args = (state, tally, ext, phase, powers, total_power,
+            proposer_flag, propose_value)
+    return tuple(
+        jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            a, spec, is_leaf=lambda x: x is None)
+        for a, spec in zip(args, _in_specs()))

@@ -1,0 +1,77 @@
+"""Flagship benchmark: fused verify+tally+step throughput on one chip.
+
+Drives the BASELINE config-4 shape — thousands of parallel instances,
+1000-validator tally — through the fused 7-stage consensus step and
+reports votes ingested (deduped, tallied, threshold-checked, state-
+machine-applied) per second.  vs_baseline is measured against the
+north-star 1M votes/sec/chip target from BASELINE.json (the reference
+itself publishes no numbers — SURVEY.md §6).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from agnes_tpu.device.encoding import DeviceState
+from agnes_tpu.device.step import ExtEvent, VotePhase, consensus_step_jit
+from agnes_tpu.device.tally import TallyConfig, TallyState
+from agnes_tpu.types import VoteType
+
+NORTH_STAR = 1_000_000  # votes/sec/chip (BASELINE.json north_star)
+
+
+def bench(n_instances: int = 4096, n_validators: int = 1024,
+          iters: int = 20) -> dict:
+    I, V = n_instances, n_validators
+    cfg = TallyConfig(n_validators=V, n_rounds=4, n_slots=4)
+
+    state = DeviceState.new((I,))
+    tally = TallyState.new(I, cfg)
+    ext = ExtEvent.none(I)
+    powers = jnp.ones((V,), jnp.int32)
+    total = jnp.asarray(V, jnp.int32)
+    proposer_flag = jnp.ones((I, cfg.n_rounds), bool)
+    propose_value = jnp.full(I, 1, jnp.int32)
+
+    voters = jnp.ones((V,), bool)
+    phase = VotePhase(
+        round=jnp.zeros(I, jnp.int32),
+        typ=jnp.full(I, int(VoteType.PREVOTE), jnp.int32),
+        slots=jnp.ones((I, V), jnp.int32),
+        mask=jnp.broadcast_to(voters[None, :], (I, V)),
+    )
+
+    def step(state, tally):
+        return consensus_step_jit(state, tally, ext, phase, powers, total,
+                                  proposer_flag, propose_value)
+
+    # warmup + compile
+    s, t, _ = step(state, tally)
+    jax.block_until_ready(s)
+
+    t0 = time.perf_counter()
+    s, t = state, tally
+    for _ in range(iters):
+        s, t, _ = step(s, t)
+    jax.block_until_ready(s)
+    dt = time.perf_counter() - t0
+
+    votes_per_iter = I * V
+    votes_per_sec = votes_per_iter * iters / dt
+    return {
+        "metric": "fused_tally_step_votes_per_sec",
+        "value": round(votes_per_sec),
+        "unit": "votes/sec/chip",
+        "vs_baseline": round(votes_per_sec / NORTH_STAR, 3),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench()))

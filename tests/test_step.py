@@ -120,15 +120,21 @@ def test_non_proposer_times_out_to_nil_and_skips_round():
     assert (m["aux"] == int(VoteType.PRECOMMIT)).all()
     assert (m["value"] == -1).all()
 
-    # everyone precommits nil: no event (vote_executor.rs:33), but
-    # PrecommitAny requery (stage 4) schedules timeout precommit
+    # everyone precommits nil: no value event (vote_executor.rs:33), but
+    # the PrecommitAny edge (stage 1) schedules timeout precommit; the
+    # requery stages stay silent — the state hasn't moved since (spec
+    # line 47 "for the first time")
     state, tally, msgs = _step(
         state, tally,
         phase=_phase(0, VoteType.PRECOMMIT, {0: -1, 1: -1, 2: -1}),
         proposer=False)
-    m = _msgs_at(msgs, 4)
+    m = _msgs_at(msgs, 1)
     assert (m["tag"] == int(MsgTag.TIMEOUT)).all()
     assert (m["aux"] == int(TimeoutStep.PRECOMMIT)).all()
+    # a further idle step re-emits nothing
+    state, tally, msgs = _step(state, tally, proposer=False)
+    all_msgs = np.asarray(msgs.tag)
+    assert (all_msgs == int(MsgTag.NONE)).all()
 
     # timeout precommit -> round 1, re-entry as non-proposer
     ext = ExtEvent(jnp.full(I, int(EventTag.TIMEOUT_PRECOMMIT), jnp.int32),
@@ -185,3 +191,61 @@ def test_missed_edge_recovered_by_requery():
     assert (m["tag"] == int(MsgTag.VOTE)).all()
     assert (m["aux"] == int(VoteType.PRECOMMIT)).all()
     assert (m["value"] == VAL).all()
+
+
+def test_exactly_one_timeout_precommit_per_round():
+    """A standing precommit quorum must schedule TimeoutPrecommit exactly
+    once per round, however many step changes follow (spec line 47 "for
+    the first time"; regression: the requery stages used to re-schedule
+    it on every intra-round state change)."""
+    state = DeviceState.new((I,))
+    tally = TallyState.new(I, CFG)
+    state, tally, _ = _step(state, tally, proposer=False)  # Propose step
+
+    n_tp = np.zeros(I, int)
+
+    def count(msgs):
+        m = np.asarray(msgs.tag) == int(MsgTag.TIMEOUT)
+        a = np.asarray(msgs.aux) == int(TimeoutStep.PRECOMMIT)
+        return (m & a).sum(axis=0)
+
+    # precommit-nil quorum lands while still in Propose
+    state, tally, msgs = _step(
+        state, tally,
+        phase=_phase(0, VoteType.PRECOMMIT, {0: -1, 1: -1, 2: -1}),
+        proposer=False)
+    n_tp += count(msgs)
+
+    # proposal arrives (Propose->Prevote), then a nil polka
+    # (Prevote->Precommit), then idle steps: no re-schedules
+    ext = ExtEvent(jnp.full(I, int(EventTag.PROPOSAL), jnp.int32),
+                   jnp.zeros(I, jnp.int32), jnp.full(I, VAL, jnp.int32),
+                   jnp.full(I, -1, jnp.int32))
+    state, tally, msgs = _step(state, tally, ext=ext, proposer=False)
+    n_tp += count(msgs)
+    state, tally, msgs = _step(
+        state, tally, phase=_phase(0, VoteType.PREVOTE, {0: -1, 1: -1, 2: -1}),
+        proposer=False)
+    n_tp += count(msgs)
+    for _ in range(3):
+        state, tally, msgs = _step(state, tally, proposer=False)
+        n_tp += count(msgs)
+
+    assert (n_tp == 1).all(), n_tp
+
+    # the NEXT round gets its own (single) schedule
+    ext = ExtEvent(jnp.full(I, int(EventTag.TIMEOUT_PRECOMMIT), jnp.int32),
+                   jnp.zeros(I, jnp.int32), jnp.zeros(I, jnp.int32),
+                   jnp.full(I, -1, jnp.int32))
+    state, tally, msgs = _step(state, tally, ext=ext, proposer=False)
+    assert (np.asarray(state.round) == 1).all()
+    n_tp2 = count(msgs)
+    state, tally, msgs = _step(
+        state, tally,
+        phase=_phase(1, VoteType.PRECOMMIT, {0: -1, 1: -1, 2: -1}),
+        proposer=False)
+    n_tp2 += count(msgs)
+    for _ in range(2):
+        state, tally, msgs = _step(state, tally, proposer=False)
+        n_tp2 += count(msgs)
+    assert (n_tp2 == 1).all(), n_tp2

@@ -214,3 +214,47 @@ def test_device_precommit_any_fires_once_across_any_then_nil():
     # nil now 110 > 100: code rises to NIL, event is the same -> silent
     t, ev = ph(t, {3: -1})
     assert int(ev.tag[0]) == NO_EVENT
+
+
+def test_out_of_window_round_is_dropped_entirely():
+    """Votes for a round outside the tracked window [0, W) must not
+    tally, fire events, or flag honest validators as equivocators
+    (regression: the all-false row-selector used to read garbage that
+    pattern-matched as a conflicting prior vote)."""
+    cfg = TallyConfig(n_validators=4, n_rounds=4, n_slots=2)
+    powers = jnp.ones((4,), jnp.int32)
+    total = jnp.asarray(4, jnp.int32)
+    t0 = TallyState.new(1, cfg)
+
+    slots = np.full((1, 4), 1, np.int32)
+    mask = np.ones((1, 4), bool)
+    for bad_round in (5, -1, 4):
+        t, ev = add_votes_jit(t0, powers, total,
+                              jnp.full(1, bad_round, jnp.int32),
+                              jnp.zeros(1, jnp.int32), jnp.asarray(slots),
+                              jnp.asarray(mask), jnp.zeros(1, jnp.int32))
+        assert not np.asarray(t.equiv).any(), bad_round
+        assert (np.asarray(t.weights) == 0).all(), bad_round
+        assert (np.asarray(t.voted) == -2).all(), bad_round
+        assert int(ev.tag[0]) == NO_EVENT, bad_round
+        assert int(ev.skip_round[0]) == -1, bad_round
+
+
+def test_invalid_slot_votes_are_dropped():
+    """Votes carrying a slot outside [-1, S) must not tally — clipping
+    them into a real bucket would manufacture a quorum for a value
+    nobody voted for, which the commit arm would decide on
+    (regression)."""
+    cfg = TallyConfig(n_validators=4, n_rounds=2, n_slots=2)
+    powers = jnp.ones((4,), jnp.int32)
+    total = jnp.asarray(4, jnp.int32)
+    t = TallyState.new(1, cfg)
+
+    for bad in (5, 2, -2, -7):
+        slots = np.full((1, 4), bad, np.int32)
+        mask = np.ones((1, 4), bool)
+        t2, ev = add_votes_jit(t, powers, total, jnp.zeros(1, jnp.int32),
+                               jnp.zeros(1, jnp.int32), jnp.asarray(slots),
+                               jnp.asarray(mask), jnp.zeros(1, jnp.int32))
+        assert (np.asarray(t2.weights) == 0).all(), bad
+        assert int(ev.tag[0]) == NO_EVENT, bad
