@@ -1,0 +1,26 @@
+"""Ed25519 for the vote path.
+
+The reference stubs all signing ("sign the vote", consensus_executor.rs:
+35-41) and carries no signatures on `Vote` at all (lib.rs:23-27 — SURVEY
+§2.1 "notably absent").  This package supplies the full signature
+surface the build adds:
+
+  ed25519_ref   pure-Python RFC 8032 implementation — the oracle every
+                other implementation (C++ host, JAX batched) is
+                differential-tested against.
+  ed25519_jax   batched verification in JAX: packed-limb field
+                arithmetic, vmapped double-scalar multiplication.
+  sha512_jax    SHA-512 on device (uint32-pair word arithmetic) for the
+                H(R || A || M) challenge hash.
+"""
+
+from agnes_tpu.crypto.ed25519_ref import (  # noqa: F401
+    keypair,
+    sign,
+    verify,
+)
+from agnes_tpu.crypto.encoding import (  # noqa: F401
+    VOTE_MSG_LEN,
+    proposal_signing_bytes,
+    vote_signing_bytes,
+)

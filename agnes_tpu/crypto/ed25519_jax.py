@@ -1,0 +1,211 @@
+"""Batched Ed25519 verification in JAX — the north-star data plane.
+
+Verifies [S]B == R + [k]A (equivalently Q := [S]B + [k](-A) == R) for a
+whole batch of signatures at once:
+
+  - curve arithmetic on `field_jax` 13-bit int32 limbs, extended
+    twisted-Edwards coordinates with the complete unified addition law
+    (a = -1 is square mod p, d is not, so the formula has no special
+    cases — no data-dependent branches anywhere);
+  - the double-scalar multiplication is one `lax.scan` over 260
+    MSB-first bit pairs (Straus/Shamir: shared doubling, one table add
+    from {identity, B, -A, B - A} per step — adding the identity is
+    fine under the complete law, keeping the select branch-free);
+  - k = SHA-512(R || A || M) via `sha512_jax`, reduced by
+    `scalar_jax.barrett_reduce`;
+  - R is never decompressed: Q is compressed and byte-compared against
+    the signature's R, which also enforces canonical R encoding.
+
+Checks applied per RFC 8032 §5.1.7: A decodes to a curve point,
+S < L, and the (cofactorless) group equation.  Oracle:
+`ed25519_ref.verify`, pinned to the RFC vectors.
+
+The reference engine verifies nothing (vote identity/signatures are
+"notably absent", SURVEY.md §2.1); this kernel is the added surface
+that BASELINE.json's >= 1M verifies/sec north star measures.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from agnes_tpu.crypto import ed25519_ref as ref
+from agnes_tpu.crypto import field_jax as F
+from agnes_tpu.crypto import scalar_jax as S
+from agnes_tpu.crypto import sha512_jax as sha
+
+I32 = F.I32
+
+# --- curve constants as limb arrays ----------------------------------------
+P = F.P
+D_LIMBS = F.to_limbs(ref.D)
+D2_LIMBS = F.to_limbs(2 * ref.D % P)
+SQRT_M1_LIMBS = F.to_limbs(ref.SQRT_M1)
+P_LIMBS = F.to_limbs(P)
+_BX, _BY = ref.BASE[0], ref.BASE[1]
+BX_LIMBS = F.to_limbs(_BX)
+BY_LIMBS = F.to_limbs(_BY)
+BT_LIMBS = F.to_limbs(_BX * _BY % P)
+
+
+class Point(NamedTuple):
+    """Extended homogeneous coordinates; each field [..., 20] limbs."""
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+def identity(shape: Tuple[int, ...]) -> Point:
+    zero = jnp.zeros(shape + (F.NLIMBS,), I32)
+    one = zero.at[..., 0].set(1)
+    return Point(zero, one, one, zero)
+
+
+def base_point(shape: Tuple[int, ...]) -> Point:
+    return Point(
+        jnp.broadcast_to(BX_LIMBS, shape + (F.NLIMBS,)),
+        jnp.broadcast_to(BY_LIMBS, shape + (F.NLIMBS,)),
+        identity(shape).y,
+        jnp.broadcast_to(BT_LIMBS, shape + (F.NLIMBS,)),
+    )
+
+
+def point_add(p: Point, q: Point) -> Point:
+    """Unified a=-1 twisted Edwards addition (complete; 9 muls)."""
+    a = F.mul(F.sub(p.y, p.x), F.sub(q.y, q.x))
+    b = F.mul(F.add(p.y, p.x), F.add(q.y, q.x))
+    c = F.mul(F.mul(p.t, q.t), jnp.broadcast_to(D2_LIMBS, p.t.shape))
+    d = F.carry(2 * F.mul(p.z, q.z))
+    e, f = F.sub(b, a), F.sub(d, c)
+    g, h = F.add(d, c), F.add(b, a)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def point_neg(p: Point) -> Point:
+    zero = jnp.zeros_like(p.x)
+    return Point(F.sub(zero, p.x), p.y, p.z, F.sub(zero, p.t))
+
+
+def point_equal(p: Point, q: Point) -> jnp.ndarray:
+    """Projective equality: x1 z2 == x2 z1 and y1 z2 == y2 z1."""
+    return (F.eq_mod_p(F.mul(p.x, q.z), F.mul(q.x, p.z))
+            & F.eq_mod_p(F.mul(p.y, q.z), F.mul(q.y, p.z)))
+
+
+def decompress(ybytes: jnp.ndarray) -> Tuple[Point, jnp.ndarray]:
+    """[..., 32] little-endian encoded points -> (Point, ok).
+
+    ok is False for non-canonical y (>= p), non-residue x^2, or the
+    x = 0 / sign = 1 combination; coordinates are garbage when not ok
+    (callers fold `ok` into the validity verdict — branch-free)."""
+    b = ybytes.astype(I32)
+    sign = b[..., 31] >> 7
+    b = b.at[..., 31].set(b[..., 31] & 0x7F)
+    y = F.bytes32_to_limbs(b)
+    ok = ~F._geq(y, P_LIMBS)
+
+    one = jnp.zeros_like(y).at[..., 0].set(1)
+    y2 = F.sqr(y)
+    u = F.sub(y2, one)
+    v = F.add(F.mul(y2, jnp.broadcast_to(D_LIMBS, y.shape)), one)
+    v3 = F.mul(v, F.sqr(v))
+    v7 = F.mul(v3, F.mul(v3, v))
+    x = F.mul(F.mul(u, v3), F.pow_p(F.mul(u, v7), (P - 5) // 8))
+
+    vx2 = F.mul(v, F.sqr(x))
+    neg_u = F.sub(jnp.zeros_like(u), u)
+    root_direct = F.eq_mod_p(vx2, u)
+    root_flip = F.eq_mod_p(vx2, neg_u)
+    x = jnp.where(root_flip[..., None],
+                  F.mul(x, jnp.broadcast_to(SQRT_M1_LIMBS, x.shape)), x)
+    ok &= root_direct | root_flip
+
+    xf = F.freeze(x)
+    x_is_zero = jnp.all(xf == 0, axis=-1)
+    flip_sign = (xf[..., 0] & 1) != sign
+    x = jnp.where(flip_sign[..., None], F.sub(jnp.zeros_like(xf), xf), xf)
+    ok &= ~(x_is_zero & (sign == 1))
+    return Point(x, y, one, F.mul(x, y)), ok
+
+
+def compress(p: Point) -> jnp.ndarray:
+    """Point -> [..., 32] canonical little-endian bytes (int32 0..255)."""
+    zi = F.inv(p.z)
+    x = F.freeze(F.mul(p.x, zi))
+    y = F.freeze(F.mul(p.y, zi))
+    out = F.limbs_to_bytes32(y)
+    return out.at[..., 31].set(out[..., 31] | ((x[..., 0] & 1) << 7))
+
+
+def straus_sub(s: jnp.ndarray, k: jnp.ndarray, a_point: Point) -> Point:
+    """[s]B - [k]A by Shamir's trick: one scan over 260 shared-doubling
+    steps, each adding one of {identity, B, -A, B-A} (branch-free
+    4-way select; identity-adds are valid under the complete law)."""
+    shape = s.shape[:-1]
+    na = point_neg(a_point)
+    b = base_point(shape)
+    bma = point_add(b, na)
+    idn = identity(shape)
+
+    # stacked table [4, ..., 20] per coordinate, indexed by bs*1 + bk*2
+    table = jax.tree.map(lambda *xs: jnp.stack(xs), idn, b, na, bma)
+    sbits = S.bits_msb_first(s)          # [260, ...]
+    kbits = S.bits_msb_first(k)
+
+    def body(acc: Point, bits):
+        bs, bk = bits
+        sel = bs.astype(I32) + 2 * bk.astype(I32)     # [...]
+        acc = point_add(acc, acc)
+        onehot = (jnp.arange(4) == sel[..., None])    # [..., 4]
+        pick = jax.tree.map(
+            lambda tbl: jnp.sum(
+                jnp.where(jnp.moveaxis(onehot, -1, 0)[..., None],
+                          tbl, 0), axis=0),
+            table)
+        return point_add(acc, Point(*pick)), None
+
+    acc, _ = jax.lax.scan(body, idn, (sbits, kbits))
+    return acc
+
+
+def verify_batch(pub: jnp.ndarray, sig: jnp.ndarray,
+                 msg_blocks: jnp.ndarray) -> jnp.ndarray:
+    """Batch verify.  pub [B, 32] bytes, sig [B, 64] bytes, msg_blocks
+    [B, n_blocks, 32] uint32 — pre-padded SHA-512 blocks of
+    R || A || M (see sha512_jax.pack_padded_host / the bridge packer).
+    Returns [B] bool."""
+    a_point, ok_a = decompress(pub)
+    s = S.scalar_from_bytes32(sig[..., 32:])
+    ok_s = S.is_canonical(s)
+    k = S.barrett_reduce(S.digest_to_limbs(sha.sha512_blocks(msg_blocks)))
+    q = straus_sub(s, k, a_point)
+    q_bytes = compress(q)
+    ok_eq = jnp.all(q_bytes == sig[..., :32].astype(I32), axis=-1)
+    return ok_a & ok_s & ok_eq
+
+
+verify_batch_jit = jax.jit(verify_batch)
+
+
+def pack_verify_inputs_host(pubs, msgs, sigs):
+    """Host packer for tests/benchmarks: lists of (32B pub, bytes msg,
+    64B sig) -> (pub [B,32] i32, sig [B,64] i32, blocks [B,n,32] u32).
+    All messages must have equal length (fixed-layout vote encoding,
+    crypto.encoding)."""
+    import numpy as np
+
+    if not pubs:
+        return (jnp.zeros((0, 32), I32), jnp.zeros((0, 64), I32),
+                jnp.zeros((0, 1, 32), jnp.uint32))
+    pub_arr = jnp.asarray(
+        np.stack([np.frombuffer(p, np.uint8) for p in pubs]), I32)
+    sig_arr = jnp.asarray(
+        np.stack([np.frombuffer(sg, np.uint8) for sg in sigs]), I32)
+    blocks = sha.pack_padded_host(
+        [sg[:32] + p + m for p, m, sg in zip(pubs, msgs, sigs)])
+    return pub_arr, sig_arr, blocks

@@ -1,0 +1,51 @@
+"""Canonical signing byte layouts — the single source of truth.
+
+The reference carries no identity or signatures on votes (`Vote` lacks
+height/validator/signature, reference lib.rs:23-27; SURVEY.md §2.1), so
+this wire layout is new surface.  It is deliberately *fixed-width and
+short*: a 45-byte vote message means R(32) || A(32) || M(45) = 109
+bytes pads to exactly ONE SHA-512 block (limit 111), so batched
+verification costs a single compression per signature
+(sha512_jax.pad_message).
+
+Every signer/verifier/packer must go through these functions — the
+pure-Python signer (harness fixtures), the JAX batch verifier's host
+packer, the C++ host core, and the device-side bridge packer all agree
+on bytes by construction.
+
+Layout (little-endian integers):
+
+  vote:     type(1) | height(8) | round(4) | value(32)      = 45 bytes
+  proposal: 0xP0(1) | height(8) | round(4) | pol_round(4)
+            | value(32)                                     = 49 bytes
+"""
+
+from __future__ import annotations
+
+VOTE_MSG_LEN = 45
+PROPOSAL_MSG_LEN = 49
+PROPOSAL_TAG = 0x50
+
+# nil votes sign value 0; real value ids are hashes/nonzero ids.  The
+# distinction lives in the vote's value field, not the signing bytes.
+NIL_WIRE = 0
+
+
+def vote_signing_bytes(height: int, round: int, typ: int,
+                       value: int | None) -> bytes:
+    """Canonical 45-byte vote message (None value = nil -> 0)."""
+    v = NIL_WIRE if value is None else int(value)
+    return (bytes([int(typ)])
+            + int(height).to_bytes(8, "little")
+            + int(round).to_bytes(4, "little", signed=True)
+            + v.to_bytes(32, "little"))
+
+
+def proposal_signing_bytes(height: int, round: int, pol_round: int,
+                           value: int) -> bytes:
+    """Canonical 49-byte proposal message."""
+    return (bytes([PROPOSAL_TAG])
+            + int(height).to_bytes(8, "little")
+            + int(round).to_bytes(4, "little", signed=True)
+            + int(pol_round).to_bytes(4, "little", signed=True)
+            + int(value).to_bytes(32, "little"))

@@ -1,0 +1,269 @@
+"""GF(2^255 - 19) limb arithmetic in JAX, int32-only.
+
+Representation chosen for the TPU's 32-bit vector unit: 20 little-endian
+limbs of 13 bits (radix 2^13, 260 bits of headroom).  The bounds work
+out so that *no intermediate ever leaves int32*:
+
+  - schoolbook product terms: (2^13-1)^2 < 2^26
+  - a product column sums at most 20 terms: < 20 * 2^26 < 2^31
+  - the high product half is carry-normalized to 13-bit limbs *before*
+    the mod-p fold, so the fold multiplier 608 = 19 * 2^5 (from
+    2^260 = 2^5 * 2^255 = 32 * 2^255 === 32*19 mod p) stays < 2^23.
+
+Elements are kept *partially reduced* — limbs < 2^13, value < 2^260,
+possibly >= p — through all arithmetic; `freeze` produces the canonical
+value only for compares/encodings.  Subtraction adds 64p (spread across
+limbs so every limb of the constant is >= 6976) before the carry chain,
+which keeps totals positive for any pair of partially-reduced inputs;
+signed int32 carries (arithmetic shift) absorb the per-limb slack.
+
+The batch axis is leading and everything is elementwise or a contraction
+against small constant matrices, so `jit(vmap(...))` vectorizes cleanly;
+the column sums of `mul` are a [.., 400] x [400, 39] constant matmul XLA
+can put on the MXU.
+
+Oracle: `ed25519_ref` (plain Python ints); see tests/test_field_jax.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+BITS = 13
+RADIX = 1 << BITS          # 8192
+LMASK = RADIX - 1
+NLIMBS = 20                # 260 bits
+P = 2**255 - 19
+FOLD = 608                 # 2^260 mod p = 32 * 19
+
+# 64p = 2^261 - 1216, spread so every limb is a valid 13-bit-ish positive
+# constant: limb0 = 8192-1216, limbs 1..18 = 8191, limb19 = 2^14 - 1.
+_SUB_K = np.full(NLIMBS, LMASK, np.int32)
+_SUB_K[0] = RADIX - 1216
+_SUB_K[NLIMBS - 1] = (1 << 14) - 1
+SUB_K = jnp.asarray(_SUB_K)
+assert sum(int(_SUB_K[i]) << (BITS * i) for i in range(NLIMBS)) == 64 * P
+
+# column-sum matrix: flat outer-product index (i*NLIMBS+j) -> column i+j
+_COLS = 2 * NLIMBS - 1
+_M = np.zeros((NLIMBS * NLIMBS, _COLS), np.int32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _M[_i * NLIMBS + _j, _i + _j] = 1
+COLSUM = jnp.asarray(_M)
+
+
+# --- host <-> limb conversion ----------------------------------------------
+
+def to_limbs(x: int) -> jnp.ndarray:
+    """Python int -> [NLIMBS] int32 (host helper)."""
+    return jnp.asarray([(x >> (BITS * i)) & LMASK for i in range(NLIMBS)],
+                       I32)
+
+
+def from_limbs(a) -> int:
+    """[NLIMBS] limbs -> Python int (host helper; no mod-p)."""
+    arr = np.asarray(a, np.int64)
+    return sum(int(arr[..., i]) << (BITS * i) for i in range(NLIMBS))
+
+
+def bytes_to_limbs(b: jnp.ndarray, n_limbs: int) -> jnp.ndarray:
+    """[..., n_bytes] uint8/int32 little-endian bytes -> [..., n_limbs]
+    13-bit limbs.  Pure bit-slicing, works under jit: limb i covers bits
+    [13i, 13i+13), i.e. 2-3 consecutive bytes."""
+    n_bytes = b.shape[-1]
+    b = b.astype(I32)
+    out = []
+    for i in range(n_limbs):
+        lo_bit = BITS * i
+        byte0, off = lo_bit // 8, lo_bit % 8
+        v = b[..., byte0] >> off
+        got = 8 - off
+        k = 1
+        while got < BITS:
+            if byte0 + k < n_bytes:
+                v = v | (b[..., byte0 + k] << got)
+            got += 8
+            k += 1
+        out.append(v & LMASK)
+    return jnp.stack(out, axis=-1)
+
+
+def bytes32_to_limbs(b: jnp.ndarray) -> jnp.ndarray:
+    """[..., 32] little-endian bytes -> [..., NLIMBS] field limbs."""
+    return bytes_to_limbs(b, NLIMBS)
+
+
+def limbs_to_bytes32(a: jnp.ndarray) -> jnp.ndarray:
+    """[..., NLIMBS] *frozen* limbs -> [..., 32] int32 little-endian
+    bytes (values 0..255)."""
+    out = []
+    for byte in range(32):
+        lo_bit = 8 * byte
+        limb0, off = lo_bit // BITS, lo_bit % BITS
+        v = a[..., limb0] >> off
+        got = BITS - off
+        if got < 8 and limb0 + 1 < NLIMBS:
+            v = v | (a[..., limb0 + 1] << got)
+        out.append(v & 0xFF)
+    return jnp.stack(out, axis=-1)
+
+
+# --- carry normalization ----------------------------------------------------
+
+def carry(r: jnp.ndarray) -> jnp.ndarray:
+    """Normalize [..., NLIMBS] int32 columns (|col| < 2^30, total value
+    non-negative) to *weakly* normalized limbs in [0, 2^13 + 16),
+    preserving the value mod p.
+
+    One signed chain, a *608 wrap fold into limb 0, and a 3-step
+    ripple.  This is the hot-path normalizer: weak limbs are safe for
+    every field op (products (2^13+16)^2 * 20 terms still fit int32;
+    `sub`'s 64p spread still dominates per-limb), and the boundaries
+    that need strict limbs (compares, byte packing) go through
+    `strict_carry`/`freeze`.  Bounds: the wrap carry c1 <= 2^19, so the
+    fold adds < 2^28 to limb 0; rippling limbs 0..2 then leaves limbs
+    1..3 within +16 of 2^13.  Callers must keep the total non-negative
+    (`sub` adds 64p for exactly this reason)."""
+    c = jnp.zeros_like(r[..., 0])
+    outs = []
+    for k in range(NLIMBS):
+        t = r[..., k] + c
+        outs.append(t & LMASK)
+        c = t >> BITS              # arithmetic shift: signed carries OK
+    r = jnp.stack(outs, axis=-1)
+    r = r.at[..., 0].add(FOLD * c)
+    for k in range(3):
+        t = r[..., k]
+        r = r.at[..., k].set(t & LMASK)
+        r = r.at[..., k + 1].add(t >> BITS)
+    return r
+
+
+def strict_carry(r: jnp.ndarray) -> jnp.ndarray:
+    """Full normalization to limbs in [0, 2^13): three (chain + wrap
+    fold) passes.  Pass-1's wrap carry is <= 2^19; each chain masks
+    limbs below 2^13 so passes 2-3 see wrap carries <= 1, and when the
+    last chain still carries, the residual value is <= 607 so the final
+    fold cannot push limb 0 back over 2^13."""
+    for _ in range(3):
+        c = jnp.zeros_like(r[..., 0])
+        outs = []
+        for k in range(NLIMBS):
+            t = r[..., k] + c
+            outs.append(t & LMASK)
+            c = t >> BITS
+        r = jnp.stack(outs, axis=-1)
+        r = r.at[..., 0].add(FOLD * c)
+    return r
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry(a - b + SUB_K)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply: outer product, column sums via the constant
+    COLSUM contraction, high-half carry, *608 fold, carry."""
+    prod = a[..., :, None] * b[..., None, :]           # [..., 20, 20] < 2^26
+    flat = prod.reshape(prod.shape[:-2] + (NLIMBS * NLIMBS,))
+    cols = flat @ COLSUM                               # [..., 39] < 2^31
+    lo, hi = cols[..., :NLIMBS], cols[..., NLIMBS:]
+    # normalize the high half to 13-bit limbs before scaling by 608
+    c = jnp.zeros_like(hi[..., 0])
+    hl = []
+    for k in range(_COLS - NLIMBS):
+        t = hi[..., k] + c
+        hl.append(t & LMASK)
+        c = t >> BITS
+    hi_n = jnp.stack(hl + [c], axis=-1)                # [..., 20] < 2^13 (+c)
+    return carry(lo + FOLD * hi_n)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small constant (k < 2^17)."""
+    return carry(a * jnp.asarray(k, I32))
+
+
+def one_like(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.zeros_like(a).at[..., 0].set(1)
+
+
+def pow_p(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e by left-to-right square-and-multiply over the static exponent
+    bits, as a `lax.scan` — a 255-squaring chain unrolled into the graph
+    compiles in O(minutes) on XLA, so the loop must be rolled (one body
+    compile, sequential execution; the batch axis keeps the VPU fed)."""
+    bits = jnp.asarray([(e >> i) & 1 for i in
+                        reversed(range(e.bit_length()))], bool)
+
+    def body(r, bit):
+        r = sqr(r)
+        return jnp.where(bit, mul(r, a), r), None
+
+    r, _ = jax.lax.scan(body, one_like(a), bits)
+    return r
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    return pow_p(a, P - 2)
+
+
+def freeze(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical representative in [0, p) with strict limbs.  After
+    strict normalization the value is < 2^260 < 33p, so branch-free
+    conditional subtraction of 16p, 8p, 4p, 2p, p, p reduces it."""
+    a = strict_carry(a)
+    for m in (16, 8, 4, 2, 1, 1):
+        mp = to_limbs(m * P)
+        ge = _geq(a, mp)
+        a = jnp.where(ge[..., None], _raw_sub(a, mp), a)
+    return a
+
+
+def _raw_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b for a >= b, both limb-normalized: signed chain, no fold.
+    Generic over the limb count (also used for mod-L scalars)."""
+    r = a - b
+    c = jnp.zeros_like(r[..., 0])
+    outs = []
+    for k in range(r.shape[-1]):
+        t = r[..., k] + c
+        outs.append(t & LMASK)
+        c = t >> BITS
+    return jnp.stack(outs, axis=-1)
+
+
+def _geq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a >= b on normalized limbs (lexicographic from the top).
+    Generic over the limb count."""
+    gt = jnp.zeros(a.shape[:-1], bool)
+    eq = jnp.ones(a.shape[:-1], bool)
+    for k in reversed(range(a.shape[-1])):
+        ak, bk = a[..., k], b[..., k]
+        gt = gt | (eq & (ak > bk))
+        eq = eq & (ak == bk)
+    return gt | eq
+
+
+def eq_mod_p(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a == b (mod p) for partially-reduced inputs."""
+    fa, fb = freeze(a), freeze(b)
+    return jnp.all(fa == fb, axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(freeze(a) == 0, axis=-1)

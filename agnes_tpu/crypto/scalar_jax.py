@@ -1,0 +1,119 @@
+"""Scalars mod the ed25519 group order L, in 13-bit limbs (JAX).
+
+The verification challenge k = SHA-512(R || A || M) is a 512-bit
+integer that must be taken mod L = 2^252 + 27742...493 before the
+double-scalar multiplication.  Classic Barrett reduction, limb-aligned:
+with mu = floor(2^520 / L) (520 = 40 limbs exactly),
+
+    q = floor(k * mu / 2^520)  in [floor(k/L) - 2, floor(k/L)]
+    r = k - q*L                in [0, 3L)  ->  two conditional - L.
+
+All products are (variable x compile-time-constant) convolutions done
+as shifted multiply-adds over the constant's limbs; column sums stay
+under 21 * 2^26 < 2^31, so everything is int32 like the field layer.
+
+The reference has no scalar arithmetic (no crypto at all, SURVEY.md
+§2.1); oracle for tests is plain Python `% L`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from agnes_tpu.crypto.field_jax import (
+    BITS,
+    I32,
+    LMASK,
+    _geq,
+    _raw_sub,
+    bytes_to_limbs,
+)
+
+L = 2**252 + 27742317777372353535851937790883648493
+N_HASH = 40                      # limbs for a 512-bit hash (520 bits)
+N_SCALAR = 20                    # limbs for reduced scalars (260 bits)
+MU = (1 << 520) // L             # 268 bits -> 21 limbs
+
+
+def _const_limbs(x: int) -> list:
+    out = []
+    while x:
+        out.append(x & LMASK)
+        x >>= BITS
+    return out or [0]
+
+
+MU_LIMBS = _const_limbs(MU)
+L_LIMBS_LIST = _const_limbs(L)
+L_LIMBS = jnp.asarray(L_LIMBS_LIST + [0] * (N_SCALAR - len(L_LIMBS_LIST)),
+                      I32)
+
+
+def _mul_const(a: jnp.ndarray, const: list) -> jnp.ndarray:
+    """[..., n] limbs times a constant (as limb list) -> [..., n+m-1]
+    raw columns (unnormalized, < 2^31)."""
+    n, m = a.shape[-1], len(const)
+    acc = jnp.zeros(a.shape[:-1] + (n + m - 1,), I32)
+    for j, cj in enumerate(const):
+        if cj:
+            acc = acc.at[..., j:j + n].add(a * jnp.asarray(cj, I32))
+    return acc
+
+
+def _chain(r: jnp.ndarray) -> jnp.ndarray:
+    """Normalize non-negative raw columns; the final carry is appended
+    as an extra limb (caller knows the true width)."""
+    c = jnp.zeros_like(r[..., 0])
+    outs = []
+    for k in range(r.shape[-1]):
+        t = r[..., k] + c
+        outs.append(t & LMASK)
+        c = t >> BITS
+    outs.append(c)
+    return jnp.stack(outs, axis=-1)
+
+
+def barrett_reduce(k: jnp.ndarray) -> jnp.ndarray:
+    """[..., N_HASH] normalized limbs (value < 2^520) -> [..., N_SCALAR]
+    limbs of k mod L (canonical, < L)."""
+    t = _chain(_mul_const(k, MU_LIMBS))          # k*mu, limbs
+    q = t[..., N_HASH:]                           # >> 520
+    ql = _chain(_mul_const(q, L_LIMBS_LIST))[..., :N_HASH]
+    r = _chain(k - ql)[..., :N_SCALAR]            # in [0, 3L), signed-safe
+    for _ in range(2):
+        ge = _geq(r, L_LIMBS)
+        r = jnp.where(ge[..., None], _raw_sub(r, L_LIMBS), r)
+    return r
+
+
+def digest_to_limbs(digest: jnp.ndarray) -> jnp.ndarray:
+    """sha512_jax digest ([..., 16] uint32, (hi, lo) big-endian word
+    pairs) -> [..., N_HASH] limbs of the RFC 8032 little-endian int."""
+    d = digest.astype(I32)
+    bytes_le = []
+    for j in range(64):
+        t, b = j // 8, j % 8
+        half = d[..., 2 * t] if b < 4 else d[..., 2 * t + 1]
+        shift = 24 - 8 * (b % 4)
+        bytes_le.append((half >> shift) & 0xFF)
+    return bytes_to_limbs(jnp.stack(bytes_le, axis=-1), N_HASH)
+
+
+def scalar_from_bytes32(b: jnp.ndarray) -> jnp.ndarray:
+    """[..., 32] little-endian bytes -> [..., N_SCALAR] limbs (< 2^256,
+    NOT reduced — use `is_canonical` for the S < L check)."""
+    return bytes_to_limbs(b, N_SCALAR)
+
+
+def is_canonical(s: jnp.ndarray) -> jnp.ndarray:
+    """s < L (the RFC 8032 §5.1.7 malleability check)."""
+    return ~_geq(s, L_LIMBS)
+
+
+def bits_msb_first(s: jnp.ndarray, n_bits: int = 260) -> jnp.ndarray:
+    """[..., n_limbs] limbs -> [n_bits, ...] bool, most significant bit
+    first — the scan input for double-scalar multiplication."""
+    idx = jnp.arange(n_bits - 1, -1, -1)
+    limb, off = idx // BITS, idx % BITS
+    bits = (s[..., limb] >> off) & 1
+    return jnp.moveaxis(bits.astype(bool), -1, 0)

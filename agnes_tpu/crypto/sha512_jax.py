@@ -1,0 +1,237 @@
+"""Batched SHA-512 in JAX for the Ed25519 challenge hash.
+
+TPU has no 64-bit scalar unit worth leaning on, so every 64-bit SHA-512
+word is carried as a (hi, lo) pair of uint32 lanes; the batch axis is
+the vector axis.  The round/IV constants are *generated* at import time
+from their definition (fractional parts of cube/square roots of the
+first primes, FIPS 180-4 §4.2.3/§5.3.5) rather than typed in as a
+table — the test suite pins the output against `hashlib.sha512`.
+
+Only fixed-length single-block messages are needed by the vote path:
+the canonical vote encoding is sized so that R(32) || A(32) || M(<=47)
+fits one 128-byte padded block (a deliberate TPU-first design choice —
+one compression per signature).  Multi-block inputs are handled by
+looping compressions on the host-traced (static) block count.
+
+The reference engine hashes nothing (SURVEY.md §5: no crypto anywhere);
+this exists to serve the added signature surface.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+MASK32 = 0xFFFFFFFF
+
+Word = Tuple[jnp.ndarray, jnp.ndarray]  # (hi, lo) uint32 lanes
+
+
+# --- constant generation (FIPS 180-4: frac parts of prime roots) ------------
+
+def _primes(n: int) -> List[int]:
+    ps, x = [], 2
+    while len(ps) < n:
+        if all(x % p for p in ps):
+            ps.append(x)
+        x += 1
+    return ps
+
+
+def _icbrt(n: int) -> int:
+    x = 1 << ((n.bit_length() + 2) // 3 + 1)
+    while True:
+        y = (2 * x + n // (x * x)) // 3
+        if y >= x:
+            break
+        x = y
+    return x
+
+
+def _isqrt(n: int) -> int:
+    import math
+    return math.isqrt(n)
+
+
+# K[t] = floor(frac(cbrt(prime_t)) * 2^64)
+_K64 = [_icbrt(p << 192) & ((1 << 64) - 1) for p in _primes(80)]
+# H0[i] = floor(frac(sqrt(prime_i)) * 2^64)
+_H64 = [_isqrt(p << 128) & ((1 << 64) - 1) for p in _primes(8)]
+
+K_HI = jnp.asarray([k >> 32 for k in _K64], U32)
+K_LO = jnp.asarray([k & MASK32 for k in _K64], U32)
+H0_HI = jnp.asarray([h >> 32 for h in _H64], U32)
+H0_LO = jnp.asarray([h & MASK32 for h in _H64], U32)
+
+
+# --- 64-bit word ops on (hi, lo) uint32 pairs -------------------------------
+
+def _add(a: Word, *rest: Word) -> Word:
+    hi, lo = a
+    for bh, bl in rest:
+        lo = lo + bl
+        hi = hi + bh + (lo < bl).astype(U32)
+    return hi, lo
+
+
+def _xor(a: Word, b: Word) -> Word:
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def _and(a: Word, b: Word) -> Word:
+    return a[0] & b[0], a[1] & b[1]
+
+
+def _not(a: Word) -> Word:
+    return ~a[0], ~a[1]
+
+
+def _rotr(a: Word, n: int) -> Word:
+    hi, lo = a
+    if n >= 32:
+        hi, lo, n = lo, hi, n - 32
+    if n == 0:
+        return hi, lo
+    return ((hi >> n) | (lo << (32 - n)),
+            (lo >> n) | (hi << (32 - n)))
+
+
+def _shr(a: Word, n: int) -> Word:
+    hi, lo = a
+    if n >= 32:
+        return jnp.zeros_like(hi), hi >> (n - 32)
+    if n == 0:
+        return hi, lo
+    return hi >> n, (lo >> n) | (hi << (32 - n))
+
+
+def _ch(e: Word, f: Word, g: Word) -> Word:
+    return _xor(_and(e, f), _and(_not(e), g))
+
+
+def _maj(a: Word, b: Word, c: Word) -> Word:
+    return _xor(_xor(_and(a, b), _and(a, c)), _and(b, c))
+
+
+def _big_sigma0(a: Word) -> Word:
+    return _xor(_xor(_rotr(a, 28), _rotr(a, 34)), _rotr(a, 39))
+
+
+def _big_sigma1(e: Word) -> Word:
+    return _xor(_xor(_rotr(e, 14), _rotr(e, 18)), _rotr(e, 41))
+
+
+def _sm_sigma0(w: Word) -> Word:
+    return _xor(_xor(_rotr(w, 1), _rotr(w, 8)), _shr(w, 7))
+
+
+def _sm_sigma1(w: Word) -> Word:
+    return _xor(_xor(_rotr(w, 19), _rotr(w, 61)), _shr(w, 6))
+
+
+def _compress(state: List[Word], block: jnp.ndarray) -> List[Word]:
+    """One SHA-512 compression.  block: [..., 32] uint32 where columns
+    (2t, 2t+1) are the (hi, lo) halves of big-endian message word t.
+
+    Both the message schedule and the 80 rounds are `lax.scan`s: this
+    XLA toolchain compiles at O(100) ops/sec, so an unrolled ~5k-op
+    compression graph takes minutes to build while two small scan
+    bodies compile in seconds."""
+    # message schedule: scan a 16-word sliding window, emitting W[t]
+    win_hi = jnp.stack([block[..., 2 * t] for t in range(16)], axis=0)
+    win_lo = jnp.stack([block[..., 2 * t + 1] for t in range(16)], axis=0)
+
+    def sched(win, _):
+        wh, wl = win
+        cur: Word = (wh[0], wl[0])
+        nxt = _add(_sm_sigma1((wh[14], wl[14])), (wh[9], wl[9]),
+                   _sm_sigma0((wh[1], wl[1])), (wh[0], wl[0]))
+        wh = jnp.roll(wh, -1, axis=0).at[15].set(nxt[0])
+        wl = jnp.roll(wl, -1, axis=0).at[15].set(nxt[1])
+        return (wh, wl), cur
+
+    _, (w_hi, w_lo) = jax.lax.scan(sched, (win_hi, win_lo), None, length=80)
+
+    def round_fn(carry_state, wk):
+        a, b, c, d, e, f, g, h = [(hi, lo) for hi, lo in
+                                  zip(carry_state[0], carry_state[1])]
+        whi, wlo, khi, klo = wk
+        t1 = _add(h, _big_sigma1(e), _ch(e, f, g), (khi, klo), (whi, wlo))
+        t2 = _add(_big_sigma0(a), _maj(a, b, c))
+        h, g, f = g, f, e
+        e = _add(d, t1)
+        d, c, b = c, b, a
+        a = _add(t1, t2)
+        new = [a, b, c, d, e, f, g, h]
+        return (tuple(x[0] for x in new), tuple(x[1] for x in new)), None
+
+    init = (tuple(s[0] for s in state), tuple(s[1] for s in state))
+    batch = block.shape[:-1]
+    kshape = (80,) + (1,) * len(batch)
+    kh = jnp.broadcast_to(K_HI.reshape(kshape), (80,) + batch)
+    kl = jnp.broadcast_to(K_LO.reshape(kshape), (80,) + batch)
+    (fh, fl), _ = jax.lax.scan(round_fn, init, (w_hi, w_lo, kh, kl))
+
+    return [_add(s, (fh[i], fl[i])) for i, s in enumerate(state)]
+
+
+def sha512_blocks(blocks: jnp.ndarray) -> jnp.ndarray:
+    """SHA-512 over pre-padded message blocks.
+
+    blocks: [..., n_blocks, 32] uint32 — each block is 16 big-endian
+    64-bit words as (hi, lo) column pairs.  Returns the digest as
+    [..., 16] uint32, same (hi, lo) big-endian word convention.
+    The block count is static (python loop under jit)."""
+    shape = blocks.shape[:-2]
+    state: List[Word] = [
+        (jnp.broadcast_to(H0_HI[i], shape), jnp.broadcast_to(H0_LO[i], shape))
+        for i in range(8)]
+    for blk in range(blocks.shape[-2]):
+        state = _compress(state, blocks[..., blk, :])
+    return jnp.stack([half for word in state for half in word], axis=-1)
+
+
+def pad_message(msg_len: int) -> Tuple[int, int]:
+    """(n_blocks, zero_bytes) of SHA-512 padding for a msg_len-byte
+    message: 0x80, zeros, 16-byte big-endian bit length."""
+    n_blocks = (msg_len + 1 + 16 + 127) // 128
+    zeros = n_blocks * 128 - msg_len - 1 - 16
+    return n_blocks, zeros
+
+
+def pack_padded_host(msgs: "list[bytes]") -> jnp.ndarray:
+    """Host-side packer: equal-length byte messages -> [B, n_blocks, 32]
+    uint32 padded blocks for `sha512_blocks`.  The bridge's fixed-layout
+    vote packer (device-side) mirrors this."""
+    import numpy as np
+
+    if not msgs:
+        return jnp.zeros((0, 1, 32), U32)
+    n = len(msgs[0])
+    assert all(len(m) == n for m in msgs), "equal-length messages required"
+    n_blocks, zeros = pad_message(n)
+    out = np.zeros((len(msgs), n_blocks * 128), np.uint8)
+    for i, m in enumerate(msgs):
+        out[i, :n] = np.frombuffer(m, np.uint8)
+        out[i, n] = 0x80
+        bitlen = (8 * n).to_bytes(16, "big")
+        out[i, -16:] = np.frombuffer(bitlen, np.uint8)
+    words = out.reshape(len(msgs), n_blocks, 32, 4)
+    packed = ((words[..., 0].astype(np.uint32) << 24)
+              | (words[..., 1].astype(np.uint32) << 16)
+              | (words[..., 2].astype(np.uint32) << 8)
+              | words[..., 3].astype(np.uint32))
+    return jnp.asarray(packed)
+
+
+def digest_to_le_bytes_host(digest) -> bytes:
+    """One [16] uint32 digest row -> the 64 raw bytes (as produced by
+    hashlib .digest()), for host-side tests."""
+    import numpy as np
+
+    d = np.asarray(digest, np.uint64)
+    words = [(int(d[2 * t]) << 32) | int(d[2 * t + 1]) for t in range(8)]
+    return b"".join(w.to_bytes(8, "big") for w in words)

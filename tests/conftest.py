@@ -25,6 +25,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 try:
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: the crypto scan bodies cost minutes to
+    # compile on this toolchain; cache them across test runs
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 except ImportError:  # pure-core tests don't need jax
     pass
 

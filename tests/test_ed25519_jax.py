@@ -1,0 +1,123 @@
+"""Batched JAX Ed25519 vs the pure-Python RFC 8032 oracle.
+
+One jit compile is shared across the module (the Straus scan body is
+the expensive compile); batches are kept small for CPU test speed.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agnes_tpu.crypto import ed25519_jax as E
+from agnes_tpu.crypto import ed25519_ref as ref
+from agnes_tpu.crypto import scalar_jax as S
+from agnes_tpu.crypto.encoding import VOTE_MSG_LEN, vote_signing_bytes
+from agnes_tpu.types import VoteType
+
+rng = random.Random(99)
+
+
+def _enc_batch(points):
+    return jnp.asarray(
+        np.stack([np.frombuffer(ref._compress(p), np.uint8)
+                  for p in points]), jnp.int32)
+
+
+def _as_bytes(arr_row) -> bytes:
+    return np.asarray(arr_row, np.uint8).tobytes()
+
+
+def test_decompress_compress_roundtrip():
+    pts = [ref.BASE, ref._mul(2, ref.BASE), ref._mul(3, ref.BASE),
+           ref._mul(rng.randrange(ref.L), ref.BASE)]
+    enc = _enc_batch(pts)
+    P, ok = jax.jit(E.decompress)(enc)
+    assert bool(ok.all())
+    out = jax.jit(E.compress)(P)
+    for i, p in enumerate(pts):
+        assert _as_bytes(out[i]) == ref._compress(p)
+
+
+def test_decompress_rejects_bad_encodings():
+    bad = np.zeros((3, 32), np.int32)
+    bad[0] = np.frombuffer((ref.P + 1).to_bytes(32, "little"), np.uint8)
+    bad[1] = np.frombuffer((2).to_bytes(32, "little"), np.uint8)  # y=2 off-curve
+    # x = 0 with sign bit set: y = 1 encodes the identity, sign must be 0
+    one_enc = (1 | (1 << 255)).to_bytes(32, "little")
+    bad[2] = np.frombuffer(one_enc, np.uint8)
+    _, ok = jax.jit(E.decompress)(jnp.asarray(bad))
+    assert not bool(ok.any())
+
+
+def test_point_add_matches_oracle():
+    a = ref._mul(7, ref.BASE)
+    b = ref._mul(11, ref.BASE)
+    enc = _enc_batch([a, b])
+    P, _ = jax.jit(E.decompress)(enc)
+    s = E.point_add(E.Point(*[c[0:1] for c in P]),
+                    E.Point(*[c[1:2] for c in P]))
+    assert _as_bytes(jax.jit(E.compress)(s)[0]) == \
+        ref._compress(ref._add(a, b))
+
+
+def test_barrett_reduce_matches_python():
+    ks = [0, 1, S.L - 1, S.L, S.L + 1, 2**252, 2**512 - 1,
+          rng.randrange(2**512), rng.randrange(2**512)]
+    limbs = jnp.stack(
+        [jnp.asarray([(k >> (13 * i)) & 0x1FFF for i in range(S.N_HASH)],
+                     jnp.int32) for k in ks])
+    out = jax.jit(S.barrett_reduce)(limbs)
+    for i, k in enumerate(ks):
+        got = sum(int(np.asarray(out[i])[j]) << (13 * j)
+                  for j in range(S.N_SCALAR))
+        assert got == k % S.L, f"case {i}"
+
+
+def test_verify_batch():
+    seeds = [bytes([i]) * 32 for i in range(5)]
+    keys = [ref.keypair(s) for s in seeds]
+    msgs = [vote_signing_bytes(height=1, round=0,
+                               typ=int(VoteType.PREVOTE), value=i)
+            for i in range(5)]
+    assert all(len(m) == VOTE_MSG_LEN for m in msgs)
+    sigs = [ref.sign(sk, m) for (sk, _), m in zip(keys, msgs)]
+    pubs = [pk for _, pk in keys]
+    # corrupt: bad sig bit, wrong message, non-canonical S
+    sigs[1] = sigs[1][:5] + bytes([sigs[1][5] ^ 1]) + sigs[1][6:]
+    msgs[2] = msgs[2][:-1] + b"X"
+    s3 = int.from_bytes(sigs[3][32:], "little")
+    sigs[3] = sigs[3][:32] + (s3 + ref.L).to_bytes(32, "little")
+
+    pub, sig, blocks = E.pack_verify_inputs_host(pubs, msgs, sigs)
+    ok = E.verify_batch_jit(pub, sig, blocks)
+    assert ok.tolist() == [True, False, False, False, True]
+    # parity with the oracle on every lane
+    for i in range(5):
+        assert bool(ok[i]) == ref.verify(pubs[i], msgs[i], sigs[i])
+
+
+def test_verify_fuzz_parity():
+    """Randomized parity: valid/invalid mix must agree with the oracle.
+    Batch of 5 keeps the same shape as test_verify_batch so the Straus
+    scan compile is shared."""
+    n = 5
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        seed = bytes(rng.randrange(256) for _ in range(32))
+        sk, pk = ref.keypair(seed)
+        m = bytes(rng.randrange(256) for _ in range(VOTE_MSG_LEN))
+        sg = ref.sign(sk, m)
+        if i % 3 == 1:
+            pos = rng.randrange(64)
+            sg = sg[:pos] + bytes([sg[pos] ^ (1 << rng.randrange(8))]) \
+                + sg[pos + 1:]
+        if i % 3 == 2:
+            pk = ref.keypair(bytes(rng.randrange(256)
+                                   for _ in range(32)))[1]
+        pubs.append(pk), msgs.append(m), sigs.append(sg)
+    pub, sig, blocks = E.pack_verify_inputs_host(pubs, msgs, sigs)
+    ok = E.verify_batch_jit(pub, sig, blocks)
+    for i in range(n):
+        assert bool(ok[i]) == ref.verify(pubs[i], msgs[i], sigs[i]), i

@@ -1,0 +1,91 @@
+"""GF(2^255-19) limb arithmetic vs plain Python ints (the oracle)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agnes_tpu.crypto import field_jax as F
+
+P = F.P
+rng = random.Random(1234)
+
+
+def _cases(n):
+    special = [0, 1, 2, 19, P - 1, P, P + 1, 2 * P - 1, (1 << 255) - 1,
+               (1 << 256) - 1, (1 << 260) - 1]
+    return special + [rng.randrange(1 << 260) for _ in range(n)]
+
+
+def _batch(ints):
+    return jnp.stack([F.to_limbs(x) for x in ints])
+
+
+def test_roundtrip():
+    xs = _cases(16)
+    limbs = _batch(xs)
+    for i, x in enumerate(xs):
+        assert F.from_limbs(limbs[i]) == x
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("add", lambda a, b: (a + b) % P),
+    ("sub", lambda a, b: (a - b) % P),
+    ("mul", lambda a, b: (a * b) % P),
+])
+def test_binary_ops(op, ref):
+    xs, ys = _cases(24), list(reversed(_cases(24)))
+    a, b = _batch(xs), _batch(ys)
+    out = jax.jit(getattr(F, op))(a, b)
+    frozen = F.freeze(out)
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        got = F.from_limbs(frozen[i])
+        assert got == ref(x, y), f"{op}[{i}]: {x} . {y} -> {got}"
+    # limbs stay weakly normalized (safe as inputs to a further mul)
+    assert np.asarray(out).max() < F.RADIX + 16
+    assert np.asarray(out).min() >= 0
+
+
+def test_freeze_canonical():
+    xs = _cases(16)
+    frozen = F.freeze(_batch(xs))
+    for i, x in enumerate(xs):
+        assert F.from_limbs(frozen[i]) == x % P
+
+
+def test_inv():
+    xs = [x for x in _cases(6) if x % P != 0]
+    a = _batch(xs)
+    out = F.freeze(jax.jit(F.inv)(a))
+    for i, x in enumerate(xs):
+        assert F.from_limbs(out[i]) == pow(x, P - 2, P)
+
+
+def test_chained_ops_stay_bounded():
+    """Long chains (like a 255-squaring pow) must not overflow int32."""
+    x = _batch([rng.randrange(1 << 260) for _ in range(4)])
+    acc = x
+    ref = [F.from_limbs(x[i]) for i in range(4)]
+    for _ in range(30):
+        acc = F.mul(F.add(acc, x), acc)
+        ref = [((r + s) * r) % P for r, s in zip(ref, [F.from_limbs(x[i])
+                                                      for i in range(4)])]
+    frozen = F.freeze(acc)
+    for i in range(4):
+        assert F.from_limbs(frozen[i]) == ref[i]
+
+
+def test_bytes_conversion():
+    xs = [rng.randrange(1 << 255) for _ in range(8)]
+    raw = np.zeros((8, 32), np.int32)
+    for i, x in enumerate(xs):
+        raw[i] = np.frombuffer(x.to_bytes(32, "little"), np.uint8)
+    limbs = F.bytes32_to_limbs(jnp.asarray(raw))
+    for i, x in enumerate(xs):
+        assert F.from_limbs(limbs[i]) == x
+    back = F.limbs_to_bytes32(F.freeze(limbs))
+    for i, x in enumerate(xs):
+        assert bytes(np.asarray(back[i], np.uint8).tobytes()) == \
+            (x % P).to_bytes(32, "little")
