@@ -1,0 +1,26 @@
+// SHA-512 (FIPS 180-4) for the native core: validator-set hashing and
+// the Ed25519 sign/verify challenge hash.  Written from the spec; the
+// round constants are generated at build time by native_build.py from
+// their definition (frac parts of cube roots of the first 80 primes)
+// into sha512_k.inc — the same generator the JAX layer uses, so all
+// three implementations share one constant source.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace agnes {
+
+struct Sha512 {
+  uint64_t h[8];
+  uint8_t buf[128];
+  uint64_t len = 0;   // total bytes absorbed
+
+  Sha512();
+  void update(const uint8_t* data, size_t n);
+  void final(uint8_t out[64]);
+};
+
+void sha512(const uint8_t* data, size_t n, uint8_t out[64]);
+
+}  // namespace agnes

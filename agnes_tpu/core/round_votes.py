@@ -132,12 +132,15 @@ class VoteCount:
 
     def quorum_value(self) -> Optional[int]:
         """The highest-weight value with a quorum, if any.  At most one
-        value can have >2/3, so 'highest-weight' only breaks ties in
-        adversarial >total-weight streams (identity-free votes)."""
+        value can have >2/3, so the tie-break (highest weight, then
+        smallest value id) only matters in adversarial >total-weight
+        streams (identity-free votes); it is deterministic and mirrored
+        by the C++ core's ascending-id map iteration."""
         best = None
         best_w = -1
         for v, w in self.weights.items():
-            if is_quorum(w, self.total) and w > best_w:
+            if is_quorum(w, self.total) and (
+                    w > best_w or (w == best_w and v < best)):
                 best, best_w = v, w
         return best
 

@@ -116,6 +116,20 @@ def limbs_to_bytes32(a: jnp.ndarray) -> jnp.ndarray:
 
 # --- carry normalization ----------------------------------------------------
 
+def _carry_chain(r: jnp.ndarray):
+    """One signed sequential carry chain over the last axis: returns
+    (limbs in [0, 2^13), carry out).  Arithmetic right-shift makes
+    negative columns borrow correctly.  Shared by every normalizer here
+    and by scalar_jax — fix bounds bugs in ONE place."""
+    c = jnp.zeros_like(r[..., 0])
+    outs = []
+    for k in range(r.shape[-1]):
+        t = r[..., k] + c
+        outs.append(t & LMASK)
+        c = t >> BITS
+    return jnp.stack(outs, axis=-1), c
+
+
 def carry(r: jnp.ndarray) -> jnp.ndarray:
     """Normalize [..., NLIMBS] int32 columns (|col| < 2^30, total value
     non-negative) to *weakly* normalized limbs in [0, 2^13 + 16),
@@ -130,13 +144,7 @@ def carry(r: jnp.ndarray) -> jnp.ndarray:
     fold adds < 2^28 to limb 0; rippling limbs 0..2 then leaves limbs
     1..3 within +16 of 2^13.  Callers must keep the total non-negative
     (`sub` adds 64p for exactly this reason)."""
-    c = jnp.zeros_like(r[..., 0])
-    outs = []
-    for k in range(NLIMBS):
-        t = r[..., k] + c
-        outs.append(t & LMASK)
-        c = t >> BITS              # arithmetic shift: signed carries OK
-    r = jnp.stack(outs, axis=-1)
+    r, c = _carry_chain(r)
     r = r.at[..., 0].add(FOLD * c)
     for k in range(3):
         t = r[..., k]
@@ -152,13 +160,7 @@ def strict_carry(r: jnp.ndarray) -> jnp.ndarray:
     last chain still carries, the residual value is <= 607 so the final
     fold cannot push limb 0 back over 2^13."""
     for _ in range(3):
-        c = jnp.zeros_like(r[..., 0])
-        outs = []
-        for k in range(NLIMBS):
-            t = r[..., k] + c
-            outs.append(t & LMASK)
-            c = t >> BITS
-        r = jnp.stack(outs, axis=-1)
+        r, c = _carry_chain(r)
         r = r.at[..., 0].add(FOLD * c)
     return r
 
@@ -237,14 +239,7 @@ def freeze(a: jnp.ndarray) -> jnp.ndarray:
 def _raw_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """a - b for a >= b, both limb-normalized: signed chain, no fold.
     Generic over the limb count (also used for mod-L scalars)."""
-    r = a - b
-    c = jnp.zeros_like(r[..., 0])
-    outs = []
-    for k in range(r.shape[-1]):
-        t = r[..., k] + c
-        outs.append(t & LMASK)
-        c = t >> BITS
-    return jnp.stack(outs, axis=-1)
+    return _carry_chain(a - b)[0]
 
 
 def _geq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

@@ -24,6 +24,7 @@ from agnes_tpu.crypto.field_jax import (
     BITS,
     I32,
     LMASK,
+    _carry_chain,
     _geq,
     _raw_sub,
     bytes_to_limbs,
@@ -63,14 +64,8 @@ def _mul_const(a: jnp.ndarray, const: list) -> jnp.ndarray:
 def _chain(r: jnp.ndarray) -> jnp.ndarray:
     """Normalize non-negative raw columns; the final carry is appended
     as an extra limb (caller knows the true width)."""
-    c = jnp.zeros_like(r[..., 0])
-    outs = []
-    for k in range(r.shape[-1]):
-        t = r[..., k] + c
-        outs.append(t & LMASK)
-        c = t >> BITS
-    outs.append(c)
-    return jnp.stack(outs, axis=-1)
+    limbs, c = _carry_chain(r)
+    return jnp.concatenate([limbs, c[..., None]], axis=-1)
 
 
 def barrett_reduce(k: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,223 @@
+"""Differential tests: C++ native core vs the Python oracle core.
+
+The exhaustive state-machine sweep is the §4(b) test from SURVEY.md:
+the Step x Event x guard space is tiny, so every reachable-or-not
+combination is checked for byte-identical (state', message) output.
+"""
+
+import itertools
+import random
+
+import pytest
+
+from agnes_tpu.core import native as N
+from agnes_tpu.core import state_machine as sm
+from agnes_tpu.core.round_votes import RoundVotes, ThreshKind
+from agnes_tpu.crypto import ed25519_ref as ed
+from agnes_tpu.types import Vote, VoteType
+
+rng = random.Random(7)
+
+
+def _all_events():
+    evs = []
+    for tag in sm.EventTag:
+        if tag in (sm.EventTag.NEW_ROUND_PROPOSER, sm.EventTag.POLKA_VALUE,
+                   sm.EventTag.PRECOMMIT_VALUE):
+            evs += [sm.Event(tag, value=v) for v in (1, 2)]
+        elif tag == sm.EventTag.PROPOSAL:
+            evs += [sm.Event(tag, value=v, pol_round=pr)
+                    for v in (1, 2) for pr in (-2, -1, 0, 1, 2)]
+        else:
+            evs.append(sm.Event(tag))
+    return evs
+
+
+def _all_states():
+    states = []
+    for step in sm.Step:
+        for round in (0, 1, 2):
+            for locked in (None, sm.RoundValue(0, 1), sm.RoundValue(1, 2),
+                           sm.RoundValue(2, 1)):
+                for valid in (None, sm.RoundValue(0, 1),
+                              sm.RoundValue(1, 2)):
+                    states.append(sm.State(height=5, round=round, step=step,
+                                           locked=locked, valid=valid))
+    return states
+
+
+def test_exhaustive_state_machine_parity():
+    """Every (state, round, event) pair: C++ == Python, field for field."""
+    events = _all_events()
+    count = 0
+    for s in _all_states():
+        for round in (0, 1, 2, 3):
+            for e in events:
+                py_s, py_m = sm.apply(s, round, e)
+                c_s, c_m = N.native_apply(s, round, e)
+                assert c_s == py_s, (s, round, e, c_s, py_s)
+                assert c_m == py_m, (s, round, e, c_m, py_m)
+                count += 1
+    assert count == len(_all_states()) * 4 * len(_all_events())
+
+
+def test_tally_differential_fuzz():
+    """Random identified/anonymous vote streams: thresholds, skip weight
+    and equivocation evidence agree at every single step."""
+    for trial in range(20):
+        total = rng.randrange(4, 30)
+        py = RoundVotes(height=1, round=0, total=total)
+        cc = N.NativeRoundVotes(height=1, round=0, total=total)
+        for _ in range(80):
+            vote = Vote(
+                typ=rng.choice([VoteType.PREVOTE, VoteType.PRECOMMIT]),
+                round=0,
+                value=rng.choice([None, 1, 2, 3]),
+                validator=rng.choice([None] + list(range(8))))
+            w = rng.randrange(1, 4)
+            t_py = py.add_vote(vote, w)
+            t_cc = cc.add_vote(vote, w)
+            assert t_cc == t_py, (trial, vote, w, t_cc, t_py)
+            assert cc.skip_weight() == py.skip_weight()
+        eq_py = [(e.round, e.typ, e.validator, e.first_value, e.second_value)
+                 for e in py.equivocations]
+        eq_cc = [(e.round, e.typ, e.validator, e.first_value, e.second_value)
+                 for e in cc.equivocations]
+        assert eq_cc == eq_py
+
+
+def test_tally_thresh_ladder_reference_parity():
+    """The reference's own add_votes test ladder (round_votes.rs:107-132):
+    Init -> Init -> Any -> Value with total weight 4, identity-free."""
+    cc = N.NativeRoundVotes(height=1, round=0, total=4)
+    v = Vote(typ=VoteType.PREVOTE, round=0, value=None, validator=None)
+    assert cc.add_vote(v, 1).kind == ThreshKind.INIT
+    assert cc.add_vote(v, 1).kind == ThreshKind.INIT  # duplicate counts!
+    w = Vote(typ=VoteType.PREVOTE, round=0, value=7, validator=None)
+    assert cc.add_vote(w, 1).kind == ThreshKind.ANY   # 3*3 > 2*4 mixed
+    t = cc.add_vote(w, 1)
+    # nil=2, value7=2: seen 4 -> Any stays (no single bucket has quorum)
+    assert t.kind == ThreshKind.ANY
+    t = cc.add_vote(w, 1)
+    assert t.kind == ThreshKind.VALUE and t.value == 7  # 3*3 > 2*4
+
+
+def test_validator_set_parity():
+    keys = [ed.keypair(bytes([i]) * 32)[1] for i in range(6)]
+    entries = [(keys[i], i + 1) for i in range(6)]
+    shuffled = entries[:]
+    rng.shuffle(shuffled)
+    cc = N.NativeValidatorSet(shuffled + [shuffled[0]])  # dup dropped
+    assert len(cc) == 6
+    assert cc.total_power == sum(p for _, p in entries)
+    # sorted by pubkey
+    vals = cc.validators()
+    assert [pk for pk, _ in vals] == sorted(keys)
+    for pk, p in entries:
+        assert vals[cc.index_of(pk)] == (pk, p)
+    assert cc.index_of(b"\x00" * 32) == -1
+    # mutations
+    assert cc.update(keys[0], 100)
+    assert cc.total_power == sum(p for _, p in entries) - dict(entries)[keys[0]] + 100
+    assert cc.remove(keys[0])
+    assert len(cc) == 5
+    assert not cc.remove(keys[0])
+    cc.add(keys[0], 3)
+    assert len(cc) == 6
+    # hash changes with content, stable across construction order
+    h1 = cc.hash()
+    cc2 = N.NativeValidatorSet(cc.validators())
+    assert cc2.hash() == h1
+    cc2.update(keys[1], 50)
+    assert cc2.hash() != h1
+
+
+def test_proposer_rotation_parity():
+    """The C++ rotation must reproduce the Python ProposerRotation
+    sequence step for step — all planes must name the same proposer."""
+    from agnes_tpu.core.validators import ProposerRotation, Validator, \
+        ValidatorSet
+
+    keys = [ed.keypair(bytes([i + 30]) * 32)[1] for i in range(5)]
+    powers = [1, 2, 5, 1, 3]
+    py_set = ValidatorSet([Validator(pk, p) for pk, p in zip(keys, powers)])
+    py_rot = ProposerRotation(py_set)
+    cc_set = N.NativeValidatorSet(list(zip(keys, powers)))
+    cc_rot = N.NativeProposerRotation(cc_set)
+    seq_py = [py_rot.step() for _ in range(60)]
+    seq_cc = [cc_rot.step() for _ in range(60)]
+    assert seq_cc == seq_py
+    # weighted fairness over a full cycle
+    total = sum(powers)
+    counts = [0] * 5
+    for i in seq_py[:2 * total]:
+        counts[i] += 1
+    sorted_powers = [p for _, p in cc_set.validators()]
+    assert counts == [2 * p for p in sorted_powers]
+
+
+def test_duplicate_add_latest_wins():
+    """add() of an existing pubkey replaces the power (Python parity,
+    deterministic across libstdc++ versions)."""
+    keys = [ed.keypair(bytes([i + 50]) * 32)[1] for i in range(3)]
+    cc = N.NativeValidatorSet([(keys[0], 1), (keys[1], 2), (keys[2], 3)])
+    cc.add(keys[1], 99)
+    assert len(cc) == 3
+    assert dict(cc.validators())[keys[1]] == 99
+    # construction-time duplicates: last entry wins too
+    cc2 = N.NativeValidatorSet([(keys[0], 1), (keys[0], 7)])
+    assert cc2.validators() == [(keys[0], 7)]
+
+
+def test_equivocation_no_truncation():
+    """More than 1024 equivocating validators: every record survives."""
+    n = 1500
+    cc = N.NativeRoundVotes(height=1, round=0, total=n)
+    for v in range(n):
+        cc.add_vote(Vote(typ=VoteType.PREVOTE, round=0, value=1,
+                         validator=v), 1)
+        cc.add_vote(Vote(typ=VoteType.PREVOTE, round=0, value=2,
+                         validator=v), 1)
+    eq = cc.equivocations
+    assert len(eq) == n
+    assert {e.validator for e in eq} == set(range(n))
+
+
+@pytest.mark.parametrize("i", range(3))
+def test_native_ed25519_rfc_vectors(i):
+    from tests.test_ed25519_ref import VECTORS
+    seed_h, pub_h, msg_h, sig_h = VECTORS[i]
+    seed, pub = bytes.fromhex(seed_h), bytes.fromhex(pub_h)
+    msg, sig = bytes.fromhex(msg_h), bytes.fromhex(sig_h)
+    assert N.pubkey(seed) == pub
+    assert N.sign(seed, msg) == sig
+    assert N.verify(pub, msg, sig)
+
+
+def test_native_verify_batch_and_edge_cases():
+    seeds = [bytes([i + 1]) * 32 for i in range(6)]
+    msgs = [bytes([i]) * 45 for i in range(6)]
+    pks = [N.pubkey(s) for s in seeds]
+    sigs = [N.sign(s, m) for s, m in zip(seeds, msgs)]
+    # corrupt lane 2 (sig), lane 4 (wrong key)
+    sigs[2] = sigs[2][:3] + bytes([sigs[2][3] ^ 0x40]) + sigs[2][4:]
+    pks[4] = N.pubkey(b"\x99" * 32)
+    ok = N.verify_batch(pks, msgs, sigs)
+    assert ok == [True, True, False, True, False, True]
+    # oracle agreement on every lane
+    for i in range(6):
+        assert ok[i] == ed.verify(pks[i], msgs[i], sigs[i])
+    # malleable S rejected
+    s = int.from_bytes(sigs[0][32:], "little")
+    bad = sigs[0][:32] + (s + ed.L).to_bytes(32, "little")
+    assert not N.verify(pks[0], msgs[0], bad)
+    # empty batch
+    assert N.verify_batch([], [], []) == []
+
+
+def test_native_cross_verifies_python_and_jax_signatures():
+    """All three implementations interoperate on the same bytes."""
+    seed = bytes(range(32))
+    msg = b"m" * 45
+    assert N.verify(ed.keypair(seed)[1], msg, ed.sign(seed, msg))
+    assert ed.verify(N.pubkey(seed), msg, N.sign(seed, msg))
