@@ -24,3 +24,23 @@ from agnes_tpu.crypto.encoding import (  # noqa: F401
     proposal_signing_bytes,
     vote_signing_bytes,
 )
+
+
+def host_sign(seed: bytes, msg: bytes) -> bytes:
+    """Sign on the host: the C++ signer when the native build is
+    available, the Python oracle otherwise.  The single fallback policy
+    for every host-side consumer (executor, simulator, fixtures)."""
+    try:
+        from agnes_tpu.core import native
+        return native.sign(seed, msg)
+    except Exception:
+        return sign(seed, msg)
+
+
+def host_verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    """Verify on the host (C++ when available; see host_sign)."""
+    try:
+        from agnes_tpu.core import native
+        return native.verify(pk, msg, sig)
+    except Exception:
+        return verify(pk, msg, sig)

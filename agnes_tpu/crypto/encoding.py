@@ -26,14 +26,15 @@ VOTE_MSG_LEN = 45
 PROPOSAL_MSG_LEN = 49
 PROPOSAL_TAG = 0x50
 
-# nil votes sign value 0; real value ids are hashes/nonzero ids.  The
-# distinction lives in the vote's value field, not the signing bytes.
-NIL_WIRE = 0
+# nil votes sign the all-ones value field.  Value ids are < 2^31
+# (types.NIL_ID docs), so 2^256-1 can never collide with a real id —
+# signing nil as 0 would be forgeable against value id 0.
+NIL_WIRE = (1 << 256) - 1
 
 
 def vote_signing_bytes(height: int, round: int, typ: int,
                        value: int | None) -> bytes:
-    """Canonical 45-byte vote message (None value = nil -> 0)."""
+    """Canonical 45-byte vote message (None value = nil -> all-ones)."""
     v = NIL_WIRE if value is None else int(value)
     return (bytes([int(typ)])
             + int(height).to_bytes(8, "little")

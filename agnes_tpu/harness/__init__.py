@@ -1,0 +1,19 @@
+"""Event-stream simulation harness.
+
+The reference's entire test strategy is "the consumer fabricates the
+event stream" (README.md:8-14, :36-42; SURVEY.md §4): no cluster, no
+network, no timers — just scripted events.  This package extends that
+philosophy to both planes:
+
+  simulator.py      in-memory multi-node network over ConsensusExecutor
+                    (host plane), with Byzantine node behaviors.
+  device_driver.py  closed-loop driver for the fused device step:
+                    fabricates dense vote phases, routes the step's own
+                    output votes back in, reads decisions off the
+                    message stream.
+  configs.py        the five BASELINE.json benchmark configs, runnable
+                    as `python -m agnes_tpu.harness.configs N`.
+"""
+
+from agnes_tpu.harness.simulator import Network, NodeSpec  # noqa: F401
+from agnes_tpu.harness.device_driver import DeviceDriver  # noqa: F401

@@ -1,0 +1,207 @@
+"""The five BASELINE.json benchmark configs, runnable standalone.
+
+    python -m agnes_tpu.harness.configs <1..5> [--small]
+
+Each config returns a metrics dict (one JSON line on stdout).  The
+reference publishes no numbers (SURVEY.md §6); the comparison anchor is
+the north star: >= 1M Ed25519 verifies/sec/chip and 10k concurrent
+1000-validator instances.  `--small` shrinks shapes for CPU/test runs.
+
+  1. 4-validator single-height happy path — host executor network,
+     CPU parity (reference state_machine.rs:331-345 trace).
+  2. 100-validator prevote/precommit with Ed25519 batch verify —
+     the vote_executor path with real signatures.
+  3. 1000-validator multi-round with timeouts + nil prevotes —
+     the round_votes tally on device.
+  4. 10k parallel heights, vmapped — consensus_executor fuzz/throughput.
+  5. Byzantine equivocation sweep — 1M double-sign votes, on-device
+     slashing detection.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from agnes_tpu.types import VoteType
+
+
+def config1_happy_path(small: bool = False) -> dict:
+    """Host-plane parity: a 4-node network decides 20 heights; then raw
+    state-machine apply throughput (the reference's only benchmarkable
+    surface)."""
+    from agnes_tpu.core import state_machine as sm
+    from agnes_tpu.harness.simulator import Network
+
+    heights = 3 if small else 20
+    net = Network(n=4)
+    net.start()
+    t0 = time.perf_counter()
+    net.run_until(lambda: net.decided(heights - 1))
+    dt = time.perf_counter() - t0
+    for h in range(heights):
+        vals = set(net.decisions(h))
+        assert vals == {100 + h}, (h, vals)
+
+    # raw apply throughput (pure python transition fn)
+    s = sm.State.new(0)
+    ev = sm.Event.new_round()
+    n = 20_000 if small else 200_000
+    t1 = time.perf_counter()
+    for _ in range(n):
+        s2, _ = sm.apply(s, 0, ev)
+    apply_rate = n / (time.perf_counter() - t1)
+    return {"config": 1, "heights": heights,
+            "heights_per_sec": round(heights / dt, 2),
+            "host_applies_per_sec": round(apply_rate)}
+
+
+def config2_verify_100(small: bool = False) -> dict:
+    """100 validators, one height: every prevote+precommit is a real
+    Ed25519 signature, batch-verified on device (JAX) with the C++
+    verifier as cross-check, then tallied to decision."""
+    import jax
+    import numpy as np
+
+    from agnes_tpu.core import native
+    from agnes_tpu.core.state_machine import Step, State, Event
+    from agnes_tpu.core.vote_executor import VoteExecutor
+    from agnes_tpu.crypto import ed25519_jax as ejax
+    from agnes_tpu.crypto.encoding import vote_signing_bytes
+    from agnes_tpu.types import Vote
+
+    V = 8 if small else 100
+    value = 42
+    seeds = [bytes([i % 251 + 1, i // 251]) + bytes(30) for i in range(V)]
+    pks = [native.pubkey(s) for s in seeds]
+
+    msgs, sigs, votes = [], [], []
+    for typ in (VoteType.PREVOTE, VoteType.PRECOMMIT):
+        for i in range(V):
+            m = vote_signing_bytes(1, 0, int(typ), value)
+            msgs.append(m)
+            sigs.append(native.sign(seeds[i], m))
+            votes.append(Vote(typ=typ, round=0, value=value, validator=i,
+                              height=1))
+
+    pub, sig, blocks = ejax.pack_verify_inputs_host(pks + pks, msgs, sigs)
+    t0 = time.perf_counter()
+    ok = ejax.verify_batch_jit(pub, sig, blocks)
+    ok.block_until_ready()
+    compile_and_run = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    ok = ejax.verify_batch_jit(pub, sig, blocks)
+    ok.block_until_ready()
+    dt = time.perf_counter() - t1
+    assert bool(np.asarray(ok).all())
+    # C++ cross-check
+    assert native.verify_batch(pks + pks, msgs, sigs) == [True] * (2 * V)
+
+    # verified votes -> tally -> decision
+    state = State.new(1)
+    vx = VoteExecutor(height=1, total_weight=V)
+    state, _ = state.apply(0, Event.new_round_proposer(value))
+    state, _ = state.apply(0, Event.proposal(-1, value))
+    for v, valid in zip(votes, np.asarray(ok).tolist()):
+        if valid:
+            ev = vx.apply(v, 1)
+            if ev is not None:
+                state, msg = state.apply(0, ev)
+    assert state.step == Step.COMMIT
+    return {"config": 2, "validators": V,
+            "verifies_per_sec": round(2 * V / dt),
+            "first_call_s": round(compile_and_run, 2),
+            "decided": True}
+
+
+def config3_multiround(small: bool = False) -> dict:
+    """1000-validator tally, multi-round: round 0 times out on nil
+    votes, round 1 receives a proposal and decides."""
+    import numpy as np
+
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    I, V = (8, 64) if small else (256, 1000)
+    d = DeviceDriver(I, V, proposer_is_self=False)
+    t0 = time.perf_counter()
+    d.run_nil_round(0)
+    d.run_proposed_round(1, slot=1)
+    d.block_until_ready()
+    dt = time.perf_counter() - t0
+    assert d.all_decided()
+    assert (np.asarray(d.stats.decision_round) == 1).all()
+    return {"config": 3, "instances": I, "validators": V,
+            "rounds": 2, "votes_tallied": d.stats.votes_ingested,
+            "votes_per_sec": round(d.stats.votes_ingested / dt)}
+
+
+def config4_parallel_heights(small: bool = False) -> dict:
+    """10k concurrent instances x 1000 validators, vmapped — the north
+    star shape, honest path."""
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    I, V = (16, 32) if small else (10_000, 1000)
+    d = DeviceDriver(I, V)
+    # warmup/compile on the real shapes
+    d.run_honest_round(0)
+    d.block_until_ready()
+    d2 = DeviceDriver(I, V)
+    t0 = time.perf_counter()
+    d2.run_honest_round(0)
+    d2.block_until_ready()
+    dt = time.perf_counter() - t0
+    assert d2.all_decided()
+    votes = d2.stats.votes_ingested
+    return {"config": 4, "instances": I, "validators": V,
+            "votes_per_sec": round(votes / dt),
+            "decisions_per_sec": round(I / dt)}
+
+
+def config5_byzantine_sweep(small: bool = False) -> dict:
+    """Equivocation sweep: every validator double-signs in every
+    instance — 1M conflicting votes at full shape — and every one is
+    detected on device (the per-validator seen-record, SURVEY §2.3
+    fix 2), while the honest quorum still decides."""
+    import numpy as np
+
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    I, V = (8, 32) if small else (1000, 1000)
+    d = DeviceDriver(I, V)
+    t0 = time.perf_counter()
+    d.step()  # entry + self-proposal
+    # first prevote: everyone votes slot 1; then everyone re-votes
+    # conflicting slot 2 (double-sign)
+    expected = d.run_equivocation_phase(0, VoteType.PREVOTE, 1, 2, 1.0)
+    d.block_until_ready()
+    dt = time.perf_counter() - t0
+    det = d.equivocators_detected()
+    assert (det == expected).all(), (det[:4], expected)
+    # first votes kept counting: the polka for slot 1 still stands
+    d.step(phase=d.phase(0, VoteType.PRECOMMIT, 1))
+    assert d.all_decided()
+    double_signs = I * V
+    return {"config": 5, "instances": I, "validators": V,
+            "double_sign_votes": double_signs,
+            "detected_per_instance": int(det[0]),
+            "detect_votes_per_sec": round(2 * double_signs / dt),
+            "decided_despite_byzantine": True}
+
+
+CONFIGS = {1: config1_happy_path, 2: config2_verify_100,
+           3: config3_multiround, 4: config4_parallel_heights,
+           5: config5_byzantine_sweep}
+
+
+def main(argv=None) -> None:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv or argv[0] not in {str(k) for k in CONFIGS}:
+        print(__doc__)
+        raise SystemExit(2)
+    small = "--small" in argv
+    print(json.dumps(CONFIGS[int(argv[0])](small=small)))
+
+
+if __name__ == "__main__":
+    main()

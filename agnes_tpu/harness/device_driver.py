@@ -1,0 +1,195 @@
+"""Closed-loop driver for the fused device consensus step.
+
+Runs I independent consensus instances on device (SURVEY.md §2.7
+"instance parallelism"), with the harness playing the network: it
+fabricates the dense vote phases for the non-self validators according
+to a schedule, routes each instance's OWN output votes back into the
+next phase (self-votes take the same path as peer votes — the
+re-entrant intent of consensus_executor.rs:36-41), and collects
+decisions/timeouts off the message stream.
+
+Schedules express the §4(c) scenarios without a cluster:
+
+  honest                every validator votes the proposed value
+  nil_round             round r gets only nil votes + timeouts (the
+                        BASELINE config-3 multi-round path)
+  equivocation(frac)    a fraction of validators double-sign: two
+                        conflicting phases for the same (round, class)
+                        (BASELINE config 5; detection = tally.equiv)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agnes_tpu.core.state_machine import MsgTag
+from agnes_tpu.device.encoding import I32, DeviceState
+from agnes_tpu.device.step import (
+    ExtEvent,
+    NULL_EVENT,
+    VotePhase,
+    consensus_step_jit,
+)
+from agnes_tpu.device.tally import TallyConfig, TallyState
+from agnes_tpu.types import NIL_ID, VoteType
+from agnes_tpu.core.state_machine import EventTag
+
+
+@dataclass
+class DriverStats:
+    votes_ingested: int = 0
+    steps: int = 0
+    decided: Optional[np.ndarray] = None      # [I] bool
+    decision_value: Optional[np.ndarray] = None
+    decision_round: Optional[np.ndarray] = None
+
+
+class DeviceDriver:
+    """I instances x V validators on one device (or a mesh via the
+    sharded step; see parallel/)."""
+
+    def __init__(self, n_instances: int, n_validators: int,
+                 n_rounds: int = 4, n_slots: int = 4,
+                 proposer_is_self: bool = True):
+        self.I, self.V = n_instances, n_validators
+        self.cfg = TallyConfig(n_validators=n_validators, n_rounds=n_rounds,
+                               n_slots=n_slots)
+        self.state = DeviceState.new((self.I,))
+        self.tally = TallyState.new(self.I, self.cfg)
+        self.powers = jnp.ones((self.V,), I32)
+        self.total = jnp.asarray(self.V, I32)
+        # every instance's node proposes every round by default: the
+        # self-proposal stage then exercises the full propose path
+        self.proposer_flag = jnp.full((self.I, n_rounds),
+                                      proposer_is_self, bool)
+        self.propose_value = jnp.full((self.I,), 1, I32)
+        self.stats = DriverStats(
+            decided=np.zeros(self.I, bool),
+            decision_value=np.full(self.I, NIL_ID, np.int32),
+            decision_round=np.full(self.I, -1, np.int32))
+
+    # -- phase builders ------------------------------------------------------
+
+    def empty_phase(self) -> VotePhase:
+        return VotePhase(
+            round=jnp.zeros(self.I, I32),
+            typ=jnp.zeros(self.I, I32),
+            slots=jnp.full((self.I, self.V), NIL_ID, I32),
+            mask=jnp.zeros((self.I, self.V), bool))
+
+    def phase(self, round: int, typ: VoteType, slot: int,
+              frac: float = 1.0, offset: int = 0) -> VotePhase:
+        """Validators [offset, offset + frac*V) vote `slot` (NIL_ID for
+        nil) in `round` for class `typ` — same for every instance."""
+        k = int(round_half_up(frac * self.V))
+        idx = jnp.arange(self.V)
+        voters = (idx >= offset) & (idx < offset + k)
+        return VotePhase(
+            round=jnp.full(self.I, round, I32),
+            typ=jnp.full(self.I, int(typ), I32),
+            slots=jnp.where(voters[None, :], slot, NIL_ID).astype(I32)
+            * jnp.ones((self.I, 1), I32),
+            mask=jnp.broadcast_to(voters[None, :], (self.I, self.V)))
+
+    def ext(self, tag: int = NULL_EVENT, round: int = 0, value: int = NIL_ID,
+            pol_round: int = -1) -> ExtEvent:
+        return ExtEvent(
+            tag=jnp.full(self.I, tag, I32),
+            round=jnp.full(self.I, round, I32),
+            value=jnp.full(self.I, value, I32),
+            pol_round=jnp.full(self.I, pol_round, I32))
+
+    # -- stepping ------------------------------------------------------------
+
+    def step(self, ext: Optional[ExtEvent] = None,
+             phase: Optional[VotePhase] = None) -> "jnp.ndarray":
+        """One fused step; returns the stacked DeviceMessage batch."""
+        ext = ext if ext is not None else self.ext()
+        phase = phase if phase is not None else self.empty_phase()
+        out = consensus_step_jit(self.state, self.tally, ext, phase,
+                                 self.powers, self.total,
+                                 self.proposer_flag, self.propose_value)
+        self.state, self.tally = out.state, out.tally
+        self.stats.steps += 1
+        self.stats.votes_ingested += int(np.asarray(phase.mask).sum())
+        self._collect(out.msgs)
+        return out.msgs
+
+    def _collect(self, msgs) -> None:
+        tags = np.asarray(msgs.tag)            # [stages, I]
+        decided_now = (tags == int(MsgTag.DECISION)).any(axis=0)
+        if decided_now.any():
+            stage = (np.asarray(msgs.tag) == int(MsgTag.DECISION)).argmax(0)
+            rows = np.arange(self.I)
+            val = np.asarray(msgs.value)[stage, rows]
+            rnd = np.asarray(msgs.round)[stage, rows]
+            new = decided_now & ~self.stats.decided
+            self.stats.decision_value[new] = val[new]
+            self.stats.decision_round[new] = rnd[new]
+            self.stats.decided |= decided_now
+
+    # -- canned scenarios ----------------------------------------------------
+
+    def run_honest_round(self, round: int = 0, slot: int = 1) -> None:
+        """Drive one honest round to decision.  With proposer_is_self the
+        step's stages 5-6 produce the proposal + own prevote; the full
+        phases then deliver every validator's matching votes (the self
+        vote rides the dense phase like any peer vote)."""
+        self.step()  # round entry + self proposal -> instances prevote
+        self.step(phase=self.phase(round, VoteType.PREVOTE, slot))
+        self.step(phase=self.phase(round, VoteType.PRECOMMIT, slot))
+
+    def run_nil_round(self, round: int = 0) -> None:
+        """Round that times out (build with proposer_is_self=False: the
+        instance waits for a proposal that never comes): propose timeout
+        -> nil prevotes -> nil precommits -> precommit timeout -> the
+        instance moves to round + 1 (the config-3 multi-round path)."""
+        self.step()  # round entry: NEW_ROUND -> schedules timeout propose
+        self.step(ext=self.ext(int(EventTag.TIMEOUT_PROPOSE), round))
+        self.step(phase=self.phase(round, VoteType.PREVOTE, NIL_ID))
+        self.step(phase=self.phase(round, VoteType.PRECOMMIT, NIL_ID))
+        self.step(ext=self.ext(int(EventTag.TIMEOUT_PRECOMMIT), round))
+
+    def run_proposed_round(self, round: int = 0, slot: int = 1,
+                           pol_round: int = -1) -> None:
+        """Non-proposer instances receive a complete proposal and the
+        full honest vote phases for it."""
+        self.step()  # round entry (NEW_ROUND when not proposer)
+        self.step(ext=self.ext(int(EventTag.PROPOSAL), round, slot,
+                               pol_round))
+        self.step(phase=self.phase(round, VoteType.PREVOTE, slot))
+        self.step(phase=self.phase(round, VoteType.PRECOMMIT, slot))
+
+    def run_equivocation_phase(self, round: int, typ: VoteType,
+                               slot_a: int, slot_b: int,
+                               frac: float = 1.0) -> int:
+        """A fraction of validators vote slot_a then conflictingly
+        slot_b for the same (round, class).  Returns expected number of
+        newly flagged equivocators per instance."""
+        self.step(phase=self.phase(round, typ, slot_a, frac))
+        self.step(phase=self.phase(round, typ, slot_b, frac))
+        return int(round_half_up(frac * self.V))
+
+    def equivocators_detected(self) -> np.ndarray:
+        """[I] count of flagged validators per instance."""
+        return np.asarray(self.tally.equiv).sum(axis=1)
+
+    def all_decided(self, value: Optional[int] = None) -> bool:
+        if not bool(self.stats.decided.all()):
+            return False
+        if value is not None:
+            return bool((self.stats.decision_value == value).all())
+        return True
+
+    def block_until_ready(self):
+        jax.block_until_ready(self.state)
+        return self
+
+
+def round_half_up(x: float) -> int:
+    return int(np.floor(x + 0.5))

@@ -1,0 +1,151 @@
+"""In-memory multi-node network simulation (host plane).
+
+Drives N `ConsensusExecutor` nodes with a toy router: no sockets, no
+threads, a virtual clock — multi-node consensus exercised exactly the
+way the reference argues it should be (README.md:8-14: shrink the
+object graph; timeouts are injected events).  Byzantine behaviors are
+router policies + misbehaving signers layered on honest nodes:
+
+  silent        drops every outbound message (crash fault)
+  equivocator   additionally signs and sends a conflicting vote for a
+                different value to every peer (double-sign; feeds the
+                slashing surface, BASELINE config 5)
+  nil_flood     replaces own votes with nil votes (liveness attack)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace as dc_replace
+from typing import Callable, Dict, List, Optional, Sequence
+
+from agnes_tpu.core.executor import ConsensusExecutor, TimeoutConfig
+from agnes_tpu.core.round_votes import Equivocation
+from agnes_tpu.core.validators import Validator, ValidatorSet
+from agnes_tpu.crypto import ed25519_ref as ed
+from agnes_tpu.crypto import host_sign as _sign
+from agnes_tpu.crypto.encoding import vote_signing_bytes
+from agnes_tpu.types import Vote
+
+BEHAVIORS = ("honest", "silent", "equivocator", "nil_flood")
+
+
+@dataclass
+class NodeSpec:
+    behavior: str = "honest"
+    power: int = 1
+
+
+@dataclass
+class Network:
+    """N executors + router.  `specs[i].behavior` picks the fault model
+    for node i (indices are into the address-sorted validator set)."""
+
+    n: int = 4
+    specs: Optional[Sequence[NodeSpec]] = None
+    timeout_config: TimeoutConfig = field(default_factory=TimeoutConfig)
+    get_value: Callable[[int], int] = lambda h: 100 + h
+    verify_signatures: bool = True
+
+    def __post_init__(self):
+        specs = list(self.specs or [NodeSpec() for _ in range(self.n)])
+        assert len(specs) == self.n
+        seeds = [bytes([i + 1]) * 32 for i in range(self.n)]
+        keyed = sorted(zip([ed.keypair(s)[1] for s in seeds], seeds,
+                           range(self.n)))
+        # specs are re-indexed to sorted order so specs[i] matches node i
+        self.specs = [specs[orig] for _, _, orig in keyed]
+        self.seeds = [seed for _, seed, _ in keyed]
+        self.vset = ValidatorSet(
+            [Validator(pk, self.specs[i].power)
+             for i, (pk, _, _) in enumerate(keyed)])
+        self.nodes: List[ConsensusExecutor] = [
+            ConsensusExecutor(
+                self.vset, index=i, seed=self.seeds[i],
+                get_value=self.get_value,
+                timeout_config=self.timeout_config,
+                verify_signatures=self.verify_signatures)
+            for i in range(self.n)]
+        self._delivered = [0] * self.n
+        self.dropped = 0
+
+    # -- fault models -------------------------------------------------------
+
+    def _outbound(self, i: int, msg) -> List[object]:
+        """Apply node i's behavior to an outbound message."""
+        b = self.specs[i].behavior
+        if b == "silent":
+            self.dropped += 1
+            return []
+        if b == "equivocator" and isinstance(msg, Vote) \
+                and msg.value is not None:
+            other = msg.value + 1_000_000
+            sig = _sign(self.seeds[i], vote_signing_bytes(
+                msg.height, msg.round, int(msg.typ), other))
+            evil = dc_replace(msg, value=other, signature=sig)
+            return [msg, evil]
+        if b == "nil_flood" and isinstance(msg, Vote):
+            sig = _sign(self.seeds[i], vote_signing_bytes(
+                msg.height, msg.round, int(msg.typ), None))
+            return [dc_replace(msg, value=None, signature=sig)]
+        return [msg]
+
+    # -- driving ------------------------------------------------------------
+
+    def start(self) -> None:
+        for node in self.nodes:
+            node.start()
+
+    def step_router(self) -> bool:
+        """Deliver every pending outbox message; True if any moved."""
+        progress = False
+        for i, node in enumerate(self.nodes):
+            while self._delivered[i] < len(node.outbox):
+                msg = node.outbox[self._delivered[i]]
+                self._delivered[i] += 1
+                progress = True
+                for out in self._outbound(i, msg):
+                    for j, other in enumerate(self.nodes):
+                        if j != i:
+                            other.execute(out)
+        return progress
+
+    def advance_time(self, to: float) -> None:
+        for i, node in enumerate(self.nodes):
+            if self.specs[i].behavior != "silent":
+                node.advance_time(to)
+
+    def run_until(self, pred: Callable[[], bool], max_iters: int = 500,
+                  time_step: float = 5.0) -> None:
+        """Route until `pred()`; when the network quiesces without
+        progress, advance the virtual clock (fires timeouts)."""
+        t = 0.0
+        for _ in range(max_iters):
+            if pred():
+                return
+            if not self.step_router():
+                t += time_step
+                self.advance_time(t)
+                if not self.step_router() and pred():
+                    return
+        raise AssertionError("network did not reach the predicate")
+
+    def honest_nodes(self) -> List[ConsensusExecutor]:
+        return [n for i, n in enumerate(self.nodes)
+                if self.specs[i].behavior != "silent"]
+
+    def decided(self, height: int) -> bool:
+        return all(height in n.decided for n in self.honest_nodes())
+
+    def decisions(self, height: int) -> List[int]:
+        return [n.decided[height].value for n in self.honest_nodes()]
+
+    def equivocations(self) -> Dict[int, List[Equivocation]]:
+        """Evidence collected per honest node index (all heights)."""
+        out = {}
+        for i, n in enumerate(self.nodes):
+            if self.specs[i].behavior == "silent":
+                continue
+            ev = n.all_equivocations()
+            if ev:
+                out[i] = ev
+        return out

@@ -1,0 +1,231 @@
+"""ConsensusExecutor: multi-node simulation through the public API.
+
+A toy in-memory router replaces the network (the reference's testing
+philosophy: the consumer fabricates the message stream, README.md:8-14)
+— no cluster needed to exercise multi-node consensus, timeouts, round
+skips, height advance, and Byzantine rejection.
+"""
+
+import pytest
+
+from agnes_tpu.core import state_machine as sm
+from agnes_tpu.core.executor import (
+    ConsensusExecutor,
+    TimeoutConfig,
+    WireProposal,
+    WireTimeout,
+)
+from agnes_tpu.core.validators import Validator, ValidatorSet
+from agnes_tpu.crypto import ed25519_ref as ed
+from agnes_tpu.types import Vote
+
+
+def make_net(n=4, verify=True, start_height=0):
+    seeds = [bytes([i + 1]) * 32 for i in range(n)]
+    pairs = sorted(zip([ed.keypair(s)[1] for s in seeds], seeds))
+    vset = ValidatorSet([Validator(pk, 1) for pk, _ in pairs])
+    nodes = []
+    for i, (pk, seed) in enumerate(pairs):
+        nodes.append(ConsensusExecutor(
+            vset, index=i, seed=seed,
+            get_value=lambda h: 100 + h,
+            start_height=start_height,
+            verify_signatures=verify))
+    return nodes
+
+
+def route(nodes, drop=lambda sender, msg: False, max_iters=200,
+          until=lambda: False):
+    """Deliver every outbox message to every *other* node until the
+    network is quiescent or `until()` holds.  (A healthy network never
+    quiesces on its own — each decision starts the next height.)"""
+    delivered = [0] * len(nodes)
+    for _ in range(max_iters):
+        if until():
+            return
+        progress = False
+        for i, node in enumerate(nodes):
+            while delivered[i] < len(node.outbox):
+                msg = node.outbox[delivered[i]]
+                delivered[i] += 1
+                progress = True
+                if drop(i, msg):
+                    continue
+                for j, other in enumerate(nodes):
+                    if j != i:
+                        other.execute(msg)
+        if not progress:
+            return
+    raise AssertionError("network did not quiesce")
+
+
+def test_happy_path_multi_height():
+    nodes = make_net(4)
+    for node in nodes:
+        node.start()
+    # drive until three consecutive heights decided everywhere
+    route(nodes, until=lambda: all(2 in n.decided for n in nodes))
+    for target_height in range(3):
+        for node in nodes:
+            d = node.decided[target_height]
+            assert d.value == 100 + target_height
+            assert d.round == 0
+    assert all(n.height >= 3 for n in nodes)
+
+
+def test_unsigned_and_forged_votes_rejected():
+    nodes = make_net(4)
+    for node in nodes:
+        node.start()
+    victim = nodes[0]
+    before = victim.votes.votes.round(0).prevotes.seen_weight()
+    # unsigned vote claiming validator 2
+    victim.execute(Vote.new_prevote(0, 55, validator=2, height=0))
+    # forged: signed by the wrong key
+    wrong_seed = b"\xAA" * 32
+    from agnes_tpu.crypto.encoding import vote_signing_bytes
+    sig = ed.sign(wrong_seed, vote_signing_bytes(0, 0, 0, 55))
+    victim.execute(Vote.new_prevote(0, 55, validator=2, height=0,
+                                    signature=sig))
+    after = victim.votes.votes.round(0).prevotes.seen_weight()
+    assert after == before  # neither vote reached the tally
+
+
+def test_identity_free_votes_dropped_when_verifying():
+    """A verifying executor must not tally anonymous weight-1 votes —
+    they would let an attacker forge a quorum for any value."""
+    nodes = make_net(4)
+    node = nodes[0]
+    node.start()
+    for typ_ctor in (Vote.new_prevote, Vote.new_precommit):
+        for _ in range(4):
+            node.execute(typ_ctor(0, 666, height=0))
+    assert 0 not in node.decided
+    assert node.votes.votes.round(0).prevotes.seen_weight() <= 1  # own vote
+
+
+def test_malformed_wire_fields_do_not_crash():
+    """Out-of-range ints from Byzantine peers are dropped, not raised."""
+    nodes = make_net(4)
+    node = nodes[0]
+    node.start()
+    bad_votes = [
+        Vote.new_prevote(0, -1, validator=0, height=0, signature=b"x" * 64),
+        Vote.new_prevote(0, 2**256, validator=0, height=0,
+                         signature=b"x" * 64),
+        Vote.new_prevote(-5, 1, validator=0, height=0, signature=b"x" * 64),
+        Vote.new_prevote(2**40, 1, validator=0, height=0,
+                         signature=b"x" * 64),
+    ]
+    for v in bad_votes:
+        node.execute(v)  # must not raise
+    node.execute(WireProposal(height=0, round=0, value=-7, pol_round=-1,
+                              proposer=1, signature=b"x" * 64))
+    node.execute(WireProposal(height=0, round=2**40, value=1, pol_round=-9,
+                              proposer=99, signature=b"x" * 64))
+    assert 0 not in node.decided
+
+
+def test_config_cli_rejects_bad_args():
+    from agnes_tpu.harness.configs import main
+    for bad in ([], ["12"], ["0"], ["x"]):
+        with pytest.raises(SystemExit):
+            main(bad)
+
+
+def test_byzantine_proposer_prevotes_nil():
+    """A proposal from the wrong claimed proposer (or with a bad sig)
+    produces ProposalInvalid -> the node prevotes nil."""
+    nodes = make_net(4)
+    node = nodes[0]
+    node.start()
+    r0_proposer = node.proposer(0, 0)
+    wrong = (r0_proposer + 1) % 4
+    if node.index == r0_proposer:
+        node = nodes[1]
+        node.start()
+    node.execute(WireProposal(height=0, round=0, value=55, pol_round=-1,
+                              proposer=wrong, signature=b"\x00" * 64))
+    nil_prevotes = [m for m in node.outbox
+                    if isinstance(m, Vote) and m.value is None]
+    assert len(nil_prevotes) == 1
+
+
+def test_timeout_round_advances_and_decides_in_round_1():
+    """Silent proposer in round 0: everyone times out propose, prevotes
+    nil, precommits nil, times out precommit, moves to round 1 and
+    decides there."""
+    nodes = make_net(4)
+    for node in nodes:
+        node.start()
+    r0_proposer_idx = nodes[0].proposer(0, 0)
+
+    def drop(sender, msg):
+        # proposer is mute in round 0 (its proposal AND its votes)
+        if isinstance(msg, WireProposal):
+            return msg.round == 0
+        if isinstance(msg, Vote):
+            return msg.validator == r0_proposer_idx and msg.round == 0
+        return False
+
+    # nobody hears a proposal; drive clocks until decision
+    silent = nodes[r0_proposer_idx]
+    done = lambda: all(0 in n.decided for n in nodes  # noqa: E731
+                       if n is not silent)
+    for t in (5.0, 10.0, 20.0, 40.0):
+        for i, node in enumerate(nodes):
+            if node is not silent:
+                node.advance_time(t)
+        route(nodes, drop=drop, until=done)
+        if done():
+            break
+    for node in nodes:
+        if node is silent:
+            continue
+        d = node.decided[0]
+        assert d.round >= 1
+        assert d.value == 100
+
+
+def test_decision_is_unanimous_and_consistent_under_reordering():
+    """Shuffled delivery order still yields one decision value."""
+    import random
+    rng = random.Random(3)
+    nodes = make_net(4)
+    for node in nodes:
+        node.start()
+    # collect and deliver in random order, repeatedly
+    for _ in range(50):
+        pending = []
+        for i, node in enumerate(nodes):
+            for msg in node.outbox:
+                pending.append((i, msg))
+        rng.shuffle(pending)
+        for i, msg in pending:
+            for j, other in enumerate(nodes):
+                if j != i:
+                    other.execute(msg)
+        if all(0 in n.decided for n in nodes):
+            break
+    values = {n.decided[0].value for n in nodes}
+    assert values == {100}
+
+
+def test_timer_wheel_ordering():
+    from agnes_tpu.core.executor import TimerWheel
+    w = TimerWheel()
+    t1 = WireTimeout(0, 0, sm.TimeoutStep.PROPOSE)
+    t2 = WireTimeout(0, 1, sm.TimeoutStep.PREVOTE)
+    w.schedule(5.0, t2)
+    w.schedule(1.0, t1)
+    assert w.next_deadline() == 1.0
+    assert w.advance(0.5) == []
+    assert w.advance(1.0) == [t1]
+    assert w.advance(10.0) == [t2]
+    assert w.next_deadline() is None
+
+
+def test_timeout_config_escalates():
+    cfg = TimeoutConfig(propose=3.0, delta=0.5)
+    assert cfg.duration(sm.TimeoutStep.PROPOSE, 0) == 3.0
+    assert cfg.duration(sm.TimeoutStep.PROPOSE, 4) == 5.0

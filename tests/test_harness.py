@@ -1,0 +1,78 @@
+"""Harness: Byzantine network simulations + the five configs (small)."""
+
+import numpy as np
+import pytest
+
+from agnes_tpu.harness.configs import CONFIGS
+from agnes_tpu.harness.device_driver import DeviceDriver
+from agnes_tpu.harness.simulator import Network, NodeSpec
+from agnes_tpu.types import VoteType
+
+
+def test_network_with_silent_node_still_decides():
+    """3 of 4 honest is exactly +2/3: consensus proceeds via timeouts."""
+    net = Network(n=4, specs=[NodeSpec(), NodeSpec(), NodeSpec(),
+                              NodeSpec(behavior="silent")])
+    net.start()
+    net.run_until(lambda: net.decided(0))
+    assert set(net.decisions(0)) == {100}
+    assert net.dropped > 0
+
+
+def test_network_equivocator_detected_and_consensus_holds():
+    net = Network(n=4, specs=[NodeSpec(behavior="equivocator"),
+                              NodeSpec(), NodeSpec(), NodeSpec()])
+    net.start()
+    net.run_until(lambda: net.decided(0))
+    assert set(net.decisions(0)) == {100}
+    ev = net.equivocations()
+    assert ev, "double-sign evidence must be collected"
+    flagged = {e.validator for evs in ev.values() for e in evs}
+    # the equivocator's sorted index is the only flagged validator
+    eq_idx = [i for i, s in enumerate(net.specs)
+              if s.behavior == "equivocator"]
+    assert flagged == set(eq_idx)
+
+
+def test_network_nil_flooder_delays_but_does_not_block():
+    net = Network(n=4, specs=[NodeSpec(behavior="nil_flood"),
+                              NodeSpec(), NodeSpec(), NodeSpec()])
+    net.start()
+    net.run_until(lambda: net.decided(0))
+    assert set(net.decisions(0)) == {100}
+
+
+def test_device_driver_honest_round():
+    d = DeviceDriver(n_instances=4, n_validators=8)
+    d.run_honest_round(0, slot=1)
+    assert d.all_decided()
+    assert (np.asarray(d.stats.decision_value) == 1).all()
+    assert (np.asarray(d.stats.decision_round) == 0).all()
+
+
+def test_device_driver_nil_then_decide():
+    d = DeviceDriver(n_instances=4, n_validators=8, proposer_is_self=False)
+    d.run_nil_round(0)
+    assert not d.stats.decided.any()
+    assert (np.asarray(d.state.round) == 1).all()
+    d.run_proposed_round(1, slot=2)
+    assert d.all_decided(value=2)
+    assert (np.asarray(d.stats.decision_round) == 1).all()
+
+
+def test_device_driver_equivocation_detection():
+    d = DeviceDriver(n_instances=3, n_validators=8)
+    d.step()
+    expected = d.run_equivocation_phase(0, VoteType.PREVOTE, 1, 2, frac=0.5)
+    det = d.equivocators_detected()
+    assert (det == expected).all()
+    # honest completion: first votes still count
+    d.step(phase=d.phase(0, VoteType.PREVOTE, 1, frac=1.0))
+    d.step(phase=d.phase(0, VoteType.PRECOMMIT, 1))
+    assert d.all_decided(value=1)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5])
+def test_configs_small(n):
+    out = CONFIGS[n](small=True)
+    assert out["config"] == n
