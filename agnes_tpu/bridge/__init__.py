@@ -1,0 +1,21 @@
+"""Host <-> device bridge: the vote-batch ingestion ABI.
+
+The reference's L4/L6 boundary ("the consumer is responsible for
+networking... and deciding when received messages constitute an Event",
+README.md:46-49) is exactly where the TPU/host boundary goes (SURVEY.md
+§1).  This package is that boundary's host side:
+
+  value_table.py  payload <-> 31-bit value id interning (types.py:
+                  values on device are fixed-width ids; arbitrary
+                  payloads live here), plus the per-instance dense
+                  slot mapping the tally kernels index by.
+  ingest.py       VoteBatcher: sparse signed wire votes in, batched
+                  signature verification + dense per-(round, class)
+                  VotePhase matrices out.
+
+The device side of the ABI is device/step.py's VotePhase/ExtEvent and
+the validator table from ValidatorSet.device_arrays().
+"""
+
+from agnes_tpu.bridge.ingest import VoteBatcher, WireVote  # noqa: F401
+from agnes_tpu.bridge.value_table import SlotMap, ValueTable  # noqa: F401

@@ -1,0 +1,169 @@
+"""VoteBatcher: sparse signed wire votes -> dense device phases.
+
+The ingestion path of the north star: wire votes carrying (instance,
+validator, round, class, value, signature) are batch-verified (JAX
+Ed25519 data plane; C++ fallback) and densified into the [I, V]
+VotePhase matrices the fused step consumes.  Votes that share an
+(instance, validator, round, class) cell cannot ride one dense matrix,
+so the batcher *layers* them: layer k holds each cell's k-th vote —
+conflicting (equivocating) votes land in later layers and still reach
+the device, where the tally's seen-record flags the double-sign.
+
+The reference's analogue is the one-vote-at-a-time
+`VoteExecutor::apply` loop (vote_executor.rs:20-23, SURVEY §3.2); this
+is that loop turned into a batched device pipeline.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from agnes_tpu.bridge.value_table import SlotMap
+from agnes_tpu.crypto.encoding import vote_signing_bytes
+from agnes_tpu.device.step import VotePhase
+from agnes_tpu.device.tally import VOTED_NIL
+from agnes_tpu.types import NIL_ID, VoteType
+
+
+@dataclass(frozen=True)
+class WireVote:
+    """One signed vote addressed to a consensus instance."""
+
+    instance: int
+    validator: int
+    height: int
+    round: int
+    typ: VoteType
+    value: Optional[int]       # None = nil
+    signature: Optional[bytes] = None
+
+
+class VoteBatcher:
+    """Collects wire votes for one ingestion tick and emits dense
+    phases.  One batcher per (driver, height window)."""
+
+    def __init__(self, n_instances: int, n_validators: int, n_slots: int,
+                 heights: Optional[np.ndarray] = None):
+        self.I, self.V = n_instances, n_validators
+        self.slots = SlotMap(n_instances, n_slots)
+        # per-instance height (defaults: all at height 0)
+        self.heights = (heights if heights is not None
+                        else np.zeros(n_instances, np.int64))
+        self._pending: List[WireVote] = []
+        self.rejected_signature = 0
+        self.rejected_malformed = 0
+        self.overflow_votes: List[WireVote] = []
+
+    def add(self, vote: WireVote) -> None:
+        self._pending.append(vote)
+
+    def extend(self, votes) -> None:
+        self._pending.extend(votes)
+
+    # -- signature verification ---------------------------------------------
+
+    def _verify_batch(self, votes: List[WireVote],
+                      pubkeys: np.ndarray) -> List[bool]:
+        """Batch-verify on the JAX plane; pubkeys [V, 32] uint8 is the
+        device-resident validator table (ValidatorSet.device_arrays)."""
+        from agnes_tpu.crypto import ed25519_jax as ejax
+
+        pks, msgs, sigs = [], [], []
+        for v in votes:
+            pks.append(pubkeys[v.validator].tobytes())
+            msgs.append(vote_signing_bytes(v.height, v.round, int(v.typ),
+                                           v.value))
+            sigs.append(v.signature or b"\x00" * 64)
+        pub, sig, blocks = ejax.pack_verify_inputs_host(pks, msgs, sigs)
+        ok = ejax.verify_batch_jit(pub, sig, blocks)
+        return np.asarray(ok).tolist()
+
+    # -- densification -------------------------------------------------------
+
+    def build_phases(self, pubkeys: Optional[np.ndarray] = None
+                     ) -> List[Tuple[VotePhase, int]]:
+        """Drain pending votes into dense phases.
+
+        Returns [(phase, n_votes)], one per (round, class, layer),
+        deterministic order.  With `pubkeys` given, signatures are
+        batch-verified first and failures dropped (and counted)."""
+        votes, self._pending = self._pending, []
+        keep = []
+        for v in votes:
+            if not (0 <= v.instance < self.I and 0 <= v.validator < self.V
+                    and v.round >= 0
+                    and (v.value is None or 0 <= v.value < 2**31)
+                    and v.height == self.heights[v.instance]):
+                self.rejected_malformed += 1
+                continue
+            keep.append(v)
+        if pubkeys is not None and keep:
+            ok = self._verify_batch(keep, pubkeys)
+            self.rejected_signature += len(keep) - sum(ok)
+            keep = [v for v, good in zip(keep, ok) if good]
+
+        # exact-duplicate dedup: gossip redelivery of the same vote must
+        # not burn a whole dense layer (the device tally would no-op it
+        # anyway, but each layer is a full [I, V] fused step)
+        seen_exact = set()
+        deduped = []
+        for v in keep:
+            key = (v.instance, v.validator, v.round, int(v.typ), v.value)
+            if key in seen_exact:
+                continue
+            seen_exact.add(key)
+            deduped.append(v)
+        keep = deduped
+
+        # group by (round, typ); layer repeated (instance, validator)
+        groups: Dict[Tuple[int, int], List[List[WireVote]]] = \
+            defaultdict(list)
+        depth: Dict[Tuple[int, int, int, int], int] = defaultdict(int)
+        for v in keep:
+            gk = (v.round, int(v.typ))
+            ck = (v.instance, v.validator, v.round, int(v.typ))
+            layer = depth[ck]
+            depth[ck] += 1
+            layers = groups[gk]
+            while len(layers) <= layer:
+                layers.append([])
+            layers[layer].append(v)
+
+        phases: List[Tuple[VotePhase, int]] = []
+        for (rnd, typ) in sorted(groups):
+            for layer_votes in groups[(rnd, typ)]:
+                slots = np.full((self.I, self.V), VOTED_NIL, np.int32)
+                mask = np.zeros((self.I, self.V), bool)
+                n = 0
+                for v in layer_votes:
+                    if v.value is None:
+                        slot = VOTED_NIL
+                    else:
+                        s = self.slots.slot_for(v.instance, v.value)
+                        if s is None:
+                            self.overflow_votes.append(v)
+                            continue
+                        slot = s
+                    slots[v.instance, v.validator] = slot
+                    mask[v.instance, v.validator] = True
+                    n += 1
+                if n == 0:
+                    continue
+                phases.append((VotePhase(
+                    round=jnp.full(self.I, rnd, jnp.int32),
+                    typ=jnp.full(self.I, typ, jnp.int32),
+                    slots=jnp.asarray(slots),
+                    mask=jnp.asarray(mask)), n))
+        return phases
+
+    def decode_slot(self, instance: int, slot: int) -> Optional[int]:
+        """Device slot -> value id (for reading decisions back)."""
+        if slot == NIL_ID:
+            return None
+        return self.slots.value_for(instance, slot)

@@ -1,26 +1,31 @@
-"""GF(2^255 - 19) limb arithmetic in JAX, int32-only.
+"""GF(2^255 - 19) limb arithmetic in JAX, int32-only, *signed* limbs.
 
 Representation chosen for the TPU's 32-bit vector unit: 20 little-endian
-limbs of 13 bits (radix 2^13, 260 bits of headroom).  The bounds work
-out so that *no intermediate ever leaves int32*:
+limbs of 13 bits (radix 2^13, 260 bits of headroom), each limb a SIGNED
+int32 kept in [-8800, 8800] ("weak" range).  Signed limbs buy two
+things: subtraction is simply `carry(a - b)` — no 64p offset — and the
+carry normalizer is a handful of *vectorized whole-limb-axis passes*
+(mask, shift, roll, add) instead of a 20-step sequential chain.  That
+matters because the double-scalar-mult scan body executes ~19 field
+muls per bit, and on TPU the runtime is dominated by op dispatch, not
+FLOPs: the sequential chains made verification ~25x slower.
 
-  - schoolbook product terms: (2^13-1)^2 < 2^26
-  - a product column sums at most 20 terms: < 20 * 2^26 < 2^31
-  - the high product half is carry-normalized to 13-bit limbs *before*
-    the mod-p fold, so the fold multiplier 608 = 19 * 2^5 (from
-    2^260 = 2^5 * 2^255 = 32 * 2^255 === 32*19 mod p) stays < 2^23.
+Bounds (everything stays in int32):
 
-Elements are kept *partially reduced* — limbs < 2^13, value < 2^260,
-possibly >= p — through all arithmetic; `freeze` produces the canonical
-value only for compares/encodings.  Subtraction adds 64p (spread across
-limbs so every limb of the constant is >= 6976) before the carry chain,
-which keeps totals positive for any pair of partially-reduced inputs;
-signed int32 carries (arithmetic shift) absorb the per-limb slack.
+  - schoolbook product terms: 8800^2 < 2^27; column sums of <= 20
+    terms: 20 * 8800^2 < 1.55e9 < 2^31 (sign-magnitude, signed-safe)
+  - one vectorized carry pass maps per-limb bound M to
+    8191 + M/2^13 + 1, converging to ~8193 in 3-4 passes from 2^30;
+    the top limb's wrap folds into limb 0 times 608 = 2^260 mod p
+  - mul normalizes the 21-limb high half before scaling by 608; its
+    top limb is bounded by value >> 260 <= 2^6, so the 2^260 === 608
+    double-fold term 608*608*h20 also fits int32.
 
-The batch axis is leading and everything is elementwise or a contraction
-against small constant matrices, so `jit(vmap(...))` vectorizes cleanly;
-the column sums of `mul` are a [.., 400] x [400, 39] constant matmul XLA
-can put on the MXU.
+Values are partially reduced (|value| < 2^260.1, any residue class);
+`freeze` adds 64p, exact-normalizes and canonicalizes to [0, p) for
+compares/encodings only.  The batch axis is leading and everything is
+elementwise or a contraction against small constant matrices, so
+`jit(vmap(...))` vectorizes cleanly.
 
 Oracle: `ed25519_ref` (plain Python ints); see tests/test_field_jax.py.
 """
@@ -41,8 +46,8 @@ NLIMBS = 20                # 260 bits
 P = 2**255 - 19
 FOLD = 608                 # 2^260 mod p = 32 * 19
 
-# 64p = 2^261 - 1216, spread so every limb is a valid 13-bit-ish positive
-# constant: limb0 = 8192-1216, limbs 1..18 = 8191, limb19 = 2^14 - 1.
+# 64p = 2^261 - 1216 spread over 20 limbs (limb19 oversized at 2^14-1):
+# freeze adds it to make signed values positive before exact reduction.
 _SUB_K = np.full(NLIMBS, LMASK, np.int32)
 _SUB_K[0] = RADIX - 1216
 _SUB_K[NLIMBS - 1] = (1 << 14) - 1
@@ -130,65 +135,89 @@ def _carry_chain(r: jnp.ndarray):
     return jnp.stack(outs, axis=-1), c
 
 
-def carry(r: jnp.ndarray) -> jnp.ndarray:
-    """Normalize [..., NLIMBS] int32 columns (|col| < 2^30, total value
-    non-negative) to *weakly* normalized limbs in [0, 2^13 + 16),
-    preserving the value mod p.
+def _vpass(r: jnp.ndarray, fold: int | None = FOLD) -> jnp.ndarray:
+    """One vectorized carry pass over the whole limb axis: ~5 ops, no
+    sequential chain.  value(out) == value(in) exactly (fold=None — the
+    top limb is left intact so nothing is shifted off the end) or mod p
+    (fold wraps the top limb's carry into limb 0 as carry * fold).
 
-    One signed chain, a *608 wrap fold into limb 0, and a 3-step
-    ripple.  This is the hot-path normalizer: weak limbs are safe for
-    every field op (products (2^13+16)^2 * 20 terms still fit int32;
-    `sub`'s 64p spread still dominates per-limb), and the boundaries
-    that need strict limbs (compares, byte packing) go through
-    `strict_carry`/`freeze`.  Bounds: the wrap carry c1 <= 2^19, so the
-    fold adds < 2^28 to limb 0; rippling limbs 0..2 then leaves limbs
-    1..3 within +16 of 2^13.  Callers must keep the total non-negative
-    (`sub` adds 64p for exactly this reason)."""
-    r, c = _carry_chain(r)
-    r = r.at[..., 0].add(FOLD * c)
-    for k in range(3):
-        t = r[..., k]
-        r = r.at[..., k].set(t & LMASK)
-        r = r.at[..., k + 1].add(t >> BITS)
+    Works for signed limbs: `& LMASK` keeps the two's-complement low
+    bits and the arithmetic `>> BITS` carries the signed remainder, so
+    lo + (hi << 13) reconstructs the input limb exactly.  With per-limb
+    bound M in, the non-top out bound is 8191 + M/2^13 + 1 — a few
+    passes converge to ~8.2k regardless of M."""
+    lo = r & LMASK
+    hi = r >> BITS                 # arithmetic shift: signed carries
+    shift_in = jnp.concatenate(
+        [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
+    if fold is None:
+        # exact mode: the top limb keeps its full value (not masked,
+        # nothing shifted off the end), still receives the carry below
+        lo = jnp.concatenate([lo[..., :-1], r[..., -1:]], axis=-1)
+        return lo + shift_in
+    return lo + shift_in.at[..., 0].add(hi[..., -1] * fold)
+
+
+def carry(r: jnp.ndarray, passes: int = 4) -> jnp.ndarray:
+    """Normalize [..., NLIMBS] signed int32 columns (|col| < 2^31 / 20)
+    to weak limbs (|limb| <= 8208), preserving the value mod p.
+
+    Vectorized passes only — the hot-path normalizer inside the
+    double-scalar-mult scan.  4 passes handle |col| up to ~2^30 (mul
+    output, including the fold's 608 * 2^17 landing on limb 0); callers
+    with small inputs (add/sub: |col| < 2^15) may pass `passes=2`.
+    Limbs may end negative (bounded ~-1300 via the final pass's fold on
+    limb 0, tiny elsewhere); all consumers are bound-safe under
+    |limb| <= 8800; exact non-negative limbs come from
+    `strict_carry`/`freeze` at the boundaries."""
+    for _ in range(passes):
+        r = _vpass(r)
     return r
 
 
 def strict_carry(r: jnp.ndarray) -> jnp.ndarray:
-    """Full normalization to limbs in [0, 2^13): three (chain + wrap
-    fold) passes.  Pass-1's wrap carry is <= 2^19; each chain masks
-    limbs below 2^13 so passes 2-3 see wrap carries <= 1, and when the
-    last chain still carries, the residual value is <= 607 so the final
-    fold cannot push limb 0 back over 2^13."""
+    """Exact normalization to limbs in [0, 2^13).  Vectorized passes
+    first (cheap convergence to ~[-2, 8193]), then one sequential
+    signed chain with wrap fold; the chain's outputs are masked
+    non-negative and its final wrap is <= 1 with a tiny limb 0, so one
+    fold cannot overflow.  Caller must guarantee the total VALUE is
+    non-negative (freeze adds 64p first for exactly that)."""
     for _ in range(3):
-        r, c = _carry_chain(r)
-        r = r.at[..., 0].add(FOLD * c)
-    return r
+        r = _vpass(r)
+    r, c = _carry_chain(r)
+    r = r.at[..., 0].add(FOLD * c)
+    r, c2 = _carry_chain(r)       # clears any ripple from the fold
+    return r.at[..., 0].add(FOLD * c2)
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return carry(a + b)
+    return carry(a + b, passes=2)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return carry(a - b + SUB_K)
+    """Signed limbs make subtraction offset-free (no 64p constant)."""
+    return carry(a - b, passes=2)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Field multiply: outer product, column sums via the constant
-    COLSUM contraction, high-half carry, *608 fold, carry."""
-    prod = a[..., :, None] * b[..., None, :]           # [..., 20, 20] < 2^26
+    COLSUM contraction, vectorized high-half normalize, *608 fold,
+    vectorized carry."""
+    prod = a[..., :, None] * b[..., None, :]        # [..., 20, 20] < 2^27
     flat = prod.reshape(prod.shape[:-2] + (NLIMBS * NLIMBS,))
-    cols = flat @ COLSUM                               # [..., 39] < 2^31
+    cols = flat @ COLSUM                            # [..., 39] |.| < 2^31
     lo, hi = cols[..., :NLIMBS], cols[..., NLIMBS:]
-    # normalize the high half to 13-bit limbs before scaling by 608
-    c = jnp.zeros_like(hi[..., 0])
-    hl = []
-    for k in range(_COLS - NLIMBS):
-        t = hi[..., k] + c
-        hl.append(t & LMASK)
-        c = t >> BITS
-    hi_n = jnp.stack(hl + [c], axis=-1)                # [..., 20] < 2^13 (+c)
-    return carry(lo + FOLD * hi_n)
+    # high half as its own 21-limb number (|value| < 2^266 -> top limb
+    # after normalization is |h20| <= 2^6 + eps)
+    hi = jnp.concatenate(
+        [hi, jnp.zeros(hi.shape[:-1] + (2,), I32)], axis=-1)
+    for _ in range(3):
+        hi = _vpass(hi, fold=None)                  # internal, no wrap
+    # product === lo + 608*HI; HI's limb 20 sits at 2^260 === 608, so it
+    # contributes 608*608*h20 to limb 0 (|.| <= 2^25)
+    r = lo + FOLD * hi[..., :NLIMBS]
+    r = r.at[..., 0].add((FOLD * FOLD) * hi[..., NLIMBS])
+    return carry(r)
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
@@ -225,10 +254,14 @@ def inv(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def freeze(a: jnp.ndarray) -> jnp.ndarray:
-    """Canonical representative in [0, p) with strict limbs.  After
-    strict normalization the value is < 2^260 < 33p, so branch-free
-    conditional subtraction of 16p, 8p, 4p, 2p, p, p reduces it."""
-    a = strict_carry(a)
+    """Canonical representative in [0, p) with strict limbs.
+
+    Signed-limb values can be negative, so 64p (the SUB_K spread — its
+    oversized top limb is fine here, strict_carry eats it) is added
+    first: the total becomes positive, and strict normalization then
+    leaves a value < 2^260 < 33p for the branch-free conditional
+    subtraction ladder."""
+    a = strict_carry(a + SUB_K)
     for m in (16, 8, 4, 2, 1, 1):
         mp = to_limbs(m * P)
         ge = _geq(a, mp)

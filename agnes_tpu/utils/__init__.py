@@ -1,0 +1,23 @@
+"""Auxiliary subsystems (SURVEY.md §5 — every one absent in the
+reference, present here):
+
+  checkpoint.py   snapshot/resume of device consensus state + the
+                  decided-height log (the reference restarts by
+                  constructing State::new(h+1); here 10k instances'
+                  arrays snapshot and re-upload).
+  metrics.py      counters/gauges off the hot loops (votes verified,
+                  thresholds crossed, decisions/sec) with one-line
+                  JSON export — the north-star metrics are built in.
+  tracing.py      host spans (chrome-trace JSON for perfetto) +
+                  jax.named_scope helpers for device kernels.
+  config.py       the typed run configuration (validators, instances,
+                  mesh shape, timeouts, dtypes) + CLI parsing.
+"""
+
+from agnes_tpu.utils.checkpoint import (  # noqa: F401
+    load_driver,
+    save_driver,
+)
+from agnes_tpu.utils.config import RunConfig  # noqa: F401
+from agnes_tpu.utils.metrics import Metrics  # noqa: F401
+from agnes_tpu.utils.tracing import Tracer, span  # noqa: F401

@@ -1,0 +1,134 @@
+"""Checkpoint / resume for the device consensus plane.
+
+The reference has no serialization at all — `State` is 5 small fields
+and a height restart is `State::new(h+1)` (README.md:43-44, SURVEY.md
+§5).  Here the unit of state is much bigger: 10k instances' int32
+arrays (DeviceState) plus the tally window (TallyState) and the
+driver's decided log.  A snapshot is a flat .npz of named leaves —
+`jax.device_get` pulls everything in one transfer, resume re-uploads
+with `jnp.asarray`.  Every leaf is a plain int/bool array, so the
+format is dtype-exact and framework-agnostic (orbax would add async/
+sharded saves; this keeps the dependency surface zero until needed).
+
+Host executors snapshot separately (`save_executor`): their state is a
+handful of Python scalars plus the decided log.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from agnes_tpu.device.encoding import DeviceState
+from agnes_tpu.device.tally import TallyConfig, TallyState
+
+_STATE_PREFIX = "state."
+_TALLY_PREFIX = "tally."
+_STATS_PREFIX = "stats."
+
+
+def save_driver(driver, path: str) -> None:
+    """Snapshot a harness.DeviceDriver (device arrays + stats) to
+    `path` (.npz).  One device_get for the whole tree."""
+    leaves = {}
+    state_host = jax.device_get(driver.state)
+    tally_host = jax.device_get(driver.tally)
+    for name, arr in zip(DeviceState._fields, state_host):
+        leaves[_STATE_PREFIX + name] = np.asarray(arr)
+    for name, arr in zip(TallyState._fields, tally_host):
+        leaves[_TALLY_PREFIX + name] = np.asarray(arr)
+    leaves[_STATS_PREFIX + "decided"] = driver.stats.decided
+    leaves[_STATS_PREFIX + "decision_value"] = driver.stats.decision_value
+    leaves[_STATS_PREFIX + "decision_round"] = driver.stats.decision_round
+    # full driver configuration: a resumed driver must behave
+    # identically (proposer schedule, powers, propose values)
+    leaves["cfg.proposer_flag"] = np.asarray(
+        jax.device_get(driver.proposer_flag))
+    leaves["cfg.powers"] = np.asarray(jax.device_get(driver.powers))
+    leaves["cfg.total"] = np.asarray(jax.device_get(driver.total))
+    leaves["cfg.propose_value"] = np.asarray(
+        jax.device_get(driver.propose_value))
+    leaves["meta"] = np.asarray([driver.I, driver.V, driver.cfg.n_rounds,
+                                 driver.cfg.n_slots,
+                                 driver.stats.votes_ingested,
+                                 driver.stats.steps], np.int64)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **leaves)
+    os.replace(tmp, path)
+
+
+def load_driver(path: str):
+    """Rebuild a DeviceDriver from a snapshot (arrays re-uploaded)."""
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    with np.load(path) as z:
+        meta = z["meta"]
+        d = DeviceDriver(int(meta[0]), int(meta[1]),
+                         n_rounds=int(meta[2]), n_slots=int(meta[3]))
+        d.state = DeviceState(*[jnp.asarray(z[_STATE_PREFIX + n])
+                                for n in DeviceState._fields])
+        d.tally = TallyState(*[jnp.asarray(z[_TALLY_PREFIX + n])
+                               for n in TallyState._fields])
+        d.proposer_flag = jnp.asarray(z["cfg.proposer_flag"])
+        d.powers = jnp.asarray(z["cfg.powers"])
+        d.total = jnp.asarray(z["cfg.total"])
+        d.propose_value = jnp.asarray(z["cfg.propose_value"])
+        d.stats.decided = z[_STATS_PREFIX + "decided"].copy()
+        d.stats.decision_value = z[_STATS_PREFIX + "decision_value"].copy()
+        d.stats.decision_round = z[_STATS_PREFIX + "decision_round"].copy()
+        d.stats.votes_ingested = int(meta[4])
+        d.stats.steps = int(meta[5])
+    return d
+
+
+# --- host executor snapshots ------------------------------------------------
+
+
+def save_executor(ex, path: str) -> None:
+    """Persist a ConsensusExecutor's progress: height, state fields and
+    the decided log (votes in flight are not persisted — on resume the
+    node rejoins at its height and catches up from peers, the same
+    crash-recovery story as any BFT node)."""
+    from agnes_tpu.device.encoding import encode_state
+
+    s = encode_state(ex.state)
+    doc = {
+        "height": ex.height,
+        "state": {f: int(getattr(s, f)) for f in s._fields},
+        "decided": {h: [d.height, d.round, d.value]
+                    for h, d in ex.decided.items()},
+        "now": ex.wheel.now,
+    }
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def load_executor_into(ex, path: str) -> Tuple[int, dict]:
+    """Restore height/state/decisions into a freshly built executor
+    (same validator set + seed).  Returns (height, decided)."""
+    from agnes_tpu.core.executor import Decision
+    from agnes_tpu.core.vote_executor import VoteExecutor
+    from agnes_tpu.device.encoding import DeviceState, decode_state
+
+    with open(path) as f:
+        doc = json.load(f)
+    ex.height = doc["height"]
+    leaves = doc["state"]
+    ds = DeviceState(*[np.int32(leaves[f]) for f in DeviceState._fields])
+    ex.state = decode_state(ds, height=ex.height)
+    ex.decided = {int(h): Decision(*v) for h, v in doc["decided"].items()}
+    ex.decisions = sorted(ex.decided.values(), key=lambda d: d.height)
+    ex.votes = VoteExecutor(height=ex.height,
+                            total_weight=ex.vset.total_power,
+                            edge_triggered=True)
+    ex.wheel.now = doc["now"]
+    return ex.height, ex.decided

@@ -1,0 +1,74 @@
+"""The typed run configuration.
+
+The reference's only "config system" is constructor arguments
+(State::new(height), RoundVotes::new(height, round, total) — SURVEY.md
+§5); timeout durations don't exist there at all (the consumer owns
+them).  This dataclass is the single place a deployment describes
+itself: scale (validators, instances), the tally window, mesh shape,
+timeouts, and dtype policy.  `from_args` gives every benchmark/driver
+CLI the same flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import asdict, dataclass, field
+from typing import Optional, Tuple
+
+from agnes_tpu.core.executor import TimeoutConfig
+
+
+@dataclass(frozen=True)
+class RunConfig:
+    # scale
+    n_validators: int = 1000
+    n_instances: int = 10_000
+    # tally / proposer window (rounds tracked on device)
+    n_rounds: int = 4
+    n_slots: int = 4
+    # mesh: (data/instances axis, validator axis); None = single device
+    mesh: Optional[Tuple[int, int]] = None
+    # timeouts (virtual units)
+    timeouts: TimeoutConfig = field(default_factory=TimeoutConfig)
+    # dtype policy: tally weights stay int32; this switches any future
+    # floating-point surfaces (bf16 on TPU by default)
+    float_dtype: str = "bfloat16"
+    # checkpointing
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_steps: int = 0     # 0 = disabled
+
+    def validate(self) -> "RunConfig":
+        assert self.n_validators >= 1 and self.n_instances >= 1
+        assert self.n_rounds >= 1 and self.n_slots >= 1
+        if self.mesh is not None:
+            d, v = self.mesh
+            assert self.n_instances % d == 0, "instances % mesh data axis"
+            assert self.n_validators % v == 0, "validators % mesh val axis"
+        assert self.float_dtype in ("bfloat16", "float32")
+        return self
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_args(cls, argv=None) -> "RunConfig":
+        p = argparse.ArgumentParser(description=__doc__)
+        p.add_argument("--validators", type=int, default=cls.n_validators)
+        p.add_argument("--instances", type=int, default=cls.n_instances)
+        p.add_argument("--rounds", type=int, default=cls.n_rounds)
+        p.add_argument("--slots", type=int, default=cls.n_slots)
+        p.add_argument("--mesh", type=str, default=None,
+                       help="DxV, e.g. 4x2")
+        p.add_argument("--float-dtype", default=cls.float_dtype)
+        p.add_argument("--checkpoint-dir", default=None)
+        p.add_argument("--checkpoint-every", type=int, default=0)
+        a = p.parse_args(argv)
+        mesh = None
+        if a.mesh:
+            d, v = a.mesh.lower().split("x")
+            mesh = (int(d), int(v))
+        return cls(n_validators=a.validators, n_instances=a.instances,
+                   n_rounds=a.rounds, n_slots=a.slots, mesh=mesh,
+                   float_dtype=a.float_dtype,
+                   checkpoint_dir=a.checkpoint_dir,
+                   checkpoint_every_steps=a.checkpoint_every).validate()

@@ -1,0 +1,86 @@
+"""Counters and gauges for the consensus planes.
+
+The reference has zero observability (SURVEY.md §5).  Here the tally
+kernels yield the interesting numbers for free — votes ingested,
+thresholds crossed, decisions — and the host wraps them in a tiny
+registry with monotonic counters, gauges, and rate derivation.  Export
+is one JSON line (the bench.py / driver contract) or a plain dict.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class Metrics:
+    """Process-local metric registry.  Counters are monotonic;
+    `rate(name)` derives per-second rates against the registry clock."""
+
+    counters: Dict[str, int] = field(default_factory=dict)
+    gauges: Dict[str, float] = field(default_factory=dict)
+    _t0: float = field(default_factory=time.perf_counter)
+
+    def count(self, name: str, delta: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + delta
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def rate(self, name: str) -> float:
+        dt = self.elapsed()
+        return self.counters.get(name, 0) / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        out = dict(self.counters)
+        out.update(self.gauges)
+        out["elapsed_s"] = round(self.elapsed(), 4)
+        for name in self.counters:
+            out[f"{name}_per_sec"] = round(self.rate(name), 2)
+        return out
+
+    def json_line(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+
+# the well-known counter names used across the harness/driver
+VOTES_INGESTED = "votes_ingested"
+VOTES_VERIFIED = "votes_verified"
+THRESHOLDS_CROSSED = "thresholds_crossed"
+DECISIONS = "decisions"
+ROUNDS_SKIPPED = "rounds_skipped"
+EQUIVOCATIONS = "equivocations"
+
+
+def attach_to_driver(driver, metrics: Optional[Metrics] = None) -> Metrics:
+    """Wrap a DeviceDriver's step() so the registry tracks the
+    north-star counters without touching the jitted path."""
+    import numpy as np
+
+    m = metrics or Metrics()
+    inner = driver.step
+
+    def step(ext=None, phase=None):
+        decided_before = int(driver.stats.decided.sum())
+        votes_before = driver.stats.votes_ingested
+        # tally.emitted holds the highest threshold code reached per
+        # (instance, round, class); its sum rises exactly when a tally
+        # threshold is newly crossed — the real counter, as opposed to
+        # counting the state machine's output messages
+        emitted_before = int(np.asarray(driver.tally.emitted).sum())
+        msgs = inner(ext=ext, phase=phase)
+        m.count(VOTES_INGESTED, driver.stats.votes_ingested - votes_before)
+        m.count(DECISIONS, int(driver.stats.decided.sum()) - decided_before)
+        emitted_now = int(np.asarray(driver.tally.emitted).sum())
+        m.count(THRESHOLDS_CROSSED, emitted_now - emitted_before)
+        m.gauge(EQUIVOCATIONS, int(driver.equivocators_detected().sum()))
+        return msgs
+
+    driver.step = step
+    return m

@@ -1,0 +1,75 @@
+"""Tracing: host spans (chrome-trace JSON) + device scope helpers.
+
+Host side: `Tracer` records begin/end spans and writes the standard
+chrome://tracing / perfetto JSON array format.  Device side: `span`
+wraps `jax.named_scope`, so kernel regions show up named in XLA/JAX
+profiler dumps (`jax.profiler.trace` being the heavyweight option).
+The reference has no instrumentation anywhere (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class _Span:
+    name: str
+    ts_us: float
+    dur_us: float
+    tid: int
+
+
+@dataclass
+class Tracer:
+    """Collects host spans; `write(path)` emits chrome-trace JSON."""
+
+    spans: List[_Span] = field(default_factory=list)
+    _t0: float = field(default_factory=time.perf_counter)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            end = time.perf_counter()
+            with self._lock:
+                self.spans.append(_Span(
+                    name=name,
+                    ts_us=(start - self._t0) * 1e6,
+                    dur_us=(end - start) * 1e6,
+                    tid=threading.get_ident() & 0xFFFF))
+
+    def write(self, path: str) -> None:
+        events = [{"name": s.name, "ph": "X", "ts": s.ts_us,
+                   "dur": s.dur_us, "pid": os.getpid(), "tid": s.tid}
+                  for s in self.spans]
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        os.replace(tmp, path)
+
+    def total_us(self, name: str) -> float:
+        return sum(s.dur_us for s in self.spans if s.name == name)
+
+
+@contextlib.contextmanager
+def span(name: str, tracer: Optional[Tracer] = None):
+    """Device+host combined scope: names the region for the XLA
+    profiler AND records a host span when a tracer is given."""
+    import jax
+
+    with jax.named_scope(name):
+        if tracer is None:
+            yield
+        else:
+            with tracer.span(name):
+                yield

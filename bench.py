@@ -1,22 +1,34 @@
 """Flagship benchmark: fused verify+tally+step throughput on one chip.
 
-Drives the BASELINE config-4 shape — thousands of parallel instances,
-1000-validator tally — through the fused 7-stage consensus step and
-reports votes ingested (deduped, tallied, threshold-checked, state-
-machine-applied) per second.  vs_baseline is measured against the
-north-star 1M votes/sec/chip target from BASELINE.json (the reference
-itself publishes no numbers — SURVEY.md §6).
+Primary metric: votes ingested per second through the fused 7-stage
+consensus step at the BASELINE config-4 shape (thousands of parallel
+instances, 1000-validator tally) — each vote is deduped, tallied,
+threshold-checked and state-machine-applied on device.  vs_baseline is
+against the north-star 1M votes/sec/chip target from BASELINE.json
+(the reference itself publishes no numbers — SURVEY.md §6).
+
+Extras in the same JSON line: batched Ed25519 verification throughput
+(the crypto data plane, north star >= 1M verifies/sec) and the
+decisions/sec of the honest-path closed loop.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import jax.numpy as jnp
 
 from agnes_tpu.device.encoding import DeviceState
@@ -27,8 +39,8 @@ from agnes_tpu.types import VoteType
 NORTH_STAR = 1_000_000  # votes/sec/chip (BASELINE.json north_star)
 
 
-def bench(n_instances: int = 4096, n_validators: int = 1024,
-          iters: int = 20) -> dict:
+def bench_tally(n_instances: int = 4096, n_validators: int = 1024,
+                iters: int = 20) -> float:
     I, V = n_instances, n_validators
     cfg = TallyConfig(n_validators=V, n_rounds=4, n_slots=4)
 
@@ -52,8 +64,7 @@ def bench(n_instances: int = 4096, n_validators: int = 1024,
         return consensus_step_jit(state, tally, ext, phase, powers, total,
                                   proposer_flag, propose_value)
 
-    # warmup + compile
-    s, t, _ = step(state, tally)
+    s, t, _ = step(state, tally)   # warmup + compile
     jax.block_until_ready(s)
 
     t0 = time.perf_counter()
@@ -62,16 +73,74 @@ def bench(n_instances: int = 4096, n_validators: int = 1024,
         s, t, _ = step(s, t)
     jax.block_until_ready(s)
     dt = time.perf_counter() - t0
+    return I * V * iters / dt
 
-    votes_per_iter = I * V
-    votes_per_sec = votes_per_iter * iters / dt
-    return {
+
+def bench_verify(batch: int = 1024, iters: int = 3) -> float:
+    """Batched Ed25519 verifies/sec (signatures fabricated by the C++
+    signer; verified by the JAX data plane)."""
+    from agnes_tpu.core import native
+    from agnes_tpu.crypto import ed25519_jax as ejax
+    from agnes_tpu.crypto.encoding import vote_signing_bytes
+
+    seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(batch)]
+    msgs = [vote_signing_bytes(1, 0, 0, i % 7) for i in range(batch)]
+    pks = [native.pubkey(s) for s in seeds]
+    sigs = [native.sign(s, m) for s, m in zip(seeds, msgs)]
+    pub, sig, blocks = ejax.pack_verify_inputs_host(pks, msgs, sigs)
+
+    ok = ejax.verify_batch_jit(pub, sig, blocks)   # warmup + compile
+    ok.block_until_ready()
+    assert bool(ok.all())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ok = ejax.verify_batch_jit(pub, sig, blocks)
+    ok.block_until_ready()
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def bench_decisions(n_instances: int = 4096,
+                    n_validators: int = 1024) -> float:
+    """Honest-path closed loop: decisions/sec at config-4 shape."""
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    d = DeviceDriver(n_instances, n_validators)
+    d.run_honest_round(0)      # warmup + compile all three step shapes
+    d.block_until_ready()
+    d2 = DeviceDriver(n_instances, n_validators)
+    t0 = time.perf_counter()
+    d2.run_honest_round(0)
+    d2.block_until_ready()
+    dt = time.perf_counter() - t0
+    assert d2.all_decided()
+    return n_instances / dt
+
+
+def main() -> None:
+    import sys
+    import traceback
+
+    votes_per_sec = bench_tally()
+    try:
+        verifies_per_sec = round(bench_verify())
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        verifies_per_sec = -1
+    try:
+        decisions_per_sec = round(bench_decisions())
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        decisions_per_sec = -1
+    print(json.dumps({
         "metric": "fused_tally_step_votes_per_sec",
         "value": round(votes_per_sec),
         "unit": "votes/sec/chip",
         "vs_baseline": round(votes_per_sec / NORTH_STAR, 3),
-    }
+        "ed25519_verifies_per_sec": verifies_per_sec,
+        "decisions_per_sec": decisions_per_sec,
+    }))
 
 
 if __name__ == "__main__":
-    print(json.dumps(bench()))
+    main()

@@ -1,0 +1,128 @@
+"""Bridge: value interning, slot maps, and the vote-batch ingestion ABI."""
+
+import numpy as np
+
+from agnes_tpu.bridge import SlotMap, ValueTable, VoteBatcher, WireVote
+from agnes_tpu.core import native
+from agnes_tpu.crypto.encoding import vote_signing_bytes
+from agnes_tpu.harness.device_driver import DeviceDriver
+from agnes_tpu.types import VoteType
+
+
+def test_value_table_roundtrip_and_determinism():
+    t1, t2 = ValueTable(), ValueTable()
+    payloads = [b"block-7", b"block-8", b"x" * 100]
+    ids1 = [t1.intern(p) for p in payloads]
+    ids2 = [t2.intern(p) for p in payloads]
+    assert ids1 == ids2                      # content-derived: hosts agree
+    assert len(set(ids1)) == 3
+    for vid, p in zip(ids1, payloads):
+        assert t1.payload(vid) == p
+    assert t1.intern(b"block-7") == ids1[0]  # idempotent
+    assert all(0 <= v < 2**31 for v in ids1)
+
+
+def test_slot_map_allocation_and_overflow():
+    sm = SlotMap(n_instances=2, n_slots=2)
+    assert sm.slot_for(0, 111) == 0
+    assert sm.slot_for(0, 222) == 1
+    assert sm.slot_for(0, 111) == 0          # stable
+    assert sm.slot_for(0, 333) is None       # overflow -> host fallback
+    assert sm.overflowed == 1
+    assert sm.slot_for(1, 333) == 0          # instances independent
+    assert sm.value_for(0, 1) == 222
+    sm.reset_instance(0)
+    assert sm.slot_for(0, 333) == 0
+
+
+def _signed_vote(seeds, inst, val_idx, height, rnd, typ, value):
+    sig = native.sign(seeds[val_idx],
+                      vote_signing_bytes(height, rnd, int(typ), value))
+    return WireVote(instance=inst, validator=val_idx, height=height,
+                    round=rnd, typ=typ, value=value, signature=sig)
+
+
+def test_batcher_end_to_end_signed_consensus():
+    """Signed wire votes -> verified dense phases -> device decision."""
+    I, V = 2, 4
+    seeds = [bytes([i + 1]) * 32 for i in range(V)]
+    pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in seeds])
+    value_id = ValueTable().intern(b"the-block")
+
+    b = VoteBatcher(I, V, n_slots=4)
+    for inst in range(I):
+        for v in range(V):
+            b.add(_signed_vote(seeds, inst, v, 0, 0, VoteType.PREVOTE,
+                               value_id))
+    # one forged prevote (wrong key signs validator 3's vote)
+    forged_sig = native.sign(b"\xBB" * 32,
+                             vote_signing_bytes(0, 0, 0, value_id))
+    b.add(WireVote(instance=0, validator=3, height=0, round=0,
+                   typ=VoteType.PREVOTE, value=value_id,
+                   signature=forged_sig))
+    # and a malformed one
+    b.add(WireVote(instance=0, validator=99, height=0, round=0,
+                   typ=VoteType.PREVOTE, value=value_id, signature=None))
+
+    phases = b.build_phases(pubkeys)
+    assert b.rejected_signature == 1
+    assert b.rejected_malformed == 1
+    # layering: the forged vote was dropped, so one layer only
+    assert len(phases) == 1
+    phase, n = phases[0]
+    assert n == I * V
+
+    d = DeviceDriver(I, V)
+    d.step()                       # entry + self-proposal
+    d.step(phase=phase)            # everyone prevotes the value
+    for inst in range(I):
+        for v in range(V):
+            b.add(_signed_vote(seeds, inst, v, 0, 0, VoteType.PRECOMMIT,
+                               value_id))
+    (pc_phase, n2), = b.build_phases(pubkeys)
+    assert n2 == I * V
+    d.step(phase=pc_phase)
+    assert d.all_decided()
+    # decision slot decodes back to the interned value id
+    slot = int(d.stats.decision_value[0])
+    assert b.decode_slot(0, slot) == value_id
+
+
+def test_batcher_layers_equivocating_votes():
+    """Two conflicting votes from one validator land in two layers and
+    the device flags the equivocation."""
+    I, V = 1, 4
+    b = VoteBatcher(I, V, n_slots=4)
+    for v in range(V):
+        b.add(WireVote(0, v, 0, 0, VoteType.PREVOTE, value=100 + v % 2))
+    b.add(WireVote(0, 0, 0, 0, VoteType.PREVOTE, value=999))  # conflict
+    phases = b.build_phases()      # unverified path (no pubkeys)
+    assert len(phases) == 2        # base layer + conflict layer
+    d = DeviceDriver(I, V)
+    d.step()
+    for phase, _ in phases:
+        d.step(phase=phase)
+    assert int(d.equivocators_detected()[0]) == 1
+
+
+def test_batcher_dedupes_exact_duplicates():
+    """Gossip redelivery: 10 copies of one vote -> one layer, one slot."""
+    b = VoteBatcher(1, 4, n_slots=4)
+    for _ in range(10):
+        b.add(WireVote(0, 2, 0, 0, VoteType.PREVOTE, value=7))
+    b.add(WireVote(0, 1, 0, 0, VoteType.PREVOTE, value=7))
+    phases = b.build_phases()
+    assert len(phases) == 1
+    _, n = phases[0]
+    assert n == 2  # two distinct (validator) votes
+
+
+def test_batcher_drops_cross_height_votes():
+    b = VoteBatcher(2, 4, n_slots=4,
+                    heights=np.asarray([5, 6], np.int64))
+    b.add(WireVote(0, 1, 5, 0, VoteType.PREVOTE, 1))   # right height
+    b.add(WireVote(1, 1, 5, 0, VoteType.PREVOTE, 1))   # wrong height
+    phases = b.build_phases()
+    assert b.rejected_malformed == 1
+    assert sum(n for _, n in phases) == 1

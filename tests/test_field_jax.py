@@ -43,9 +43,9 @@ def test_binary_ops(op, ref):
     for i, (x, y) in enumerate(zip(xs, ys)):
         got = F.from_limbs(frozen[i])
         assert got == ref(x, y), f"{op}[{i}]: {x} . {y} -> {got}"
-    # limbs stay weakly normalized (safe as inputs to a further mul)
-    assert np.asarray(out).max() < F.RADIX + 16
-    assert np.asarray(out).min() >= 0
+    # limbs stay weakly normalized (safe as inputs to a further mul):
+    # signed representation, |limb| <= 8800 (module docstring bounds)
+    assert np.abs(np.asarray(out)).max() <= 8800
 
 
 def test_freeze_canonical():

@@ -1,0 +1,130 @@
+"""utils: checkpoint/resume, metrics, tracing, config."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from agnes_tpu.harness.device_driver import DeviceDriver
+from agnes_tpu.harness.simulator import Network
+from agnes_tpu.types import VoteType
+from agnes_tpu.utils import Metrics, RunConfig, Tracer, span
+from agnes_tpu.utils.checkpoint import (
+    load_driver,
+    load_executor_into,
+    save_driver,
+    save_executor,
+)
+from agnes_tpu.utils.metrics import DECISIONS, VOTES_INGESTED, \
+    attach_to_driver
+
+
+def test_driver_checkpoint_roundtrip(tmp_path):
+    """Snapshot mid-consensus, resume, finish — byte-identical state."""
+    d = DeviceDriver(n_instances=4, n_validators=8)
+    d.step()
+    d.step(phase=d.phase(0, VoteType.PREVOTE, 1))  # polka reached
+    path = str(tmp_path / "snap.npz")
+    save_driver(d, path)
+
+    d2 = load_driver(path)
+    for a, b in zip(d.state, d2.state):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(d.tally, d2.tally):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert d2.stats.votes_ingested == d.stats.votes_ingested
+
+    # both copies complete identically from the snapshot
+    d.step(phase=d.phase(0, VoteType.PRECOMMIT, 1))
+    d2.step(phase=d2.phase(0, VoteType.PRECOMMIT, 1))
+    assert d.all_decided(value=1) and d2.all_decided(value=1)
+    assert np.array_equal(d.stats.decision_round, d2.stats.decision_round)
+
+
+def test_driver_checkpoint_preserves_configuration(tmp_path):
+    """proposer_is_self=False (nil-round setup) must survive resume —
+    a resumed driver defaulting to self-proposal would diverge."""
+    d = DeviceDriver(n_instances=2, n_validators=4, proposer_is_self=False)
+    d.step()
+    path = str(tmp_path / "cfg.npz")
+    save_driver(d, path)
+    d2 = load_driver(path)
+    assert not bool(np.asarray(d2.proposer_flag).any())
+    assert np.array_equal(np.asarray(d.powers), np.asarray(d2.powers))
+    # both continue the nil round identically
+    from agnes_tpu.core.state_machine import EventTag
+    for x in (d, d2):
+        x.step(ext=x.ext(int(EventTag.TIMEOUT_PROPOSE), 0))
+        x.step(phase=x.phase(0, VoteType.PREVOTE, -1))
+    for a, b in zip(d.state, d2.state):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_executor_checkpoint_resume(tmp_path):
+    """A node snapshots after deciding heights, restarts, rejoins and
+    keeps deciding with the same network."""
+    net = Network(n=4)
+    net.start()
+    net.run_until(lambda: net.decided(1))
+    victim = net.nodes[0]
+    path = str(tmp_path / "node0.json")
+    save_executor(victim, path)
+
+    # fresh executor, same identity; restore
+    from agnes_tpu.core.executor import ConsensusExecutor
+    fresh = ConsensusExecutor(net.vset, index=0, seed=net.seeds[0],
+                              get_value=lambda h: 100 + h)
+    h, decided = load_executor_into(fresh, path)
+    assert h >= 2 and decided[0].value == 100 and decided[1].value == 101
+    assert fresh.state.height == h
+
+
+def test_metrics_registry_and_driver_attach():
+    m = Metrics()
+    m.count("x", 5)
+    m.gauge("g", 1.5)
+    snap = m.snapshot()
+    assert snap["x"] == 5 and snap["g"] == 1.5 and "x_per_sec" in snap
+    json.loads(m.json_line())
+
+    d = DeviceDriver(n_instances=2, n_validators=4)
+    m2 = attach_to_driver(d)
+    d.run_honest_round(0)
+    snap = m2.snapshot()
+    assert snap[VOTES_INGESTED] == 2 * 2 * 4
+    assert snap[DECISIONS] == 2
+
+
+def test_tracer_chrome_trace(tmp_path):
+    tr = Tracer()
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+    with span("device_scope", tr):   # named_scope + host span
+        pass
+    path = str(tmp_path / "trace.json")
+    tr.write(path)
+    with open(path) as f:
+        doc = json.load(f)
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert set(names) == {"outer", "inner", "device_scope"}
+    assert tr.total_us("outer") >= tr.total_us("inner")
+
+
+def test_run_config_validation_and_cli():
+    cfg = RunConfig.from_args(["--validators", "64", "--instances", "128",
+                               "--mesh", "4x2"])
+    assert cfg.n_validators == 64 and cfg.mesh == (4, 2)
+    with pytest.raises(AssertionError):
+        RunConfig(n_instances=10, mesh=(3, 1)).validate()
+    assert "n_validators" in cfg.as_dict()
+
+
+def test_checkpoint_files_are_atomic(tmp_path):
+    """No .tmp litter left behind."""
+    d = DeviceDriver(n_instances=2, n_validators=4)
+    path = str(tmp_path / "s.npz")
+    save_driver(d, path)
+    assert os.path.exists(path)
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
