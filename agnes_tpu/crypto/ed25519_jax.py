@@ -60,6 +60,39 @@ class Point(NamedTuple):
     t: jnp.ndarray
 
 
+# --- backend selection ------------------------------------------------------
+# 'pallas' runs the sequential loops (Straus, pow) as fused TPU kernels
+# (crypto/pallas_ed25519.py) — the only way to the >= 1M verifies/sec
+# north star; 'jnp' is the portable XLA path.  None = auto (pallas on
+# TPU).  Set before the first verify_batch call in a process: the jit
+# caches whatever backend was active at trace time.
+
+_BACKEND: str | None = None
+_INTERPRET = False
+
+
+def set_backend(name: str | None, interpret: bool = False) -> None:
+    """name in {'pallas', 'jnp', None=auto}; interpret=True runs the
+    Pallas kernels in interpreter mode (CPU correctness tests)."""
+    global _BACKEND, _INTERPRET
+    assert name in (None, "pallas", "jnp")
+    _BACKEND = name
+    _INTERPRET = interpret
+
+
+def _use_pallas() -> bool:
+    if _BACKEND is not None:
+        return _BACKEND == "pallas"
+    return jax.default_backend() == "tpu"
+
+
+def _pow(x: jnp.ndarray, e: int) -> jnp.ndarray:
+    if _use_pallas():
+        from agnes_tpu.crypto import pallas_ed25519 as pk
+        return pk.pow_p_pallas(x, e, interpret=_INTERPRET)
+    return F.pow_p(x, e)
+
+
 def identity(shape: Tuple[int, ...]) -> Point:
     zero = jnp.zeros(shape + (F.NLIMBS,), I32)
     one = zero.at[..., 0].set(1)
@@ -115,7 +148,7 @@ def decompress(ybytes: jnp.ndarray) -> Tuple[Point, jnp.ndarray]:
     v = F.add(F.mul(y2, jnp.broadcast_to(D_LIMBS, y.shape)), one)
     v3 = F.mul(v, F.sqr(v))
     v7 = F.mul(v3, F.mul(v3, v))
-    x = F.mul(F.mul(u, v3), F.pow_p(F.mul(u, v7), (P - 5) // 8))
+    x = F.mul(F.mul(u, v3), _pow(F.mul(u, v7), (P - 5) // 8))
 
     vx2 = F.mul(v, F.sqr(x))
     neg_u = F.sub(jnp.zeros_like(u), u)
@@ -135,7 +168,7 @@ def decompress(ybytes: jnp.ndarray) -> Tuple[Point, jnp.ndarray]:
 
 def compress(p: Point) -> jnp.ndarray:
     """Point -> [..., 32] canonical little-endian bytes (int32 0..255)."""
-    zi = F.inv(p.z)
+    zi = _pow(p.z, P - 2)
     x = F.freeze(F.mul(p.x, zi))
     y = F.freeze(F.mul(p.y, zi))
     out = F.limbs_to_bytes32(y)
@@ -183,7 +216,11 @@ def verify_batch(pub: jnp.ndarray, sig: jnp.ndarray,
     s = S.scalar_from_bytes32(sig[..., 32:])
     ok_s = S.is_canonical(s)
     k = S.barrett_reduce(S.digest_to_limbs(sha.sha512_blocks(msg_blocks)))
-    q = straus_sub(s, k, a_point)
+    if _use_pallas():
+        from agnes_tpu.crypto import pallas_ed25519 as pk
+        q = pk.straus_sub_pallas(s, k, a_point, interpret=_INTERPRET)
+    else:
+        q = straus_sub(s, k, a_point)
     q_bytes = compress(q)
     ok_eq = jnp.all(q_bytes == sig[..., :32].astype(I32), axis=-1)
     return ok_a & ok_s & ok_eq

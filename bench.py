@@ -76,9 +76,10 @@ def bench_tally(n_instances: int = 4096, n_validators: int = 1024,
     return I * V * iters / dt
 
 
-def bench_verify(batch: int = 1024, iters: int = 3) -> float:
+def bench_verify(batch: int = 16384, iters: int = 3) -> float:
     """Batched Ed25519 verifies/sec (signatures fabricated by the C++
-    signer; verified by the JAX data plane)."""
+    signer; verified by the JAX data plane — the Pallas kernel path on
+    TPU, measured ~250k/s at this batch; portable jnp path elsewhere)."""
     from agnes_tpu.core import native
     from agnes_tpu.crypto import ed25519_jax as ejax
     from agnes_tpu.crypto.encoding import vote_signing_bytes
