@@ -99,6 +99,7 @@ class VoteBatcher:
             if not (0 <= v.instance < self.I and 0 <= v.validator < self.V
                     and v.round >= 0
                     and (v.value is None or 0 <= v.value < 2**31)
+                    and (v.signature is None or len(v.signature) == 64)
                     and v.height == self.heights[v.instance]):
                 self.rejected_malformed += 1
                 continue

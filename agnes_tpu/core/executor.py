@@ -375,7 +375,11 @@ class ConsensusExecutor:
         dec = Decision(self.height, d.round, d.value)
         self.decisions.append(dec)
         self.decided[self.height] = dec
-        self.evidence.extend(self.votes.votes.equivocations())
+        # dedup: a restart restores live-height evidence into the archive,
+        # and peers redelivering the same votes would re-detect it here
+        seen = set(self.evidence)
+        self.evidence.extend(e for e in self.votes.votes.equivocations()
+                             if e not in seen)
         self.height += 1
         self.state = sm.State.new(self.height)
         self.votes = VoteExecutor(height=self.height,
@@ -386,8 +390,12 @@ class ConsensusExecutor:
     # -- evidence ------------------------------------------------------------
 
     def all_equivocations(self) -> List[object]:
-        """Archived evidence from decided heights plus the live height's."""
-        return self.evidence + self.votes.votes.equivocations()
+        """Archived evidence from decided heights plus the live height's
+        (deduplicated — after a restart the archive already holds the
+        restored live records)."""
+        seen = set(self.evidence)
+        return self.evidence + [e for e in self.votes.votes.equivocations()
+                                if e not in seen]
 
     # -- timers -------------------------------------------------------------
 

@@ -308,18 +308,26 @@ def sha512(data: bytes) -> bytes:
 
 
 def pubkey(seed: bytes) -> bytes:
+    if len(seed) != 32:
+        raise ValueError("ed25519 seed must be 32 bytes")
     out = ctypes.create_string_buffer(32)
     _lib().ag_ed25519_pubkey(seed, out)
     return out.raw
 
 
 def sign(seed: bytes, msg: bytes) -> bytes:
+    if len(seed) != 32:
+        raise ValueError("ed25519 seed must be 32 bytes")
     out = ctypes.create_string_buffer(64)
     _lib().ag_ed25519_sign(seed, msg, len(msg), out)
     return out.raw
 
 
 def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    # The C ABI reads pk[0..31] and sig[0..63] unconditionally; length
+    # must be enforced here or attacker-length inputs become OOB reads.
+    if len(pk) != 32 or len(sig) != 64:
+        return False
     return bool(_lib().ag_ed25519_verify(pk, msg, len(msg), sig))
 
 
@@ -331,6 +339,18 @@ def verify_batch(pks: Sequence[bytes], msgs: Sequence[bytes],
         return []
     msg_len = len(msgs[0])
     assert all(len(m) == msg_len for m in msgs)
+    ok_idx = [i for i in range(len(pks))
+              if len(pks[i]) == 32 and len(sigs[i]) == 64]
+    if len(ok_idx) != len(pks):
+        # keep the packed C call aligned: verify well-formed entries
+        # only, report False for the rest
+        sub = verify_batch([pks[i] for i in ok_idx],
+                           [msgs[i] for i in ok_idx],
+                           [sigs[i] for i in ok_idx])
+        res = [False] * len(pks)
+        for i, good in zip(ok_idx, sub):
+            res[i] = good
+        return res
     out = ctypes.create_string_buffer(len(pks))
     _lib().ag_ed25519_verify_batch(
         b"".join(pks), b"".join(sigs), b"".join(msgs),

@@ -105,6 +105,12 @@ def save_executor(ex, path: str) -> None:
         "decided": {h: [d.height, d.round, d.value]
                     for h, d in ex.decided.items()},
         "now": ex.wheel.now,
+        # slashing evidence survives restarts: archived records plus the
+        # live height's (the live VoteExecutor is not persisted, so its
+        # equivocations would otherwise vanish with it)
+        "evidence": [[e.height, e.round, int(e.typ), e.validator,
+                      e.first_value, e.second_value]
+                     for e in ex.all_equivocations()],
     }
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
@@ -116,12 +122,16 @@ def load_executor_into(ex, path: str) -> Tuple[int, dict]:
     """Restore height/state/decisions into a freshly built executor
     (same validator set + seed).  Returns (height, decided)."""
     from agnes_tpu.core.executor import Decision
+    from agnes_tpu.core.round_votes import Equivocation
     from agnes_tpu.core.vote_executor import VoteExecutor
     from agnes_tpu.device.encoding import DeviceState, decode_state
+    from agnes_tpu.types import VoteType
 
     with open(path) as f:
         doc = json.load(f)
     ex.height = doc["height"]
+    ex.evidence = [Equivocation(h, r, VoteType(t), v, fv, sv)
+                   for h, r, t, v, fv, sv in doc.get("evidence", [])]
     leaves = doc["state"]
     ds = DeviceState(*[np.int32(leaves[f]) for f in DeviceState._fields])
     ex.state = decode_state(ds, height=ex.height)

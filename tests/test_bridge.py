@@ -126,3 +126,37 @@ def test_batcher_drops_cross_height_votes():
     phases = b.build_phases()
     assert b.rejected_malformed == 1
     assert sum(n for _, n in phases) == 1
+
+
+def test_batcher_rejects_wrong_length_signature():
+    """A signature of any length other than 64 must be counted as
+    malformed, not crash the packer (ADVICE r1: one hostile vote could
+    DoS the whole ingestion tick)."""
+    seeds = [bytes([i + 1]) * 32 for i in range(4)]
+    pub = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                    for s in seeds])
+    b = VoteBatcher(1, 4, n_slots=4)
+    b.add(WireVote(0, 0, 0, 0, VoteType.PREVOTE, 1, signature=b"\x01" * 10))
+    b.add(WireVote(0, 1, 0, 0, VoteType.PREVOTE, 1, signature=b"\x01" * 65))
+    phases = b.build_phases(pubkeys=pub)
+    assert b.rejected_malformed == 2
+    assert phases == []
+
+
+def test_native_verify_rejects_wrong_length_inputs():
+    """ADVICE r1: short pk/sig must return a clean False from the C ABI
+    wrapper, never reach the unconditional 32/64-byte reads in C++."""
+    seed = b"\x07" * 32
+    pk = native.pubkey(seed)
+    msg = b"hello"
+    sig = native.sign(seed, msg)
+    assert native.verify(pk, msg, sig)
+    assert not native.verify(pk[:16], msg, sig)
+    assert not native.verify(pk, msg, sig[:10])
+    assert not native.verify(pk + b"\x00", msg, sig)
+    assert not native.verify(pk, msg, sig + b"\x00")
+    # batch path: misaligned entries report False without disturbing
+    # well-formed neighbours
+    res = native.verify_batch([pk, pk[:5], pk], [msg, msg, msg],
+                              [sig, sig, sig[:5]])
+    assert res == [True, False, False]

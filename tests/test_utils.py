@@ -80,6 +80,31 @@ def test_executor_checkpoint_resume(tmp_path):
     assert fresh.state.height == h
 
 
+def test_executor_checkpoint_preserves_evidence(tmp_path):
+    """ADVICE r1: collected double-sign evidence must survive a restart
+    (the executor deliberately archives it across heights)."""
+    from agnes_tpu.core.executor import ConsensusExecutor
+    from agnes_tpu.harness.simulator import NodeSpec
+
+    net = Network(n=4, specs=[NodeSpec(behavior="equivocator"),
+                              NodeSpec(), NodeSpec(), NodeSpec()])
+    net.start()
+    net.run_until(lambda: net.decided(0))
+    honest = next(i for i, s in enumerate(net.specs)
+                  if s.behavior == "honest")
+    victim = net.nodes[honest]
+    ev_before = victim.all_equivocations()
+    assert ev_before, "setup: evidence must exist before snapshot"
+
+    path = str(tmp_path / "node.json")
+    save_executor(victim, path)
+    fresh = ConsensusExecutor(net.vset, index=honest,
+                              seed=net.seeds[honest],
+                              get_value=lambda h: 100 + h)
+    load_executor_into(fresh, path)
+    assert fresh.all_equivocations() == ev_before
+
+
 def test_metrics_registry_and_driver_attach():
     m = Metrics()
     m.count("x", 5)
