@@ -160,7 +160,8 @@ class VoteBatcher:
                     round=jnp.full(self.I, rnd, jnp.int32),
                     typ=jnp.full(self.I, typ, jnp.int32),
                     slots=jnp.asarray(slots),
-                    mask=jnp.asarray(mask)), n))
+                    mask=jnp.asarray(mask),
+                    height=jnp.asarray(self.heights, jnp.int32)), n))
         return phases
 
     def decode_slot(self, instance: int, slot: int) -> Optional[int]:

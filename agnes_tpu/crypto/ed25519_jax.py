@@ -211,16 +211,20 @@ def verify_batch(pub: jnp.ndarray, sig: jnp.ndarray,
     """Batch verify.  pub [B, 32] bytes, sig [B, 64] bytes, msg_blocks
     [B, n_blocks, 32] uint32 — pre-padded SHA-512 blocks of
     R || A || M (see sha512_jax.pack_padded_host / the bridge packer).
-    Returns [B] bool."""
+    Returns [B] bool.
+
+    On the Pallas backend this routes to the fused windowed-Straus
+    verify kernel (crypto/pallas_verify.py); the jnp path below is the
+    portable XLA implementation and differential oracle."""
+    if _use_pallas():
+        from agnes_tpu.crypto import pallas_verify as pv
+        return pv.verify_batch_pallas(pub, sig, msg_blocks,
+                                      interpret=_INTERPRET)
     a_point, ok_a = decompress(pub)
     s = S.scalar_from_bytes32(sig[..., 32:])
     ok_s = S.is_canonical(s)
     k = S.barrett_reduce(S.digest_to_limbs(sha.sha512_blocks(msg_blocks)))
-    if _use_pallas():
-        from agnes_tpu.crypto import pallas_ed25519 as pk
-        q = pk.straus_sub_pallas(s, k, a_point, interpret=_INTERPRET)
-    else:
-        q = straus_sub(s, k, a_point)
+    q = straus_sub(s, k, a_point)
     q_bytes = compress(q)
     ok_eq = jnp.all(q_bytes == sig[..., :32].astype(I32), axis=-1)
     return ok_a & ok_s & ok_eq

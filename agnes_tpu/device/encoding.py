@@ -32,7 +32,12 @@ I32 = jnp.int32
 
 class DeviceState(NamedTuple):
     """Per-instance consensus state; every leaf is an int32 array of the
-    same (possibly empty) batch shape."""
+    same (possibly empty) batch shape.
+
+    `height` mirrors State.height (state_machine.rs:25): the transition
+    function never reads it (height never changes within an instance,
+    README.md:43-44), but the device height-advance stage increments it
+    when installing the next instance after a decision."""
 
     round: jnp.ndarray
     step: jnp.ndarray
@@ -40,14 +45,17 @@ class DeviceState(NamedTuple):
     locked_value: jnp.ndarray
     valid_round: jnp.ndarray    # -1 = no valid value
     valid_value: jnp.ndarray
+    height: jnp.ndarray
 
     @classmethod
-    def new(cls, batch_shape: Tuple[int, ...] = ()) -> "DeviceState":
+    def new(cls, batch_shape: Tuple[int, ...] = (),
+            height: int = 0) -> "DeviceState":
         """Fresh instances at round 0, NewRound (state_machine.rs:35-43)."""
         z = jnp.zeros(batch_shape, I32)
         neg = jnp.full(batch_shape, -1, I32)
         return cls(round=z, step=z, locked_round=neg, locked_value=neg,
-                   valid_round=neg, valid_value=neg)
+                   valid_round=neg, valid_value=neg,
+                   height=jnp.full(batch_shape, height, I32))
 
 
 class DeviceEvent(NamedTuple):
@@ -80,16 +88,18 @@ def encode_state(s: sm.State) -> DeviceState:
     lr, lv = rv(s.locked)
     vr, vv = rv(s.valid)
     a = lambda x: np.int32(x)  # noqa: E731
-    return DeviceState(a(s.round), a(int(s.step)), a(lr), a(lv), a(vr), a(vv))
+    return DeviceState(a(s.round), a(int(s.step)), a(lr), a(lv), a(vr), a(vv),
+                       a(s.height))
 
 
-def decode_state(d: DeviceState, height: int = 0) -> sm.State:
+def decode_state(d: DeviceState, height: int | None = None) -> sm.State:
     g = lambda x: int(np.asarray(x))  # noqa: E731
     locked = (sm.RoundValue(g(d.locked_round), g(d.locked_value))
               if g(d.locked_round) >= 0 else None)
     valid = (sm.RoundValue(g(d.valid_round), g(d.valid_value))
              if g(d.valid_round) >= 0 else None)
-    return sm.State(height=height, round=g(d.round), step=sm.Step(g(d.step)),
+    h = g(d.height) if height is None else height
+    return sm.State(height=h, round=g(d.round), step=sm.Step(g(d.step)),
                     locked=locked, valid=valid)
 
 

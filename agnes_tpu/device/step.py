@@ -43,9 +43,12 @@ from agnes_tpu.device.state_machine import apply_scalar
 from agnes_tpu.device.tally import (
     _EVENT_TABLE,
     NO_EVENT,
+    NOT_VOTED,
+    TH_INIT,
     TallyState,
     add_votes,
     current_threshold,
+    rotate_window,
 )
 from agnes_tpu.types import NIL_ID, VoteType
 
@@ -56,12 +59,18 @@ _apply = jax.vmap(apply_scalar)
 
 
 class VotePhase(NamedTuple):
-    """One dense delivery phase (see device/tally.py)."""
+    """One dense delivery phase (see device/tally.py).
+
+    `height` fences ingestion: with on-device height advance an
+    instance can move to h+1 between phases, and a replayed phase of
+    height-h votes must not tally into h+1 (the reference drops votes
+    for decided heights the same way, core.executor's HeightVotes)."""
 
     round: jnp.ndarray   # [I]
     typ: jnp.ndarray     # [I]
     slots: jnp.ndarray   # [I, V]
     mask: jnp.ndarray    # [I, V]
+    height: jnp.ndarray  # [I]
 
 
 class ExtEvent(NamedTuple):
@@ -90,9 +99,10 @@ def consensus_step(state: DeviceState,
                    phase: VotePhase,
                    powers: jnp.ndarray,         # [V]
                    total_power: jnp.ndarray,    # scalar
-                   proposer_flag: jnp.ndarray,  # [I, W] this node proposes (h,r)
+                   proposer_flag: jnp.ndarray,  # [I, R] this node proposes (h,r)
                    propose_value: jnp.ndarray,  # [I] fresh value to propose
                    axis_name: str | None = None,  # validator mesh axis (psum)
+                   advance_height: bool = False,  # stage 8 on/off
                    ) -> StepOutputs:
     msgs = []
 
@@ -106,10 +116,11 @@ def consensus_step(state: DeviceState,
     # --- 0. external event
     state = apply_ev(state, ext.tag, ext.round, ext.value, ext.pol_round)
 
-    # --- 1. vote ingestion
+    # --- 1. vote ingestion (height-fenced: stale-height phases no-op)
+    height_ok = phase.height == state.height
     tally, tev = add_votes(tally, powers, total_power, phase.round, phase.typ,
-                           phase.slots, phase.mask, state.round,
-                           axis_name=axis_name)
+                           phase.slots, phase.mask & height_ok[:, None],
+                           state.round, axis_name=axis_name)
     neg1 = jnp.full_like(tev.tag, -1)
     # precommit-class events are consumed on first in-round delivery
     # (their arms are step-independent, state_machine.rs:208,:211) —
@@ -120,7 +131,8 @@ def consensus_step(state: DeviceState,
     consumed = is_pc_ev & ((tev.round == state.round)
                            | (tev.tag == int(EventTag.PRECOMMIT_VALUE)))
     W_t = tally.pc_done.shape[1]
-    pc_hit = ((jnp.arange(W_t)[None, :] == tev.round[:, None])
+    ev_widx = tev.round - tally.base_round        # window row of the event
+    pc_hit = ((jnp.arange(W_t)[None, :] == ev_widx[:, None])
               & consumed[:, None])
     tally = tally._replace(pc_done=tally.pc_done | pc_hit)
     state = apply_ev(state, tev.tag, tev.round, tev.value_slot, neg1)
@@ -149,25 +161,30 @@ def consensus_step(state: DeviceState,
         tag = jnp.where((tag == tev.tag) & (state.round == tev.round),
                         NULL_EVENT, tag)
         if typ_code == int(VoteType.PRECOMMIT):
-            round_c_t = jnp.clip(state.round, 0, W_t - 1)
+            cur_widx = state.round - tally.base_round
+            round_c_t = jnp.clip(cur_widx, 0, W_t - 1)
             done = jnp.take_along_axis(tally.pc_done, round_c_t[:, None],
                                        axis=1)[:, 0]
             tag = jnp.where(done, NULL_EVENT, tag)
-            fired = (tag != NULL_EVENT) & (state.round < W_t)
-            pc_hit = ((jnp.arange(W_t)[None, :] == state.round[:, None])
+            fired = ((tag != NULL_EVENT) & (cur_widx >= 0)
+                     & (cur_widx < W_t))
+            pc_hit = ((jnp.arange(W_t)[None, :] == cur_widx[:, None])
                       & fired[:, None])
             tally = tally._replace(pc_done=tally.pc_done | pc_hit)
         state = apply_ev(state, tag, state.round, vslot, neg1)
     tally = tally._replace(q_round=state.round, q_step=state.step)
 
-    # --- 5. round entry (only for rounds inside the proposer-table /
-    # tally window; the host driver rotates the window for rounds beyond)
-    W = proposer_flag.shape[1]
-    round_c = jnp.clip(state.round, 0, W - 1)
+    # --- 5. round entry.  proposer_flag[i, r % R] = "this node proposes
+    # round r of instance i".  The weighted-round-robin rotation the
+    # host executor uses (core.validators.ProposerRotation) is periodic
+    # with period total_power, so a table covering a multiple of the
+    # period is exact for ALL rounds — rounds never outrun it the way
+    # they outrun a fixed window.
+    R = proposer_flag.shape[1]
+    round_c = state.round % R
     is_prop = jnp.take_along_axis(proposer_flag, round_c[:, None],
                                   axis=1)[:, 0]
-    at_new_round = ((state.step == int(Step.NEW_ROUND))
-                    & (state.round < W))
+    at_new_round = state.step == int(Step.NEW_ROUND)
     entry_tag = jnp.where(
         at_new_round,
         jnp.where(is_prop, int(EventTag.NEW_ROUND_PROPOSER),
@@ -182,11 +199,59 @@ def consensus_step(state: DeviceState,
     state = apply_ev(state, self_tag, prop_msg.round, prop_msg.value,
                      prop_msg.aux)
 
+    # --- 7. window rotation: keep the tally window around the current
+    # round (one past round stays tracked for late polka/precommit
+    # evidence; W-2 future rounds stay tracked for round-skip weight).
+    # This is the rotation the reference's unbounded per-round map
+    # (round_votes.rs:74-97) makes implicit.
+    new_base = jnp.maximum(tally.base_round,
+                           jnp.maximum(state.round - 1, 0))
+    tally = rotate_window(tally, new_base)
+
+    # --- 8. height advance (optional): a decided instance is reset to
+    # State::new(height+1) semantics — the reference's contract that "a
+    # decision ends the instance and the consumer starts a new State at
+    # the next height" (README.md:43-44), folded onto the device so
+    # multi-height throughput never round-trips the host.
+    if advance_height:
+        decided = state.step == int(Step.COMMIT)
+
+        def sel(new, old):
+            mask = decided.reshape(decided.shape
+                                   + (1,) * (old.ndim - 1))
+            return jnp.where(mask, new, old)
+
+        zero = jnp.zeros_like(state.round)
+        state = DeviceState(
+            round=sel(zero, state.round),
+            step=sel(zero, state.step),                 # Step.NEW_ROUND
+            locked_round=sel(zero - 1, state.locked_round),
+            locked_value=sel(zero - 1, state.locked_value),
+            valid_round=sel(zero - 1, state.valid_round),
+            valid_value=sel(zero - 1, state.valid_value),
+            height=sel(state.height + 1, state.height),
+        )
+        tally = tally._replace(
+            weights=sel(jnp.zeros_like(tally.weights), tally.weights),
+            voted=sel(jnp.full_like(tally.voted, NOT_VOTED), tally.voted),
+            emitted=sel(jnp.full_like(tally.emitted, TH_INIT),
+                        tally.emitted),
+            skipped=sel(jnp.zeros_like(tally.skipped), tally.skipped),
+            q_round=sel(zero - 1, tally.q_round),
+            q_step=sel(zero - 1, tally.q_step),
+            pc_done=sel(jnp.zeros_like(tally.pc_done), tally.pc_done),
+            skip_w=sel(jnp.zeros_like(tally.skip_w), tally.skip_w),
+            base_round=sel(zero, tally.base_round),
+            # equiv is cumulative evidence about validators, not about a
+            # height — it survives the advance
+        )
+
     stacked = DeviceMessage(*[jnp.stack([getattr(m, f) for m in msgs])
                               for f in DeviceMessage._fields])
     return StepOutputs(state=state, tally=tally, msgs=stacked)
 
 
-consensus_step_jit = jax.jit(consensus_step)
+consensus_step_jit = jax.jit(consensus_step,
+                             static_argnames=("axis_name", "advance_height"))
 
 N_STAGES = 7

@@ -86,6 +86,14 @@ class TallyState(NamedTuple):
                               class), maintained incrementally so the
                               round-skip check needs no O(W*V) sweep of
                               the voted record per phase.
+    base_round [I]          — absolute round of window row 0.  Window
+                              row w tracks absolute round base+w; the
+                              step's rotation stage advances the base
+                              as instances progress (`rotate_window`),
+                              so round numbers are unbounded like the
+                              reference's per-round map
+                              (round_votes.rs:74-97) even though the
+                              device tracks a fixed W-row window.
     """
 
     weights: jnp.ndarray
@@ -97,6 +105,7 @@ class TallyState(NamedTuple):
     q_step: jnp.ndarray
     pc_done: jnp.ndarray
     skip_w: jnp.ndarray
+    base_round: jnp.ndarray
 
     @classmethod
     def new(cls, n_instances: int, cfg: TallyConfig) -> "TallyState":
@@ -111,6 +120,7 @@ class TallyState(NamedTuple):
             q_step=jnp.full((I_,), -1, I32),
             pc_done=jnp.zeros((I_, W), jnp.bool_),
             skip_w=jnp.zeros((I_, W), I32),
+            base_round=jnp.zeros((I_,), I32),
         )
 
 
@@ -205,17 +215,20 @@ def add_votes(tally: TallyState,
     (SURVEY.md §2.7 "validator-axis data parallelism")."""
     I_, W, _, S1 = tally.weights.shape
 
-    # --- gather this phase's (round, class) rows; votes for rounds
-    # outside the tracked window [0, W) are dropped entirely (the host
-    # driver rotates the window / handles far-future rounds) — they must
-    # not tally, fire events, or flag equivocation
-    in_window = (round_idx >= 0) & (round_idx < W)                   # [I]
+    # --- translate absolute rounds to window rows (row w = absolute
+    # round base+w; the step's rotation stage keeps the window around
+    # each instance's current round).  Votes outside the window are
+    # dropped HERE — the bridge holds back future-round votes until the
+    # window rotates to them and host-tallies past rounds (the fallback
+    # for the reference's unbounded per-round map, round_votes.rs:74-97)
+    widx = round_idx - tally.base_round                              # [I]
+    in_window = (widx >= 0) & (widx < W)                             # [I]
     # invalid slots (outside [VOTED_NIL, S)) are dropped too — clipping
     # them into a real bucket would manufacture a quorum for a value
     # nobody voted for, which arm 14 would commit unconditionally
     valid_slot = (slots >= VOTED_NIL) & (slots < S1 - 1)             # [I, V]
     mask = mask & in_window[:, None] & valid_slot
-    sel_wt = _sel_wt(W, round_idx, typ)                              # [I, W, 2]
+    sel_wt = _sel_wt(W, widx, typ)                                   # [I, W, 2]
     voted_row = _gather_row(tally.voted, sel_wt, fill=NOT_VOTED)     # [I, V]
 
     # --- dedup + equivocation (SURVEY.md §2.3 fix 2)
@@ -261,23 +274,24 @@ def add_votes(tally: TallyState,
     # maintained incrementally: a fresh vote adds its power iff the
     # validator was unseen in the round's OTHER class too (the phase's
     # own class dedup is already `fresh`).
-    sel_other = _sel_wt(W, round_idx, 1 - typ)
+    sel_other = _sel_wt(W, widx, 1 - typ)
     other_row = _gather_row(tally.voted, sel_other, fill=NOT_VOTED)  # [I, V]
     new_voter = fresh & (other_row == NOT_VOTED)
     dskip = jnp.sum(jnp.where(new_voter, powers[None, :], 0), axis=1)  # [I]
     if axis_name is not None:
         dskip = jax.lax.psum(dskip, axis_name)
-    onehot_r = (jnp.arange(W)[None, :] == round_idx[:, None])        # [I, W]
+    onehot_r = (jnp.arange(W)[None, :] == widx[:, None])             # [I, W]
     w_skip = tally.skip_w + jnp.where(onehot_r, dskip[:, None], 0)
+    abs_round = tally.base_round[:, None] + jnp.arange(W)[None, :]   # [I, W]
     eligible = ((3 * w_skip > total_power)
-                & (jnp.arange(W)[None, :] > cur_round[:, None])
+                & (abs_round > cur_round[:, None])
                 & ~tally.skipped)                                    # [I, W]
     any_skip = jnp.any(eligible, axis=1)
+    skip_widx = jnp.argmax(eligible, axis=1).astype(I32)  # lowest eligible
     skip_round = jnp.where(
-        any_skip,
-        jnp.argmax(eligible, axis=1).astype(I32),  # lowest eligible round
-        -1)
-    skipped = tally.skipped | (jnp.arange(W)[None, :] == skip_round[:, None])
+        any_skip, tally.base_round + skip_widx, -1)
+    skipped = tally.skipped | (
+        any_skip[:, None] & (jnp.arange(W)[None, :] == skip_widx[:, None]))
 
     new_tally = tally._replace(weights=weights, voted=voted, emitted=emitted,
                                skipped=skipped, equiv=equiv, skip_w=w_skip)
@@ -292,11 +306,45 @@ def current_threshold(tally: TallyState, round_idx: jnp.ndarray,
     """(code, value_slot) currently reached at [I] (round, class) — the
     re-query path for consumers that advanced step/round after an edge
     was consumed (mirrors core.vote_executor.threshold_events).
-    Out-of-window rounds read as empty (code TH_INIT)."""
+    round_idx is absolute; out-of-window rounds read as empty (TH_INIT)."""
     W = tally.weights.shape[1]
-    sel_wt = _sel_wt(W, round_idx, typ)
+    sel_wt = _sel_wt(W, round_idx - tally.base_round, typ)
     weights_row = _gather_row(tally.weights, sel_wt)
     return _thresh_code(weights_row, total_power)
+
+
+def rotate_window(tally: TallyState, new_base: jnp.ndarray) -> TallyState:
+    """Roll each instance's W-row window forward so row 0 becomes
+    absolute round `new_base` (>= the current base; per-instance).
+
+    Rows for rounds that stay in the window are shifted down; rows
+    entering the window are fresh-empty.  This is the device half of
+    the reference's unbounded per-round tally (round_votes.rs:74-97):
+    combined with the bridge's hold-back of future-round votes and
+    host tally of dropped past rounds, no round is ever silently lost.
+    """
+    W = tally.weights.shape[1]
+    shift = jnp.maximum(new_base - tally.base_round, 0)              # [I]
+    src = jnp.arange(W)[None, :] + shift[:, None]                    # [I, W]
+    keep = src < W
+    srcc = jnp.minimum(src, W - 1)
+
+    def roll(arr, fill):
+        idx = srcc.reshape(srcc.shape + (1,) * (arr.ndim - 2))
+        idx = jnp.broadcast_to(idx, arr.shape)
+        out = jnp.take_along_axis(arr, idx, axis=1)
+        k = keep.reshape(keep.shape + (1,) * (arr.ndim - 2))
+        return jnp.where(k, out, fill)
+
+    return tally._replace(
+        weights=roll(tally.weights, 0),
+        voted=roll(tally.voted, NOT_VOTED),
+        emitted=roll(tally.emitted, TH_INIT),
+        skipped=roll(tally.skipped, False),
+        pc_done=roll(tally.pc_done, False),
+        skip_w=roll(tally.skip_w, 0),
+        base_round=tally.base_round + shift,
+    )
 
 
 add_votes_jit = jax.jit(add_votes)

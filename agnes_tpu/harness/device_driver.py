@@ -44,6 +44,7 @@ from agnes_tpu.core.state_machine import EventTag
 class DriverStats:
     votes_ingested: int = 0
     steps: int = 0
+    decisions_total: int = 0                  # across heights
     decided: Optional[np.ndarray] = None      # [I] bool
     decision_value: Optional[np.ndarray] = None
     decision_round: Optional[np.ndarray] = None
@@ -55,8 +56,10 @@ class DeviceDriver:
 
     def __init__(self, n_instances: int, n_validators: int,
                  n_rounds: int = 4, n_slots: int = 4,
-                 proposer_is_self: bool = True):
+                 proposer_is_self: bool = True,
+                 advance_height: bool = False):
         self.I, self.V = n_instances, n_validators
+        self.advance_height = advance_height
         self.cfg = TallyConfig(n_validators=n_validators, n_rounds=n_rounds,
                                n_slots=n_slots)
         self.state = DeviceState.new((self.I,))
@@ -80,7 +83,8 @@ class DeviceDriver:
             round=jnp.zeros(self.I, I32),
             typ=jnp.zeros(self.I, I32),
             slots=jnp.full((self.I, self.V), NIL_ID, I32),
-            mask=jnp.zeros((self.I, self.V), bool))
+            mask=jnp.zeros((self.I, self.V), bool),
+            height=self.state.height)
 
     def phase(self, round: int, typ: VoteType, slot: int,
               frac: float = 1.0, offset: int = 0) -> VotePhase:
@@ -94,7 +98,8 @@ class DeviceDriver:
             typ=jnp.full(self.I, int(typ), I32),
             slots=jnp.where(voters[None, :], slot, NIL_ID).astype(I32)
             * jnp.ones((self.I, 1), I32),
-            mask=jnp.broadcast_to(voters[None, :], (self.I, self.V)))
+            mask=jnp.broadcast_to(voters[None, :], (self.I, self.V)),
+            height=self.state.height)
 
     def ext(self, tag: int = NULL_EVENT, round: int = 0, value: int = NIL_ID,
             pol_round: int = -1) -> ExtEvent:
@@ -113,7 +118,8 @@ class DeviceDriver:
         phase = phase if phase is not None else self.empty_phase()
         out = consensus_step_jit(self.state, self.tally, ext, phase,
                                  self.powers, self.total,
-                                 self.proposer_flag, self.propose_value)
+                                 self.proposer_flag, self.propose_value,
+                                 advance_height=self.advance_height)
         self.state, self.tally = out.state, out.tally
         self.stats.steps += 1
         self.stats.votes_ingested += int(np.asarray(phase.mask).sum())
@@ -123,6 +129,7 @@ class DeviceDriver:
     def _collect(self, msgs) -> None:
         tags = np.asarray(msgs.tag)            # [stages, I]
         decided_now = (tags == int(MsgTag.DECISION)).any(axis=0)
+        self.stats.decisions_total += int(decided_now.sum())
         if decided_now.any():
             stage = (np.asarray(msgs.tag) == int(MsgTag.DECISION)).argmax(0)
             rows = np.arange(self.I)
@@ -164,6 +171,15 @@ class DeviceDriver:
                                pol_round))
         self.step(phase=self.phase(round, VoteType.PREVOTE, slot))
         self.step(phase=self.phase(round, VoteType.PRECOMMIT, slot))
+
+    def run_heights(self, n_heights: int, slot: int = 1) -> None:
+        """Drive every instance through `n_heights` consecutive honest
+        heights (requires advance_height=True: the device's stage-8
+        reset installs State::new(h+1) after each decision, the
+        reference's consumer contract README.md:43-44)."""
+        assert self.advance_height, "construct with advance_height=True"
+        for _ in range(n_heights):
+            self.run_honest_round(0, slot)
 
     def run_equivocation_phase(self, round: int, typ: VoteType,
                                slot_a: int, slot_b: int,

@@ -58,11 +58,13 @@ _TALLY_SPEC = TallyState(
     q_step=_DATA,
     pc_done=_DATA,
     skip_w=_DATA,
+    base_round=_DATA,
 )
 _EXT_SPEC = ExtEvent(tag=_DATA, round=_DATA, value=_DATA, pol_round=_DATA)
 _PHASE_SPEC = VotePhase(round=_DATA, typ=_DATA,
                         slots=P(DATA_AXIS, VAL_AXIS),
-                        mask=P(DATA_AXIS, VAL_AXIS))
+                        mask=P(DATA_AXIS, VAL_AXIS),
+                        height=_DATA)
 
 
 def _state_spec():

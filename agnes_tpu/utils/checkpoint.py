@@ -57,7 +57,9 @@ def save_driver(driver, path: str) -> None:
     leaves["meta"] = np.asarray([driver.I, driver.V, driver.cfg.n_rounds,
                                  driver.cfg.n_slots,
                                  driver.stats.votes_ingested,
-                                 driver.stats.steps], np.int64)
+                                 driver.stats.steps,
+                                 int(driver.advance_height),
+                                 driver.stats.decisions_total], np.int64)
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **leaves)
@@ -71,10 +73,19 @@ def load_driver(path: str):
     with np.load(path) as z:
         meta = z["meta"]
         d = DeviceDriver(int(meta[0]), int(meta[1]),
-                         n_rounds=int(meta[2]), n_slots=int(meta[3]))
-        d.state = DeviceState(*[jnp.asarray(z[_STATE_PREFIX + n])
+                         n_rounds=int(meta[2]), n_slots=int(meta[3]),
+                         advance_height=bool(meta[6]) if len(meta) > 6
+                         else False)
+
+        def leaf(prefix, n, default):
+            """Pre-rotation snapshots lack the newer leaves (height,
+            base_round); they resume with the fresh-constructed zeros."""
+            key = prefix + n
+            return jnp.asarray(z[key]) if key in z.files else default
+
+        d.state = DeviceState(*[leaf(_STATE_PREFIX, n, getattr(d.state, n))
                                 for n in DeviceState._fields])
-        d.tally = TallyState(*[jnp.asarray(z[_TALLY_PREFIX + n])
+        d.tally = TallyState(*[leaf(_TALLY_PREFIX, n, getattr(d.tally, n))
                                for n in TallyState._fields])
         d.proposer_flag = jnp.asarray(z["cfg.proposer_flag"])
         d.powers = jnp.asarray(z["cfg.powers"])
@@ -85,6 +96,7 @@ def load_driver(path: str):
         d.stats.decision_round = z[_STATS_PREFIX + "decision_round"].copy()
         d.stats.votes_ingested = int(meta[4])
         d.stats.steps = int(meta[5])
+        d.stats.decisions_total = int(meta[7]) if len(meta) > 7 else 0
     return d
 
 
@@ -132,7 +144,9 @@ def load_executor_into(ex, path: str) -> Tuple[int, dict]:
     ex.height = doc["height"]
     ex.evidence = [Equivocation(h, r, VoteType(t), v, fv, sv)
                    for h, r, t, v, fv, sv in doc.get("evidence", [])]
-    leaves = doc["state"]
+    leaves = dict(doc["state"])
+    # pre-height-field snapshots carry height only at the doc level
+    leaves.setdefault("height", doc["height"])
     ds = DeviceState(*[np.int32(leaves[f]) for f in DeviceState._fields])
     ex.state = decode_state(ds, height=ex.height)
     ex.decided = {int(h): Decision(*v) for h, v in doc["decided"].items()}

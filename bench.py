@@ -58,6 +58,7 @@ def bench_tally(n_instances: int = 4096, n_validators: int = 1024,
         typ=jnp.full(I, int(VoteType.PREVOTE), jnp.int32),
         slots=jnp.ones((I, V), jnp.int32),
         mask=jnp.broadcast_to(voters[None, :], (I, V)),
+        height=jnp.zeros(I, jnp.int32),
     )
 
     def step(state, tally):

@@ -1,0 +1,116 @@
+"""Microbenchmark: field-mul chain in two Pallas layouts.
+
+A: current [20, B] (limbs on sublanes, batch on lanes)
+B: vreg-plane [20, bh, 128] (batch tiled (8,128); each limb = vregs)
+
+Times a chain of N dependent rounds of PAR independent fe_muls.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from agnes_tpu.crypto.field_jax import BITS, FOLD, LMASK, NLIMBS, I32
+
+N_CHAIN = 64     # sequential rounds
+PAR = 4          # independent muls per round
+
+
+def _vpass0(r, fold):
+    lo = r & LMASK
+    hi = r >> BITS
+    if fold is None:
+        lo = jnp.concatenate([lo[:-1], r[-1:]], axis=0)
+        shift = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+        return lo + shift
+    shift = jnp.concatenate([hi[-1:] * fold, hi[:-1]], axis=0)
+    return lo + shift
+
+
+def _carry0(r, passes=4):
+    for _ in range(passes):
+        r = _vpass0(r, FOLD)
+    return r
+
+
+def _shift_rows(term, i):
+    pad = [(i, NLIMBS - i)] + [(0, 0)] * (term.ndim - 1)
+    return jnp.pad(term, pad)
+
+
+def _fe_mul(a, b):
+    cols = _shift_rows(a[0:1] * b, 0)
+    for i in range(1, NLIMBS):
+        cols = cols + _shift_rows(a[i:i + 1] * b, i)
+    lo, hi = cols[:NLIMBS], cols[NLIMBS:]
+    for _ in range(3):
+        hi = _vpass0(hi, None)
+    return _carry0(lo + FOLD * hi)
+
+
+def _chain_kernel(x_ref, y_ref, out_ref):
+    xs = [x_ref[:] + i for i in range(PAR)]
+    y = y_ref[:]
+    for _ in range(N_CHAIN):
+        xs = [_fe_mul(x, y) for x in xs]
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = acc + x
+    out_ref[:] = acc
+
+
+def bench(shape_full, block, label, iters=60):
+    """shape_full/block: limbs leading, batch dims trailing; grid over
+    the first batch dim."""
+    x = jnp.asarray(np.random.randint(0, 8192, shape_full, np.int32))
+    y = jnp.asarray(np.random.randint(0, 8192, shape_full, np.int32))
+    nb = len(block) - 1
+    grid_n = shape_full[1] // block[1]
+
+    def imap(g):
+        return (0, g) + (0,) * (nb - 1)
+
+    spec = pl.BlockSpec(block, imap, memory_space=pltpu.VMEM)
+    f = pl.pallas_call(
+        _chain_kernel, grid=(grid_n,), in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(shape_full, jnp.int32))
+    fj = jax.jit(f)
+    out = fj(x, y)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fj(x, y)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    total_lanes = int(np.prod(shape_full[1:]))
+    n_mul = N_CHAIN * PAR
+    ns = dt / (total_lanes * n_mul) * 1e9
+    print(f"{label:30s} dt={dt*1e3:7.2f} ms  {ns:.3f} ns/mul/lane"
+          f"  ({total_lanes*n_mul/dt/1e9:.2f} G mul-lanes/s)")
+
+
+def main():
+    global N_CHAIN
+    T = 16384
+    for b in (512, 1024):
+        bench((NLIMBS, T), (NLIMBS, b), f"A [20,{b}] sublane")
+    for bh in (8, 16):
+        bench((NLIMBS, T // 128, 128), (NLIMBS, bh, 128),
+              f"B [20,{bh},128] vreg-plane")
+    # scaling sanity: double the chain, expect ~2x time
+    N_CHAIN = 128
+    bench((NLIMBS, T), (NLIMBS, 512), "A [20,512] 2x chain")
+    bench((NLIMBS, T // 128, 128), (NLIMBS, 8, 128), "B [20,8,128] 2x chain")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Profile the Ed25519 verify pipeline stage by stage on the real chip.
+
+Times, per batch of B signatures:
+  - straus kernel alone (the double-scalar mult)
+  - pow kernel alone (one (p-2) inversion worth)
+  - XLA-side decompress (minus its pow), compress (minus its pow), sha512
+  - full verify_batch
+at several Pallas batch tile sizes.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from agnes_tpu.core import native
+from agnes_tpu.crypto import ed25519_jax as E
+from agnes_tpu.crypto import pallas_ed25519 as pk
+from agnes_tpu.crypto import scalar_jax as S
+from agnes_tpu.crypto import sha512_jax as sha
+from agnes_tpu.crypto.encoding import vote_signing_bytes
+from agnes_tpu.crypto.field_jax import P
+
+
+def timeit(fn, *args, iters=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    B = 16384
+    seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(B)]
+    msgs = [vote_signing_bytes(1, 0, 0, i % 7) for i in range(B)]
+    pks = [native.pubkey(s) for s in seeds]
+    sigs = [native.sign(s, m) for s, m in zip(seeds, msgs)]
+    pub, sig, blocks = E.pack_verify_inputs_host(pks, msgs, sigs)
+
+    # full pipeline
+    dt = timeit(E.verify_batch_jit, pub, sig, blocks)
+    print(f"full verify_batch      B={B}: {dt*1e3:8.2f} ms  {B/dt:,.0f}/s")
+
+    # sha512 alone
+    f = jax.jit(lambda bl: S.barrett_reduce(
+        S.digest_to_limbs(sha.sha512_blocks(bl))))
+    dt = timeit(f, blocks)
+    print(f"sha512+barrett         B={B}: {dt*1e3:8.2f} ms")
+
+    # decompress (includes 1 pow via pallas)
+    f = jax.jit(lambda p: E.decompress(p)[0].x)
+    dt = timeit(f, pub)
+    print(f"decompress (w/ pow)    B={B}: {dt*1e3:8.2f} ms")
+
+    # pow kernel alone at various tiles
+    x = jnp.asarray(np.random.randint(0, 8192, (B, 20), np.int32))
+    for tile in (256, 512, 1024, 2048):
+        try:
+            f = lambda xx: pk.pow_p_pallas(xx, P - 2, b_tile=tile)
+            dt = timeit(f, x)
+            print(f"pow(p-2) tile={tile:5d}    B={B}: {dt*1e3:8.2f} ms")
+        except Exception as e:
+            print(f"pow tile={tile}: FAIL {type(e).__name__}: {e}")
+
+    # straus kernel alone at various tiles
+    a_pt, _ = E.decompress(pub)
+    a_pt = jax.tree.map(lambda v: jax.block_until_ready(v), a_pt)
+    s_l = S.scalar_from_bytes32(sig[..., 32:])
+    k_l = jax.jit(lambda bl: S.barrett_reduce(
+        S.digest_to_limbs(sha.sha512_blocks(bl))))(blocks)
+    for tile in (256, 512, 1024, 2048):
+        try:
+            f = jax.jit(lambda ss, kk, ap: pk.straus_sub_pallas(
+                ss, kk, ap, b_tile=tile).x)
+            dt = timeit(f, s_l, k_l, a_pt)
+            print(f"straus tile={tile:5d}     B={B}: {dt*1e3:8.2f} ms  "
+                  f"{B/dt:,.0f}/s")
+        except Exception as e:
+            print(f"straus tile={tile}: FAIL {type(e).__name__}: {e}")
+
+    # compress alone (includes 1 pow)
+    q = E.base_point((B,))
+    f = jax.jit(E.compress)
+    dt = timeit(f, q)
+    print(f"compress (w/ pow)      B={B}: {dt*1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""TPU correctness + honest-timing test for the fused verify kernel."""
+import time
+import numpy as np
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+from agnes_tpu.core import native
+from agnes_tpu.crypto import ed25519_jax as E
+from agnes_tpu.crypto import pallas_verify as pv
+from agnes_tpu.crypto.encoding import vote_signing_bytes
+
+B = 16384
+print("building fixtures...", flush=True)
+seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(B)]
+msgs = [vote_signing_bytes(1, 0, 0, i % 7) for i in range(B)]
+pks = [native.pubkey(s) for s in seeds]
+sigs = [native.sign(s, m) for s, m in zip(seeds, msgs)]
+pub, sig, blocks = E.pack_verify_inputs_host(pks, msgs, sigs)
+print("compiling kernel...", flush=True)
+f = jax.jit(pv.verify_batch_pallas)
+t0 = time.time()
+ok = f(pub, sig, blocks)
+okh = np.asarray(ok)
+print(f"compile+run: {time.time()-t0:.1f}s  all_ok={okh.all()} n={okh.sum()}",
+      flush=True)
+assert okh.all()
+
+sigs2 = [bytearray(s) for s in sigs[:4]]
+sigs2[1][5] ^= 4
+pub2, sig2, blocks2 = E.pack_verify_inputs_host(
+    pks[:4], msgs[:4], [bytes(s) for s in sigs2])
+ok2 = np.asarray(f(pub2, sig2, blocks2))
+print("negative check:", ok2, flush=True)
+assert list(ok2) == [True, False, True, True]
+
+iters = 20
+t0 = time.time()
+outs = [f(pub, sig, blocks) for _ in range(iters)]
+for o in outs:
+    _ = np.asarray(o[:1])
+dt = (time.time() - t0) / iters
+print(f"verify v2: {dt*1e3:.2f} ms/batch of {B} -> {B/dt:,.0f} verifies/s",
+      flush=True)

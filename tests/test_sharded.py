@@ -35,12 +35,14 @@ def _phase(round_, typ, votes):
         mask[:, v] = True
     return VotePhase(jnp.full(I, round_, jnp.int32),
                      jnp.full(I, int(typ), jnp.int32),
-                     jnp.asarray(slots), jnp.asarray(mask))
+                     jnp.asarray(slots), jnp.asarray(mask),
+                     jnp.zeros(I, jnp.int32))
 
 
 def _empty_phase():
     return VotePhase(jnp.zeros(I, jnp.int32), jnp.zeros(I, jnp.int32),
-                     jnp.full((I, V), -1, jnp.int32), jnp.zeros((I, V), bool))
+                     jnp.full((I, V), -1, jnp.int32), jnp.zeros((I, V), bool),
+                     jnp.zeros(I, jnp.int32))
 
 
 def _args(state, tally, phase):

@@ -26,7 +26,8 @@ VAL = 2  # value slot this height's proposals use
 def _empty_phase():
     return VotePhase(jnp.zeros(I, jnp.int32), jnp.zeros(I, jnp.int32),
                      jnp.full((I, V), -1, jnp.int32),
-                     jnp.zeros((I, V), bool))
+                     jnp.zeros((I, V), bool),
+                     jnp.zeros(I, jnp.int32))
 
 
 def _phase(round_, typ, votes):
@@ -37,7 +38,8 @@ def _phase(round_, typ, votes):
         mask[:, v] = True
     return VotePhase(jnp.full(I, round_, jnp.int32),
                      jnp.full(I, int(typ), jnp.int32),
-                     jnp.asarray(slots), jnp.asarray(mask))
+                     jnp.asarray(slots), jnp.asarray(mask),
+                     jnp.zeros(I, jnp.int32))
 
 
 def _step(state, tally, ext=None, phase=None, proposer=True):

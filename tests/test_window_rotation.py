@@ -1,0 +1,152 @@
+"""Tally window rotation + on-device height advance.
+
+VERDICT r2 items 2/3: the device tally tracks a W-round window that
+must rotate with the instance's round (the reference tallies *any*
+round via its per-round map, round_votes.rs:74-97), and a decision must
+install State::new(h+1) (README.md:43-44) so multi-height throughput
+never leaves the device.
+
+The long-nil-round scenario is parity-checked against the pure host
+state machine (core.state_machine, the oracle that is itself pinned to
+the reference line-by-line).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from agnes_tpu.core import state_machine as sm
+from agnes_tpu.core.state_machine import EventTag, Step
+from agnes_tpu.device.tally import TallyConfig, TallyState, rotate_window
+from agnes_tpu.harness.device_driver import DeviceDriver
+from agnes_tpu.types import NIL_ID, VoteType
+
+
+def host_nil_rounds_then_decide(n_nil: int, slot: int) -> sm.State:
+    """Oracle: drive one host state machine through n_nil nil rounds and
+    a deciding round, mirroring the driver's schedule."""
+    s = sm.State.new(0)
+    for r in range(n_nil):
+        s, _ = s.apply(r, sm.Event(EventTag.NEW_ROUND))
+        s, _ = s.apply(r, sm.Event(EventTag.TIMEOUT_PROPOSE))
+        s, _ = s.apply(r, sm.Event(EventTag.POLKA_NIL))
+        # precommit-nil quorum maps to PRECOMMIT_ANY (device/tally.py)
+        s, _ = s.apply(r, sm.Event(EventTag.PRECOMMIT_ANY))
+        s, _ = s.apply(r, sm.Event(EventTag.TIMEOUT_PRECOMMIT))
+    r = n_nil
+    s, _ = s.apply(r, sm.Event(EventTag.NEW_ROUND))
+    s, m = s.apply(r, sm.Event(EventTag.PROPOSAL, value=slot, pol_round=-1))
+    s, m = s.apply(r, sm.Event(EventTag.POLKA_VALUE, value=slot))
+    s, m = s.apply(r, sm.Event(EventTag.PRECOMMIT_VALUE, value=slot))
+    assert s.step == Step.COMMIT and m.tag == sm.MsgTag.DECISION
+    return s, m
+
+
+def test_six_nil_rounds_then_round6_decision():
+    """W=4 window, decision at round 6 — impossible without rotation
+    (rounds >= 4 were silently dropped before)."""
+    I, V, slot = 3, 4, 1
+    d = DeviceDriver(I, V, n_rounds=4, n_slots=4, proposer_is_self=False)
+    for r in range(6):
+        d.run_nil_round(r)
+    # after six nil rounds every instance sits at round 6, window rotated
+    assert (np.asarray(d.state.round) == 6).all()
+    assert (np.asarray(d.tally.base_round) == 5).all()
+    d.run_proposed_round(6, slot)
+    assert d.all_decided(value=slot)
+    assert (d.stats.decision_round == 6).all()
+    # parity with the pure host machine
+    s_host, m_host = host_nil_rounds_then_decide(6, slot)
+    assert (np.asarray(d.state.step) == int(s_host.step)).all()
+    assert (np.asarray(d.state.round) == s_host.round).all()
+    assert (d.stats.decision_value == m_host.decision.value).all()
+    assert (d.stats.decision_round == m_host.decision.round).all()
+
+
+def test_rotate_window_preserves_kept_rows():
+    cfg = TallyConfig(n_validators=3, n_rounds=4, n_slots=2)
+    t = TallyState.new(2, cfg)
+    # mark round-2 (row 2) and round-3 (row 3) with distinct data
+    t = t._replace(
+        weights=t.weights.at[:, 2, 0, 1].set(7).at[:, 3, 1, 2].set(9),
+        skip_w=t.skip_w.at[:, 2].set(5),
+        skipped=t.skipped.at[:, 3].set(True))
+    t2 = rotate_window(t, jnp.asarray([2, 0]))
+    # instance 0: base 2 -> old row 2 is new row 0, old row 3 is new row 1
+    assert int(t2.weights[0, 0, 0, 1]) == 7
+    assert int(t2.weights[0, 1, 1, 2]) == 9
+    assert int(t2.skip_w[0, 0]) == 5
+    assert bool(t2.skipped[0, 1])
+    # rows 2..3 are fresh
+    assert int(t2.weights[0, 2].sum()) == 0 and int(t2.weights[0, 3].sum()) == 0
+    assert not bool(t2.skipped[0, 2]) and not bool(t2.skipped[0, 3])
+    # instance 1: base unchanged -> identical rows
+    assert np.array_equal(np.asarray(t2.weights[1]), np.asarray(t.weights[1]))
+    assert int(t2.base_round[0]) == 2 and int(t2.base_round[1]) == 0
+
+
+def test_late_vote_for_rotated_out_round_is_dropped_on_device():
+    """Past-window votes must not tally (the host fallback owns them)."""
+    I, V = 2, 4
+    d = DeviceDriver(I, V, proposer_is_self=False)
+    for r in range(4):
+        d.run_nil_round(r)
+    assert (np.asarray(d.tally.base_round) == 3).all()
+    w_before = np.asarray(d.tally.weights).copy()
+    # a full prevote phase for round 1 (< base): silently dropped
+    d.step(phase=d.phase(1, VoteType.PREVOTE, 1))
+    assert np.array_equal(np.asarray(d.tally.weights), w_before)
+
+
+def test_height_advance_runs_ten_heights():
+    I, V, H = 4, 4, 10
+    d = DeviceDriver(I, V, advance_height=True)
+    d.run_heights(H)
+    assert (np.asarray(d.state.height) == H).all()
+    assert (np.asarray(d.state.step) == int(Step.NEW_ROUND)).all()
+    assert (np.asarray(d.state.round) == 0).all()
+    assert (np.asarray(d.state.locked_round) == -1).all()
+    assert d.stats.decisions_total == I * H
+    # tally fully reset for the next height
+    assert int(np.asarray(d.tally.weights).sum()) == 0
+    assert (np.asarray(d.tally.base_round) == 0).all()
+
+
+def test_height_advance_resets_slots_and_redecides_same_value():
+    """Across heights the same slot decides again — the voted/emitted
+    rows must really have been cleared or dedup would eat the votes."""
+    I, V = 2, 4
+    d = DeviceDriver(I, V, advance_height=True)
+    for h in range(3):
+        d.run_honest_round(0, slot=2)
+        assert d.stats.decisions_total == (h + 1) * I
+    assert (np.asarray(d.state.height) == 3).all()
+
+
+def test_stale_height_phase_is_fenced():
+    """A replayed phase of prior-height votes must not tally after the
+    on-device height advance (VotePhase.height fencing)."""
+    I, V, slot = 2, 4, 1
+    d = DeviceDriver(I, V, advance_height=True)
+    d.step()
+    pv = d.phase(0, VoteType.PREVOTE, slot)     # height-0 phases
+    pc = d.phase(0, VoteType.PRECOMMIT, slot)
+    d.step(phase=pv)
+    d.step(phase=pc)
+    assert d.stats.decisions_total == I         # height 0 decided
+    assert (np.asarray(d.state.height) == 1).all()
+    # replay the identical height-0 quorum phases at height 1
+    d.step(phase=pv)
+    d.step(phase=pc)
+    assert d.stats.decisions_total == I         # no bogus h+1 decision
+    assert int(np.asarray(d.tally.weights).sum()) == 0
+
+
+def test_equiv_evidence_survives_height_advance():
+    I, V = 2, 8
+    d = DeviceDriver(I, V, advance_height=True)
+    d.run_equivocation_phase(0, VoteType.PREVOTE, 1, 2, frac=0.25)
+    flagged = d.equivocators_detected()
+    assert (flagged == 2).all()
+    d.run_honest_round(0, slot=1)
+    assert (np.asarray(d.state.height) == 1).all()
+    assert (d.equivocators_detected() == flagged).all()
