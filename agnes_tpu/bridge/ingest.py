@@ -2,21 +2,42 @@
 
 The ingestion path of the north star: wire votes carrying (instance,
 validator, round, class, value, signature) are batch-verified (JAX
-Ed25519 data plane; C++ fallback) and densified into the [I, V]
-VotePhase matrices the fused step consumes.  Votes that share an
-(instance, validator, round, class) cell cannot ride one dense matrix,
-so the batcher *layers* them: layer k holds each cell's k-th vote —
-conflicting (equivocating) votes land in later layers and still reach
-the device, where the tally's seen-record flags the double-sign.
+Ed25519 data plane) and densified into the [I, V] VotePhase matrices
+the fused step consumes.  Votes that share an (instance, validator,
+round, class) cell cannot ride one dense matrix, so the batcher
+*layers* them: layer k holds each cell's k-th vote — conflicting
+(equivocating) votes land in later layers and still reach the device,
+where the tally's seen-record flags the double-sign.
 
-The reference's analogue is the one-vote-at-a-time
-`VoteExecutor::apply` loop (vote_executor.rs:20-23, SURVEY §3.2); this
-is that loop turned into a batched device pipeline.
+The whole build is **vectorized numpy** (sort + run-length layering +
+fancy-indexed scatter); per-vote Python only ever touches *unique new
+values* (slot interning).  The array-native entry point is
+`add_arrays`; `add(WireVote)` remains for sparse/test callers.  The
+reference's analogue is the one-vote-at-a-time `VoteExecutor::apply`
+loop (vote_executor.rs:20-23, SURVEY §3.2); this is that loop turned
+into a batched device pipeline.
+
+Window discipline (pairs with device/tally.py's rotating W-round
+window; the reference tallies any round via its per-round map,
+round_votes.rs:74-97):
+
+  - FUTURE rounds (>= base+W) are *held back* and re-enter
+    automatically once `sync_device` reports the rotated window.
+  - PAST rounds (< base) are tallied on HOST (core.round_votes
+    semantics): a late +2/3 precommit-value quorum still surfaces as a
+    PRECOMMIT_VALUE event (`drain_host_events`) because
+    commit-from-any-round (state_machine.rs:211) must fire no matter
+    how late the quorum assembles.
+
+Evidence: verified votes are retained per build as array batches, so a
+device-side `tally.equiv` flag can be joined back to the two
+conflicting *signed* votes (`signed_evidence`) — slashable proof the
+reference's tally cannot produce (round_votes.rs:48-56 double-counts
+instead; SURVEY §2.3 fix 2).
 """
 
 from __future__ import annotations
 
-from collections import defaultdict
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -25,10 +46,13 @@ import numpy as np
 import jax.numpy as jnp
 
 from agnes_tpu.bridge.value_table import SlotMap
-from agnes_tpu.crypto.encoding import vote_signing_bytes
+from agnes_tpu.core.round_votes import RoundVotes, ThreshKind
+from agnes_tpu.crypto.encoding import VOTE_MSG_LEN
 from agnes_tpu.device.step import VotePhase
 from agnes_tpu.device.tally import VOTED_NIL
-from agnes_tpu.types import NIL_ID, VoteType
+from agnes_tpu.types import NIL_ID, Vote, VoteType
+
+_NIL = -1                 # array encoding of a nil vote's value
 
 
 @dataclass(frozen=True)
@@ -44,45 +68,222 @@ class WireVote:
     signature: Optional[bytes] = None
 
 
+@dataclass
+class _Batch:
+    """Column arrays for one pending/retained batch of votes."""
+
+    instance: np.ndarray       # [N] int64
+    validator: np.ndarray      # [N] int64
+    height: np.ndarray         # [N] int64
+    round: np.ndarray          # [N] int64
+    typ: np.ndarray            # [N] int64
+    value: np.ndarray          # [N] int64 (_NIL = nil)
+    signature: Optional[np.ndarray]   # [N, 64] uint8 or None
+
+    def __len__(self) -> int:
+        return len(self.instance)
+
+    def take(self, idx: np.ndarray) -> "_Batch":
+        return _Batch(
+            self.instance[idx], self.validator[idx], self.height[idx],
+            self.round[idx], self.typ[idx], self.value[idx],
+            self.signature[idx] if self.signature is not None else None)
+
+
+def _concat(batches: List[_Batch]) -> _Batch:
+    sig = None
+    if any(b.signature is not None for b in batches):
+        sig = np.concatenate([
+            b.signature if b.signature is not None
+            else np.zeros((len(b), 64), np.uint8) for b in batches])
+    return _Batch(*([np.concatenate([getattr(b, f) for b in batches])
+                     for f in ("instance", "validator", "height", "round",
+                               "typ", "value")] + [sig]))
+
+
+def vote_messages_np(height: np.ndarray, round_: np.ndarray,
+                     typ: np.ndarray, value: np.ndarray) -> np.ndarray:
+    """[N] int64 columns -> [N, 45] uint8 canonical signing messages —
+    the vectorized twin of crypto.encoding.vote_signing_bytes (value
+    _NIL signs the all-ones NIL_WIRE field)."""
+    n = len(height)
+    msg = np.zeros((n, VOTE_MSG_LEN), np.uint8)
+    msg[:, 0] = (typ & 0xFF).astype(np.uint8)
+    h = height.astype(np.uint64)
+    for i in range(8):
+        msg[:, 1 + i] = ((h >> np.uint64(8 * i))
+                         & np.uint64(0xFF)).astype(np.uint8)
+    r = round_.astype(np.int64).astype(np.uint32)
+    for i in range(4):
+        msg[:, 9 + i] = ((r >> np.uint32(8 * i))
+                         & np.uint32(0xFF)).astype(np.uint8)
+    nil = value == _NIL
+    v = np.where(nil, 0, value).astype(np.uint64)
+    for i in range(8):          # value ids are < 2^31; 8 LE bytes cover
+        msg[:, 13 + i] = ((v >> np.uint64(8 * i))
+                          & np.uint64(0xFF)).astype(np.uint8)
+    msg[nil, 13:45] = 0xFF      # NIL_WIRE = 2^256 - 1
+    return msg
+
+
+def _sha_blocks_np(r_bytes: np.ndarray, a_bytes: np.ndarray,
+                   msg: np.ndarray) -> np.ndarray:
+    """R[N,32] || A[N,32] || M[N,45] -> [N, 1, 32] uint32 padded
+    SHA-512 blocks (109 bytes + 0x80 + 16-byte bit length = 1 block),
+    the vectorized twin of sha512_jax.pack_padded_host."""
+    n = len(msg)
+    buf = np.zeros((n, 128), np.uint8)
+    buf[:, :32] = r_bytes
+    buf[:, 32:64] = a_bytes
+    buf[:, 64:109] = msg
+    buf[:, 109] = 0x80
+    bitlen = 109 * 8
+    buf[:, 126] = (bitlen >> 8) & 0xFF
+    buf[:, 127] = bitlen & 0xFF
+    w = buf.reshape(n, 32, 4).astype(np.uint32)
+    words = (w[:, :, 0] << 24) | (w[:, :, 1] << 16) \
+        | (w[:, :, 2] << 8) | w[:, :, 3]
+    return words.reshape(n, 1, 32)
+
+
 class VoteBatcher:
     """Collects wire votes for one ingestion tick and emits dense
     phases.  One batcher per (driver, height window)."""
 
     def __init__(self, n_instances: int, n_validators: int, n_slots: int,
-                 heights: Optional[np.ndarray] = None):
+                 heights: Optional[np.ndarray] = None,
+                 n_rounds: int = 4,
+                 powers: Optional[np.ndarray] = None):
         self.I, self.V = n_instances, n_validators
+        self.W = n_rounds
         self.slots = SlotMap(n_instances, n_slots)
-        # per-instance height (defaults: all at height 0)
-        self.heights = (heights if heights is not None
-                        else np.zeros(n_instances, np.int64))
-        self._pending: List[WireVote] = []
+        # per-instance height / window base (synced from the device)
+        self.heights = np.asarray(
+            heights if heights is not None
+            else np.zeros(n_instances, np.int64)).astype(np.int64)
+        self.base_round = np.zeros(n_instances, np.int64)
+        self.powers = (np.asarray(powers, np.int64) if powers is not None
+                       else np.ones(n_validators, np.int64))
+        self._pending: List[_Batch] = []
+        self._held: List[_Batch] = []          # future-round hold-back
+        self._log: List[_Batch] = []           # verified votes (evidence)
         self.rejected_signature = 0
         self.rejected_malformed = 0
-        self.overflow_votes: List[WireVote] = []
+        self.overflow_votes = 0
+        self.dropped_stale_height = 0
+        # host fallback tallies for past (rotated-out) rounds
+        self._host_tally: Dict[Tuple[int, int], RoundVotes] = {}
+        self._host_events: List[Tuple[int, int, int]] = []
+
+    # -- enqueue -------------------------------------------------------------
+
+    def add_arrays(self, instance, validator, height, round_, typ, value,
+                   signatures: Optional[np.ndarray] = None) -> None:
+        """Bulk enqueue: [N] integer arrays (+ optional [N, 64] uint8
+        signatures).  value < 0 means nil.  This is the fast path — no
+        per-vote Python objects anywhere."""
+        self._pending.append(_Batch(
+            np.asarray(instance, np.int64), np.asarray(validator, np.int64),
+            np.asarray(height, np.int64), np.asarray(round_, np.int64),
+            np.asarray(typ, np.int64),
+            np.asarray(value, np.int64),
+            np.asarray(signatures, np.uint8)
+            if signatures is not None else None))
 
     def add(self, vote: WireVote) -> None:
-        self._pending.append(vote)
+        if vote.signature is not None and len(vote.signature) != 64:
+            # wrong-length signatures can't ride the [N, 64] column;
+            # screen here (one hostile vote must not DoS the tick)
+            self.rejected_malformed += 1
+            return
+        sig = (np.frombuffer(vote.signature, np.uint8)[None, :]
+               if vote.signature is not None else None)
+        self.add_arrays([vote.instance], [vote.validator], [vote.height],
+                        [vote.round], [int(vote.typ)],
+                        [_NIL if vote.value is None else vote.value], sig)
 
     def extend(self, votes) -> None:
-        self._pending.extend(votes)
+        for v in votes:
+            self.add(v)
 
-    # -- signature verification ---------------------------------------------
+    # -- device sync ---------------------------------------------------------
 
-    def _verify_batch(self, votes: List[WireVote],
-                      pubkeys: np.ndarray) -> List[bool]:
+    def sync_device(self, base_round, heights) -> None:
+        """Adopt the device plane's rotated window bases and heights
+        (call after each step when rotation/height-advance are live).
+        Held future-round votes whose window arrived re-enter the
+        pending queue; a height advance resets that instance's slots."""
+        new_heights = np.asarray(heights, np.int64)
+        advanced = np.nonzero(new_heights > self.heights)[0]
+        for i in advanced:
+            self.slots.reset_instance(int(i))
+        if len(advanced):
+            adv = set(int(i) for i in advanced)
+            # decided heights can never commit again: drop their host
+            # tallies (and never mix them into newer heights' quorums)
+            self._host_tally = {
+                k: v for k, v in self._host_tally.items()
+                if not (k[0] in adv and k[1] < new_heights[k[0]])}
+        self.heights = new_heights
+        self.base_round = np.asarray(base_round, np.int64)
+        if self._held:
+            held, self._held = self._held, []
+            self._pending.extend(held)
+
+    def clear_log(self) -> None:
+        """Drop retained evidence batches (extract evidence for flagged
+        validators via `signed_evidence` first)."""
+        self._log = []
+
+    # -- signature verification ----------------------------------------------
+
+    def _verify(self, b: _Batch, pubkeys: np.ndarray) -> np.ndarray:
         """Batch-verify on the JAX plane; pubkeys [V, 32] uint8 is the
-        device-resident validator table (ValidatorSet.device_arrays)."""
+        device-resident validator table (ValidatorSet.device_arrays).
+        Returns [N] bool."""
         from agnes_tpu.crypto import ed25519_jax as ejax
 
-        pks, msgs, sigs = [], [], []
-        for v in votes:
-            pks.append(pubkeys[v.validator].tobytes())
-            msgs.append(vote_signing_bytes(v.height, v.round, int(v.typ),
-                                           v.value))
-            sigs.append(v.signature or b"\x00" * 64)
-        pub, sig, blocks = ejax.pack_verify_inputs_host(pks, msgs, sigs)
-        ok = ejax.verify_batch_jit(pub, sig, blocks)
-        return np.asarray(ok).tolist()
+        msg = vote_messages_np(b.height, b.round, b.typ, b.value)
+        a_bytes = pubkeys[b.validator]                    # [N, 32]
+        r_bytes = b.signature[:, :32]
+        blocks = jnp.asarray(_sha_blocks_np(r_bytes, a_bytes, msg))
+        pub = jnp.asarray(a_bytes.astype(np.int32))
+        sig = jnp.asarray(b.signature.astype(np.int32))
+        return np.asarray(ejax.verify_batch_jit(pub, sig, blocks))
+
+    # -- host fallback for past rounds ---------------------------------------
+
+    def _host_tally_past(self, b: _Batch) -> None:
+        """Tally rotated-out rounds with the host RoundVotes (exact
+        core semantics: per-value buckets, dedup, evidence).  Only the
+        commit-critical threshold is surfaced: +2/3 precommit-value at
+        ANY round decides (state_machine.rs:211)."""
+        total = int(self.powers.sum())
+        for k in range(len(b)):
+            inst, hgt, rnd = (int(b.instance[k]), int(b.height[k]),
+                              int(b.round[k]))
+            # keyed by height too: a tally must never mix votes from
+            # different heights into one quorum
+            rv = self._host_tally.get((inst, hgt, rnd))
+            if rv is None:
+                rv = RoundVotes(height=hgt, round=rnd, total=total)
+                self._host_tally[(inst, hgt, rnd)] = rv
+            val = None if b.value[k] == _NIL else int(b.value[k])
+            thresh = rv.add_vote(
+                Vote(typ=VoteType(int(b.typ[k])), round=rnd, value=val,
+                     height=hgt, validator=int(b.validator[k])),
+                int(self.powers[b.validator[k]]))
+            if (int(b.typ[k]) == int(VoteType.PRECOMMIT)
+                    and thresh.kind == ThreshKind.VALUE):
+                self._host_events.append((inst, hgt, rnd, thresh.value))
+
+    def drain_host_events(self) -> List[Tuple[int, int, int, int]]:
+        """[(instance, height, round, value_id)] late precommit-value
+        quorums detected by the host fallback; the driver injects these
+        as PRECOMMIT_VALUE ext events (commit-from-any-round) iff the
+        instance is still at that height."""
+        ev, self._host_events = self._host_events, []
+        return ev
 
     # -- densification -------------------------------------------------------
 
@@ -93,76 +294,234 @@ class VoteBatcher:
         Returns [(phase, n_votes)], one per (round, class, layer),
         deterministic order.  With `pubkeys` given, signatures are
         batch-verified first and failures dropped (and counted)."""
-        votes, self._pending = self._pending, []
-        keep = []
-        for v in votes:
-            if not (0 <= v.instance < self.I and 0 <= v.validator < self.V
-                    and v.round >= 0
-                    and (v.value is None or 0 <= v.value < 2**31)
-                    and (v.signature is None or len(v.signature) == 64)
-                    and v.height == self.heights[v.instance]):
-                self.rejected_malformed += 1
-                continue
-            keep.append(v)
-        if pubkeys is not None and keep:
-            ok = self._verify_batch(keep, pubkeys)
-            self.rejected_signature += len(keep) - sum(ok)
-            keep = [v for v, good in zip(keep, ok) if good]
+        if not self._pending:
+            return []
+        b, self._pending = _concat(self._pending), []
+        n0 = len(b)
+        if n0 == 0:
+            return []
 
-        # exact-duplicate dedup: gossip redelivery of the same vote must
-        # not burn a whole dense layer (the device tally would no-op it
-        # anyway, but each layer is a full [I, V] fused step)
-        seen_exact = set()
-        deduped = []
-        for v in keep:
-            key = (v.instance, v.validator, v.round, int(v.typ), v.value)
-            if key in seen_exact:
-                continue
-            seen_exact.add(key)
-            deduped.append(v)
-        keep = deduped
+        # --- malformed screen (vectorized; typ outside {0,1} would
+        # alias into the wrong (round, class) group downstream)
+        ok = ((b.instance >= 0) & (b.instance < self.I)
+              & (b.validator >= 0) & (b.validator < self.V)
+              & (b.round >= 0) & (b.round < 2**31)
+              & (b.typ >= 0) & (b.typ <= 1)
+              & (b.value < 2**31))
+        self.rejected_malformed += int(n0 - ok.sum())
+        # height gate: votes for other heights than the instance's are
+        # stale (or early); counted separately from malformed
+        inst_c = np.clip(b.instance, 0, self.I - 1)
+        h_ok = b.height == self.heights[inst_c]
+        self.dropped_stale_height += int((ok & ~h_ok).sum())
+        b = b.take(np.nonzero(ok & h_ok)[0])
+        if len(b) == 0:
+            return []
+        # normalize the nil encoding (contract: any value < 0 is nil)
+        if (b.value < _NIL).any():
+            b.value[b.value < 0] = _NIL
 
-        # group by (round, typ); layer repeated (instance, validator)
-        groups: Dict[Tuple[int, int], List[List[WireVote]]] = \
-            defaultdict(list)
-        depth: Dict[Tuple[int, int, int, int], int] = defaultdict(int)
-        for v in keep:
-            gk = (v.round, int(v.typ))
-            ck = (v.instance, v.validator, v.round, int(v.typ))
-            layer = depth[ck]
-            depth[ck] += 1
-            layers = groups[gk]
-            while len(layers) <= layer:
-                layers.append([])
-            layers[layer].append(v)
+        # --- hold back future rounds BEFORE verification: they are
+        # verified (and logged) once, when the window reaches them —
+        # not once per tick they sit in the queue
+        widx = b.round - self.base_round[b.instance]
+        future = widx >= self.W
+        if future.any():
+            self._held.append(b.take(np.nonzero(future)[0]))
+            b = b.take(np.nonzero(~future)[0])
+            if len(b) == 0:
+                return []
 
+        # --- signature verification (batched, one kernel call).  When
+        # pubkeys are supplied, unsigned votes must FAIL, not bypass:
+        # missing signature columns verify as zero signatures.
+        if pubkeys is not None:
+            if b.signature is None:
+                b = _Batch(b.instance, b.validator, b.height, b.round,
+                           b.typ, b.value,
+                           np.zeros((len(b), 64), np.uint8))
+            good = self._verify(b, pubkeys)
+            self.rejected_signature += int(len(b) - good.sum())
+            b = b.take(np.nonzero(good)[0])
+            if len(b) == 0:
+                return []
+
+        # --- retain verified votes for slashable evidence
+        self._log.append(b)
+
+        # --- past (rotated-out) rounds go to the host tally
+        past = (b.round - self.base_round[b.instance]) < 0
+        if past.any():
+            self._host_tally_past(b.take(np.nonzero(past)[0]))
+            b = b.take(np.nonzero(~past)[0])
+            if len(b) == 0:
+                return []
+
+        # --- fast path: one (round, class), every (instance, validator)
+        # cell occupied at most once — the common shape (a gossip tick
+        # of one phase's honest votes).  O(n) bincount check; no sorts.
+        same_rt = (b.round[0] == b.round).all() and (b.typ[0] == b.typ).all()
+        if same_rt:
+            cell_id = b.instance * self.V + b.validator
+            counts = np.bincount(cell_id, minlength=self.I * self.V)
+            if (counts <= 1).all():
+                b, slot = self._intern_and_spill(b)
+                if len(b) == 0:
+                    return []
+                return self._emit([(b, slot, int(b.round[0]),
+                                    int(b.typ[0]))])
+
+        # --- general path: ONE lexsort orders everything; duplicates,
+        # layers and phase groups all fall out of adjacency scans.
+        # Sorting (value, arrival) last makes equal-value redeliveries
+        # adjacent within their cell — exact dedup with no second sort.
+        arrival = np.arange(len(b))
+        order = np.lexsort((arrival, b.value, b.validator, b.instance,
+                            b.typ, b.round))
+        bs = b.take(order)
+
+        def cell_runs(x: _Batch) -> np.ndarray:
+            return ((x.round[1:] == x.round[:-1])
+                    & (x.typ[1:] == x.typ[:-1])
+                    & (x.instance[1:] == x.instance[:-1])
+                    & (x.validator[1:] == x.validator[:-1]))
+
+        same_cell = cell_runs(bs)
+        dup = np.zeros(len(bs), bool)
+        dup[1:] = same_cell & (bs.value[1:] == bs.value[:-1])
+        if dup.any():
+            bs = bs.take(np.nonzero(~dup)[0])
+            same_cell = cell_runs(bs)
+        n = len(bs)
+
+        # layer = rank within the (still sorted) cell run
+        new_cell = np.ones(n, bool)
+        new_cell[1:] = ~same_cell
+        group_start = np.maximum.accumulate(
+            np.where(new_cell, np.arange(n), 0))
+        layer = np.arange(n) - group_start
+
+        bs, slot, layer = self._intern_and_spill(bs, layer)
+        if len(bs) == 0:
+            return []
+
+        # group into phases by packed (round, typ, layer) int64 key
+        pkey = ((bs.round.astype(np.int64) << 22)
+                | (bs.typ.astype(np.int64) << 21)
+                | np.minimum(layer, (1 << 21) - 1))
+        ukeys, pinv = np.unique(pkey, return_inverse=True)
+        groups = []
+        for p, k in enumerate(ukeys):
+            sel = np.nonzero(pinv == p)[0]
+            groups.append((bs.take(sel), slot[sel],
+                           int(k >> 22), int((k >> 21) & 1)))
+        return self._emit(groups)
+
+    def _intern_and_spill(self, b: _Batch, layer: Optional[np.ndarray] = None):
+        """Intern slots; votes whose value overflows the instance's
+        slot budget spill to the HOST tally (SlotMap's documented
+        fallback for many-value floods) so a quorum on an untracked
+        value still commits via drain_host_events.  Returns the kept
+        batch + slots (+ layers when given)."""
+        slot = self._intern_slots(b)
+        ovf = slot == VOTED_NIL - 1
+        if ovf.any():
+            self._host_tally_past(b.take(np.nonzero(ovf)[0]))
+            keep = np.nonzero(~ovf)[0]
+            b, slot = b.take(keep), slot[~ovf]
+            if layer is not None:
+                layer = layer[~ovf]
+        return (b, slot) if layer is None else (b, slot, layer)
+
+    def _intern_slots(self, b: _Batch) -> np.ndarray:
+        """[N] slot per vote (VOTED_NIL for nil, VOTED_NIL-1 for
+        overflow); python only over UNIQUE new (instance, value)."""
+        slot = np.full(len(b), VOTED_NIL, np.int64)
+        nonnil = b.value >= 0
+        if nonnil.any():
+            nn = np.nonzero(nonnil)[0]
+            if (b.value[nn] == b.value[nn[0]]).all():
+                # single proposal value (the common case): unique pairs
+                # are just the distinct instances; map via an array LUT
+                uinst = np.unique(b.instance[nn])
+                v0 = int(b.value[nn[0]])
+                lut = np.full(self.I, VOTED_NIL - 1, np.int64)
+                for inst in uinst:
+                    s = self.slots.slot_for(int(inst), v0)
+                    lut[inst] = VOTED_NIL - 1 if s is None else s
+                slot[nn] = lut[b.instance[nn]]
+            else:
+                pair = (b.instance[nn].astype(np.int64) << 31) \
+                    | b.value[nn].astype(np.int64)
+                upairs, inv = np.unique(pair, return_inverse=True)
+                uslots = np.empty(len(upairs), np.int64)
+                for j, pk in enumerate(upairs):
+                    s = self.slots.slot_for(int(pk >> 31),
+                                            int(pk & (2**31 - 1)))
+                    uslots[j] = VOTED_NIL - 1 if s is None else s
+                slot[nn] = uslots[inv]
+        ovf = int((slot == VOTED_NIL - 1).sum())
+        self.overflow_votes += ovf
+        return slot
+
+    def _emit(self, groups) -> List[Tuple[VotePhase, int]]:
+        """[(batch, slot, round, typ)] -> dense VotePhases (fancy-index
+        scatter; no per-vote python)."""
+        hts = jnp.asarray(self.heights.astype(np.int32))
         phases: List[Tuple[VotePhase, int]] = []
-        for (rnd, typ) in sorted(groups):
-            for layer_votes in groups[(rnd, typ)]:
-                slots = np.full((self.I, self.V), VOTED_NIL, np.int32)
-                mask = np.zeros((self.I, self.V), bool)
-                n = 0
-                for v in layer_votes:
-                    if v.value is None:
-                        slot = VOTED_NIL
-                    else:
-                        s = self.slots.slot_for(v.instance, v.value)
-                        if s is None:
-                            self.overflow_votes.append(v)
-                            continue
-                        slot = s
-                    slots[v.instance, v.validator] = slot
-                    mask[v.instance, v.validator] = True
-                    n += 1
-                if n == 0:
-                    continue
-                phases.append((VotePhase(
-                    round=jnp.full(self.I, rnd, jnp.int32),
-                    typ=jnp.full(self.I, typ, jnp.int32),
-                    slots=jnp.asarray(slots),
-                    mask=jnp.asarray(mask),
-                    height=jnp.asarray(self.heights, jnp.int32)), n))
+        for bg, sg, rnd, typ in groups:
+            keep = sg != VOTED_NIL - 1
+            if not keep.all():
+                idx = np.nonzero(keep)[0]
+                bg, sg = bg.take(idx), sg[idx]
+            if len(bg) == 0:
+                continue
+            slots = np.full((self.I, self.V), VOTED_NIL, np.int32)
+            mask = np.zeros((self.I, self.V), bool)
+            slots[bg.instance, bg.validator] = sg
+            mask[bg.instance, bg.validator] = True
+            phases.append((VotePhase(
+                round=jnp.full(self.I, rnd, jnp.int32),
+                typ=jnp.full(self.I, typ, jnp.int32),
+                slots=jnp.asarray(slots),
+                mask=jnp.asarray(mask),
+                height=hts), int(len(bg))))
         return phases
+
+    # -- evidence ------------------------------------------------------------
+
+    def signed_evidence(self, instance: int, validator: int
+                        ) -> Optional[Tuple[WireVote, WireVote]]:
+        """Join a device equivocation flag back to the two conflicting
+        *signed* votes: scans the retained verified batches for two
+        votes by `validator` in `instance` with the same (height,
+        round, class) and different values.  Returns (first, second)
+        WireVotes whose signatures prove the double-sign to any third
+        party, or None."""
+        seen: Dict[Tuple[int, int, int], Tuple[int, Optional[bytes]]] = {}
+        for batch in self._log:
+            hit = np.nonzero((batch.instance == instance)
+                             & (batch.validator == validator))[0]
+            for k in hit:
+                key = (int(batch.height[k]), int(batch.round[k]),
+                       int(batch.typ[k]))
+                val = int(batch.value[k])
+                sig = (batch.signature[k].tobytes()
+                       if batch.signature is not None else None)
+                if key not in seen:
+                    seen[key] = (val, sig)
+                elif seen[key][0] != val:
+                    h, r, t = key
+                    fv, fsig = seen[key]
+
+                    def mk(v, s):
+                        return WireVote(
+                            instance=instance, validator=validator,
+                            height=h, round=r, typ=VoteType(t),
+                            value=None if v == _NIL else v, signature=s)
+
+                    return mk(fv, fsig), mk(val, sig)
+        return None
 
     def decode_slot(self, instance: int, slot: int) -> Optional[int]:
         """Device slot -> value id (for reading decisions back)."""

@@ -76,6 +76,21 @@ class DeviceDriver:
             decision_value=np.full(self.I, NIL_ID, np.int32),
             decision_round=np.full(self.I, -1, np.int32))
 
+    def set_proposer_table(self, flags, rotation_period: int) -> None:
+        """Install a round-varying proposer table.  The device indexes
+        it round % R (device/step.py stage 5), which is exact only when
+        R is a multiple of the rotation period (weighted round-robin
+        repeats every total_power rounds) — enforced here because the
+        device can't check a static shape against a traced total."""
+        flags = jnp.asarray(flags, bool)
+        const = bool(np.asarray(
+            (flags == flags[:, :1]).all()))  # row-constant: any R valid
+        if not const:
+            assert flags.shape[1] % rotation_period == 0, (
+                f"proposer table covers {flags.shape[1]} rounds; must be"
+                f" a multiple of the rotation period {rotation_period}")
+        self.proposer_flag = flags
+
     # -- phase builders ------------------------------------------------------
 
     def empty_phase(self) -> VotePhase:

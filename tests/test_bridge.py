@@ -124,7 +124,7 @@ def test_batcher_drops_cross_height_votes():
     b.add(WireVote(0, 1, 5, 0, VoteType.PREVOTE, 1))   # right height
     b.add(WireVote(1, 1, 5, 0, VoteType.PREVOTE, 1))   # wrong height
     phases = b.build_phases()
-    assert b.rejected_malformed == 1
+    assert b.dropped_stale_height == 1
     assert sum(n for _, n in phases) == 1
 
 
@@ -141,6 +141,181 @@ def test_batcher_rejects_wrong_length_signature():
     phases = b.build_phases(pubkeys=pub)
     assert b.rejected_malformed == 2
     assert phases == []
+
+
+def test_batcher_holds_back_future_rounds_until_rotation():
+    """Votes beyond the device window [base, base+W) are held and
+    re-emitted after sync_device reports the rotated window (VERDICT r2
+    missing #1: no silent drop)."""
+    I, V = 1, 4
+    b = VoteBatcher(I, V, n_slots=4, n_rounds=4)
+    for v in range(V):
+        b.add(WireVote(0, v, 0, 10, VoteType.PREVOTE, value=5))
+    assert b.build_phases() == []          # round 10 outside [0, 4)
+    # device rotates its window to base 9
+    b.sync_device(base_round=np.asarray([9]), heights=np.asarray([0]))
+    phases = b.build_phases()
+    assert len(phases) == 1
+    phase, n = phases[0]
+    assert n == V and int(phase.round[0]) == 10
+
+
+def test_batcher_host_tallies_rotated_out_rounds():
+    """A late +2/3 precommit-value quorum for a round below the window
+    base surfaces as a host event (commit-from-any-round,
+    state_machine.rs:211)."""
+    I, V = 1, 4
+    b = VoteBatcher(I, V, n_slots=4, n_rounds=4)
+    b.sync_device(base_round=np.asarray([7]), heights=np.asarray([0]))
+    for v in range(3):                     # 3 of 4 = +2/3
+        b.add(WireVote(0, v, 0, 2, VoteType.PRECOMMIT, value=42))
+    assert b.build_phases() == []          # nothing reaches the device
+    assert b.drain_host_events() == [(0, 0, 2, 42)]
+    assert b.drain_host_events() == []     # drained
+
+
+def test_host_tally_never_mixes_heights():
+    """Code-review r3 finding: the host fallback must key by height —
+    2 height-0 precommits + 1 height-1 precommit for the same (round,
+    value) must NOT form a quorum."""
+    I, V = 1, 4
+    b = VoteBatcher(I, V, n_slots=4, n_rounds=4)
+    b.sync_device(base_round=np.asarray([7]), heights=np.asarray([0]))
+    for v in range(2):                     # 2 of 4: no quorum
+        b.add(WireVote(0, v, 0, 2, VoteType.PRECOMMIT, value=42))
+    b.build_phases()
+    assert b.drain_host_events() == []
+    # instance advances to height 1; its height-0 tallies are dropped
+    b.sync_device(base_round=np.asarray([0]), heights=np.asarray([1]))
+    b.sync_device(base_round=np.asarray([7]), heights=np.asarray([1]))
+    b.add(WireVote(0, 2, 1, 2, VoteType.PRECOMMIT, value=42))
+    b.build_phases()
+    assert b.drain_host_events() == []     # 1 vote at height 1: no quorum
+
+
+def test_unsigned_votes_fail_when_verification_requested():
+    """Code-review r3 finding: an all-unsigned tick must not bypass
+    signature verification when pubkeys are supplied."""
+    seeds = [bytes([i + 1]) * 32 for i in range(4)]
+    pub = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                    for s in seeds])
+    b = VoteBatcher(1, 4, n_slots=4)
+    for v in range(4):
+        b.add(WireVote(0, v, 0, 0, VoteType.PREVOTE, 7))  # no signature
+    assert b.build_phases(pubkeys=pub) == []
+    assert b.rejected_signature == 4
+
+
+def test_invalid_typ_is_malformed():
+    b = VoteBatcher(1, 4, n_slots=4)
+    b.add_arrays([0], [1], [0], [0], [2], [7])     # typ 2: invalid
+    b.add_arrays([0], [2], [0], [0], [-1], [7])    # typ -1: invalid
+    assert b.build_phases() == []
+    assert b.rejected_malformed == 2
+
+
+def test_held_votes_are_not_relogged_each_tick():
+    """Code-review r3 finding: far-future votes must not be re-verified
+    or duplicated into the evidence log every tick they sit held."""
+    b = VoteBatcher(1, 4, n_slots=4, n_rounds=4)
+    b.add(WireVote(0, 1, 0, 50, VoteType.PREVOTE, 5))
+    for _ in range(3):
+        assert b.build_phases() == []
+        b.sync_device(base_round=np.asarray([0]), heights=np.asarray([0]))
+    assert len(b._log) == 0                # held votes never logged
+    b.sync_device(base_round=np.asarray([49]), heights=np.asarray([0]))
+    phases = b.build_phases()
+    assert len(phases) == 1 and phases[0][1] == 1
+    assert len(b._log) == 1                # logged exactly once
+
+
+def test_slot_overflow_spills_to_host_tally():
+    """Code-review r3 finding: values beyond the slot budget must reach
+    the host tally (quorums on them still commit), not vanish."""
+    I, V = 1, 4
+    b = VoteBatcher(I, V, n_slots=2, n_rounds=4)
+    # values 1,2 fill the slots; 3 of 4 validators then precommit a
+    # third value -> untrackable on device, quorum must surface on host
+    b.add(WireVote(0, 0, 0, 0, VoteType.PREVOTE, 1))
+    b.add(WireVote(0, 1, 0, 0, VoteType.PREVOTE, 2))
+    for v in range(3):
+        b.add(WireVote(0, v, 0, 0, VoteType.PRECOMMIT, 30303))
+    phases = b.build_phases()
+    assert sum(n for _, n in phases) == 2  # the two tracked prevotes
+    assert b.overflow_votes == 3
+    assert b.drain_host_events() == [(0, 0, 0, 30303)]
+
+
+def test_batcher_signed_evidence_reconstructs_double_sign():
+    """Device equiv flag -> the two conflicting SIGNED votes (VERDICT
+    r2 weak #7: device evidence must be slashable)."""
+    I, V = 1, 4
+    seeds = [bytes([i + 1]) * 32 for i in range(V)]
+    pub = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                    for s in seeds])
+    b = VoteBatcher(I, V, n_slots=4)
+    for v in range(V):
+        b.add(_signed_vote(seeds, 0, v, 0, 0, VoteType.PREVOTE, 7))
+    # validator 2 double-signs a conflicting value
+    b.add(_signed_vote(seeds, 0, 2, 0, 0, VoteType.PREVOTE, 9))
+    phases = b.build_phases(pub)
+    assert len(phases) == 2                # conflict lands in layer 1
+    d = DeviceDriver(I, V)
+    d.step()
+    for phase, _ in phases:
+        d.step(phase=phase)
+    flagged = np.nonzero(np.asarray(d.tally.equiv)[0])[0]
+    assert list(flagged) == [2]
+    ev = b.signed_evidence(0, 2)
+    assert ev is not None
+    first, second = ev
+    assert {first.value, second.value} == {7, 9}
+    assert first.round == second.round == 0
+    assert first.typ == second.typ == VoteType.PREVOTE
+    # the signatures really are that validator's, over those values —
+    # provable to any third party with only the pubkey
+    from agnes_tpu.crypto import ed25519_ref as ref
+    for w in (first, second):
+        msg = vote_signing_bytes(w.height, w.round, int(w.typ), w.value)
+        assert ref.verify(native.pubkey(seeds[2]), msg, w.signature)
+    # an honest validator yields no evidence
+    assert b.signed_evidence(0, 1) is None
+
+
+def test_batcher_add_arrays_bulk_path():
+    """The array-native fast path produces the same phases as add()."""
+    I, V = 2, 4
+    b1 = VoteBatcher(I, V, n_slots=4)
+    b2 = VoteBatcher(I, V, n_slots=4)
+    insts, vals, rnds, typs, vids = [], [], [], [], []
+    for inst in range(I):
+        for v in range(V):
+            b1.add(WireVote(inst, v, 0, 1, VoteType.PREVOTE, value=33))
+            insts.append(inst)
+            vals.append(v)
+            rnds.append(1)
+            typs.append(int(VoteType.PREVOTE))
+            vids.append(33)
+    b2.add_arrays(insts, vals, np.zeros(len(insts)), rnds, typs, vids)
+    p1 = b1.build_phases()
+    p2 = b2.build_phases()
+    assert len(p1) == len(p2) == 1
+    (ph1, n1), (ph2, n2) = p1[0], p2[0]
+    assert n1 == n2 == I * V
+    assert np.array_equal(np.asarray(ph1.slots), np.asarray(ph2.slots))
+    assert np.array_equal(np.asarray(ph1.mask), np.asarray(ph2.mask))
+
+
+def test_vote_messages_np_matches_scalar_encoding():
+    from agnes_tpu.bridge.ingest import vote_messages_np
+    cases = [(0, 0, 0, 7), (3, 9, 1, None), (2**40, 2**20, 1, 2**30)]
+    h = np.asarray([c[0] for c in cases], np.int64)
+    r = np.asarray([c[1] for c in cases], np.int64)
+    t = np.asarray([c[2] for c in cases], np.int64)
+    v = np.asarray([-1 if c[3] is None else c[3] for c in cases], np.int64)
+    got = vote_messages_np(h, r, t, v)
+    for i, (hh, rr, tt, vv) in enumerate(cases):
+        assert got[i].tobytes() == vote_signing_bytes(hh, rr, tt, vv)
 
 
 def test_native_verify_rejects_wrong_length_inputs():
