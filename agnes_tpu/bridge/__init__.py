@@ -11,11 +11,19 @@ README.md:46-49) is exactly where the TPU/host boundary goes (SURVEY.md
                   slot mapping the tally kernels index by.
   ingest.py       VoteBatcher: sparse signed wire votes in, batched
                   signature verification + dense per-(round, class)
-                  VotePhase matrices out.
+                  VotePhase matrices out (vectorized numpy).
+  native_ingest.py  NativeIngestLoop: the C++ event loop twin of
+                  VoteBatcher (core/native/ingest.cpp) — packed wire
+                  BYTES in, double-buffered dense phases out; the
+                  network-facing fast lane.
 
 The device side of the ABI is device/step.py's VotePhase/ExtEvent and
 the validator table from ValidatorSet.device_arrays().
 """
 
 from agnes_tpu.bridge.ingest import VoteBatcher, WireVote  # noqa: F401
+from agnes_tpu.bridge.native_ingest import (  # noqa: F401
+    NativeIngestLoop,
+    pack_wire_votes,
+)
 from agnes_tpu.bridge.value_table import SlotMap, ValueTable  # noqa: F401

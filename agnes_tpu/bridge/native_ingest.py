@@ -1,0 +1,244 @@
+"""NativeIngestLoop: the C++ ingestion event loop (ctypes wrapper).
+
+The native twin of `bridge.VoteBatcher` — SURVEY.md §2.7's "C++ event
+loop feeding device batches; double-buffered host<->device queues"
+slot, re-imagining the reference's one-vote-at-a-time executor loop
+(reference consensus_executor.rs:24-49) as a batch pipeline in
+core/native/ingest.cpp.  Wire votes arrive as PACKED BYTES (the
+network-facing ABI; `pack_wire_votes` builds them from columns), flow
+through parse -> screen -> window discipline -> TPU batch verify ->
+dedup/layer/intern -> dense [I, V] phases, with rotated-out rounds
+falling back to the exact C++ RoundVotes host tally (late
+precommit-value quorums surface via `drain_host_events`, because
+commit-from-any-round — reference state_machine.rs:211 — must fire no
+matter how late the quorum assembles).
+
+Differential parity with VoteBatcher: tests/test_native_ingest.py.
+
+Double buffering: `ag_ing_emit` flips between two phase-buffer sets,
+so the numpy views a previous emit handed to the device remain stable
+while C++ fills the other set — the host<->device queue overlap the
+SURVEY names.  Views are zero-copy; jnp.asarray at the device boundary
+makes the device copy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from agnes_tpu.core.native_build import lib as _build_lib
+from agnes_tpu.device.step import VotePhase
+
+REC_SIZE = 96
+
+_configured = False
+
+
+def _lib() -> ctypes.CDLL:
+    global _configured
+    L = _build_lib()
+    if not _configured:
+        c = ctypes
+        L.ag_ing_new.restype = c.c_void_p
+        L.ag_ing_new.argtypes = [c.c_int64, c.c_int64, c.c_int64,
+                                 c.c_int64, c.c_char_p, c.c_void_p]
+        L.ag_ing_free.argtypes = [c.c_void_p]
+        L.ag_ing_sync.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+        L.ag_ing_push.restype = c.c_int64
+        L.ag_ing_push.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        L.ag_ing_stage.restype = c.c_int64
+        L.ag_ing_stage.argtypes = [c.c_void_p]
+        L.ag_ing_fill_verify_inputs.argtypes = [c.c_void_p, c.c_void_p,
+                                                c.c_void_p, c.c_void_p]
+        L.ag_ing_apply_verdicts.restype = c.c_int64
+        L.ag_ing_apply_verdicts.argtypes = [c.c_void_p, c.c_char_p]
+        L.ag_ing_emit.restype = c.c_int64
+        L.ag_ing_emit.argtypes = [c.c_void_p]
+        L.ag_ing_phase.restype = c.c_int64
+        L.ag_ing_phase.argtypes = [
+            c.c_void_p, c.c_int64, c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.POINTER(c.c_int64),
+            c.POINTER(c.POINTER(c.c_int32)),
+            c.POINTER(c.POINTER(c.c_uint8))]
+        L.ag_ing_drain_events.restype = c.c_int64
+        L.ag_ing_drain_events.argtypes = [c.c_void_p, c.c_void_p,
+                                          c.c_int64]
+        L.ag_ing_decode_slot.restype = c.c_int64
+        L.ag_ing_decode_slot.argtypes = [c.c_void_p, c.c_int64, c.c_int32]
+        L.ag_ing_evidence.restype = c.c_int64
+        L.ag_ing_evidence.argtypes = [c.c_void_p, c.c_int64, c.c_int64,
+                                      c.c_char_p]
+        L.ag_ing_clear_log.argtypes = [c.c_void_p]
+        L.ag_ing_counters.argtypes = [c.c_void_p, c.c_void_p]
+        _configured = True
+    return L
+
+
+def pack_wire_votes(instance, validator, height, round_, typ, value,
+                    signatures: Optional[np.ndarray] = None) -> bytes:
+    """Column arrays -> packed 96-byte wire records (vectorized).
+    value < 0 encodes nil."""
+    n = len(np.asarray(instance))
+    rec = np.zeros((n, REC_SIZE), np.uint8)
+    rec[:, 0:4] = np.asarray(instance, np.uint32)[:, None].view(
+        np.uint8).reshape(n, 4)
+    rec[:, 4:8] = np.asarray(validator, np.uint32)[:, None].view(
+        np.uint8).reshape(n, 4)
+    rec[:, 8:16] = np.asarray(height, np.int64)[:, None].view(
+        np.uint8).reshape(n, 8)
+    rec[:, 16:20] = np.asarray(round_, np.int32)[:, None].view(
+        np.uint8).reshape(n, 4)
+    rec[:, 20] = np.asarray(typ, np.uint8)
+    val = np.asarray(value, np.int64)
+    rec[:, 21] = (val >= 0).astype(np.uint8)
+    rec[:, 24:32] = np.maximum(val, 0)[:, None].view(
+        np.uint8).reshape(n, 8)
+    if signatures is not None:
+        rec[:, 32:96] = np.asarray(signatures, np.uint8).reshape(n, 64)
+    return rec.tobytes()
+
+
+class NativeIngestLoop:
+    """One C++ ingestion loop per (driver, height window) — the native
+    fast lane with the same tick protocol as VoteBatcher."""
+
+    def __init__(self, n_instances: int, n_validators: int,
+                 n_slots: int, n_rounds: int = 4,
+                 pubkeys: Optional[np.ndarray] = None,
+                 powers: Optional[np.ndarray] = None):
+        self.I, self.V = n_instances, n_validators
+        self.signed = pubkeys is not None
+        L = _lib()
+        if pubkeys is not None:
+            pubkeys = np.ascontiguousarray(pubkeys, np.uint8)
+            if pubkeys.shape != (n_validators, 32):
+                # the C side copies V*32 bytes blind; screen here
+                # (the wrapper-screen contract of core/native.py)
+                raise ValueError(
+                    f"pubkeys must be [{n_validators}, 32] uint8, "
+                    f"got {pubkeys.shape}")
+        pk = pubkeys.tobytes() if pubkeys is not None else None
+        pw = None
+        if powers is not None:
+            pw = np.ascontiguousarray(powers, np.int64)
+            if pw.shape != (n_validators,):
+                raise ValueError(
+                    f"powers must be [{n_validators}], got {pw.shape}")
+        self._h = L.ag_ing_new(
+            n_instances, n_validators, n_rounds, n_slots, pk,
+            pw.ctypes.data if pw is not None else None)
+        self._free = L.ag_ing_free
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._free(self._h)
+            self._h = None
+
+    # -- tick protocol -------------------------------------------------------
+
+    def sync_device(self, base_round, heights) -> None:
+        base = np.ascontiguousarray(base_round, np.int64)
+        hts = np.ascontiguousarray(heights, np.int64)
+        self._heights = hts
+        _lib().ag_ing_sync(self._h, base.ctypes.data, hts.ctypes.data)
+
+    def push(self, wire_bytes: bytes) -> int:
+        """Packed wire records in; returns lanes accepted (held counts
+        as accepted; rejects show up in `counters`)."""
+        n = len(wire_bytes) // REC_SIZE
+        return _lib().ag_ing_push(self._h, wire_bytes, n)
+
+    def build_phases(self) -> List[Tuple[VotePhase, int]]:
+        """Stage -> (verify on device if signed) -> emit.  Returns
+        [(phase, n_votes)] like VoteBatcher.build_phases; the phase
+        arrays are zero-copy views into the C++ double buffer."""
+        L = _lib()
+        n = L.ag_ing_stage(self._h)
+        if n == 0:
+            ok = None
+        elif self.signed:
+            from agnes_tpu.crypto import ed25519_jax as ejax
+
+            pub = np.empty((n, 32), np.int32)
+            sig = np.empty((n, 64), np.int32)
+            blocks = np.empty((n, 32), np.uint32)
+            L.ag_ing_fill_verify_inputs(
+                self._h, pub.ctypes.data, sig.ctypes.data,
+                blocks.ctypes.data)
+            good = np.asarray(ejax.verify_batch_jit(
+                jnp.asarray(pub), jnp.asarray(sig),
+                jnp.asarray(blocks.reshape(n, 1, 32))))
+            ok = np.ascontiguousarray(good, np.uint8)
+        else:
+            ok = None
+        if n:
+            rc = L.ag_ing_apply_verdicts(
+                self._h, ok.tobytes() if ok is not None else None)
+            assert rc >= 0, "signed loop requires verdicts"
+        n_phases = L.ag_ing_emit(self._h)
+        hts = jnp.asarray(getattr(
+            self, "_heights", np.zeros(self.I, np.int64)).astype(np.int32))
+        out: List[Tuple[VotePhase, int]] = []
+        c = ctypes
+        for k in range(n_phases):
+            rnd, typ = c.c_int32(), c.c_int32()
+            nv = c.c_int64()
+            slots_p = c.POINTER(c.c_int32)()
+            mask_p = c.POINTER(c.c_uint8)()
+            L.ag_ing_phase(self._h, k, c.byref(rnd), c.byref(typ),
+                           c.byref(nv), c.byref(slots_p), c.byref(mask_p))
+            slots = np.ctypeslib.as_array(
+                slots_p, shape=(self.I, self.V))
+            mask = np.ctypeslib.as_array(
+                mask_p, shape=(self.I, self.V))
+            out.append((VotePhase(
+                round=jnp.full(self.I, int(rnd.value), jnp.int32),
+                typ=jnp.full(self.I, int(typ.value), jnp.int32),
+                slots=jnp.asarray(slots),
+                mask=jnp.asarray(mask.astype(bool)),
+                height=hts), int(nv.value)))
+        return out
+
+    # -- host fallback / evidence / introspection ----------------------------
+
+    def drain_host_events(self) -> List[Tuple[int, int, int, int]]:
+        buf = np.empty((64, 4), np.int64)
+        out: List[Tuple[int, int, int, int]] = []
+        while True:
+            n = _lib().ag_ing_drain_events(self._h, buf.ctypes.data, 64)
+            out.extend(tuple(int(x) for x in row) for row in buf[:n])
+            if n < 64:
+                return out
+
+    def decode_slot(self, instance: int, slot: int) -> Optional[int]:
+        v = _lib().ag_ing_decode_slot(self._h, instance, slot)
+        return None if v < 0 else int(v)
+
+    def signed_evidence(self, instance: int, validator: int
+                        ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Two conflicting signed wire records ([96] uint8 each), or
+        None — the slashable proof for a device equivocation flag."""
+        buf = ctypes.create_string_buffer(2 * REC_SIZE)
+        if not _lib().ag_ing_evidence(self._h, instance, validator, buf):
+            return None
+        raw = np.frombuffer(buf.raw, np.uint8)
+        return raw[:REC_SIZE].copy(), raw[REC_SIZE:].copy()
+
+    def clear_log(self) -> None:
+        _lib().ag_ing_clear_log(self._h)
+
+    @property
+    def counters(self) -> dict:
+        buf = np.empty(6, np.int64)
+        _lib().ag_ing_counters(self._h, buf.ctypes.data)
+        return {"rejected_malformed": int(buf[0]),
+                "dropped_stale_height": int(buf[1]),
+                "rejected_signature": int(buf[2]),
+                "overflow_votes": int(buf[3]),
+                "held": int(buf[4]),
+                "log": int(buf[5])}

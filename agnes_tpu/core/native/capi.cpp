@@ -89,6 +89,10 @@ void ag_apply(const AgState* s, int64_t round, const AgEvent* e,
 // --- tally handle -----------------------------------------------------------
 
 void* ag_tally_new(int64_t height, int64_t round, int64_t total) {
+  // hostile negative totals would make is_quorum(0, total) true (an
+  // empty tally reporting a quorum); clamp to the empty-set total here
+  // so the core keeps exact Python-oracle parity for in-domain inputs
+  if (total < 0) total = 0;
   return new agnes::RoundVotes(height, round, total);
 }
 

@@ -155,7 +155,7 @@ void apply(const State& s, int64_t round, const Event& e, State* os,
   if (tag == EventTag::PrecommitAny && eqr)
     return schedule_timeout_precommit(s, os, om);                // 47
   if (tag == EventTag::TimeoutPrecommit && eqr)
-    return round_skip(s, round + 1, os, om);                     // 65
+    return round_skip(s, sat_add(round, 1), os, om);             // 65
   if (tag == EventTag::RoundSkip && s.round < round)
     return round_skip(s, round, os, om);                         // 55
   if (tag == EventTag::PrecommitValue)                           // no guard!
@@ -168,14 +168,17 @@ void apply(const State& s, int64_t round, const Event& e, State* os,
 
 ThreshKind VoteCount::add(int64_t value, int64_t weight,
                           int64_t* thresh_value) {
-  if (value == kNoValue) nil_ += weight;
-  else weights_[value] += weight;
+  if (value == kNoValue) nil_ = sat_add(nil_, weight);
+  else {
+    int64_t& w = weights_[value];
+    w = sat_add(w, weight);
+  }
   return thresh(thresh_value);
 }
 
 int64_t VoteCount::seen_weight() const {
   int64_t w = nil_;
-  for (const auto& kv : weights_) w += kv.second;
+  for (const auto& kv : weights_) w = sat_add(w, kv.second);
   return w;
 }
 
@@ -197,24 +200,30 @@ ThreshKind VoteCount::thresh(int64_t* thresh_value) const {
 ThreshKind RoundVotes::add_vote(VoteType typ, int64_t validator,
                                 int64_t value, int64_t weight,
                                 int64_t* thresh_value) {
-  VoteCount& count =
-      (typ == VoteType::Prevote) ? prevotes_ : precommits_;
+  // normalize the tag to its CLASS before doing anything keyed by it:
+  // every non-prevote tag routes to precommits_, so a hostile caller
+  // replaying distinct raw tags must not get distinct seen_ keys (that
+  // would double-count one validator's weight into a forged quorum)
+  int32_t cls = (typ == VoteType::Prevote) ? 0 : 1;
+  VoteCount& count = cls == 0 ? prevotes_ : precommits_;
   if (validator != kNoValue) {
-    auto key = std::make_pair(validator, static_cast<int32_t>(typ));
+    auto key = std::make_pair(validator, cls);
     auto it = seen_.find(key);
     if (it != seen_.end()) {
       // duplicate or conflict: not counted; conflict -> one evidence
       // record per (validator, type)
       if (it->second.first != value && !flagged_.count(key)) {
         flagged_.insert(key);
-        equiv_.push_back({height_, round_, typ, validator,
+        equiv_.push_back({height_, round_,
+                          static_cast<VoteType>(cls), validator,
                           it->second.first, value});
       }
       return count.thresh(thresh_value);
     }
     seen_[key] = {value, weight};
   } else {
-    anon_weight_[static_cast<int32_t>(typ)] += weight;
+    int64_t& aw = anon_weight_[cls];
+    aw = sat_add(aw, weight);
   }
   return count.add(value, weight, thresh_value);
 }
@@ -230,7 +239,7 @@ int64_t RoundVotes::skip_weight() const {
     if (it == by_validator.end() || it->second < w) by_validator[v] = w;
   }
   int64_t sum = std::max(anon_weight_[0], anon_weight_[1]);
-  for (const auto& kv : by_validator) sum += kv.second;
+  for (const auto& kv : by_validator) sum = sat_add(sum, kv.second);
   return sum;
 }
 
@@ -286,7 +295,7 @@ bool ValidatorSet::remove(const uint8_t pk[32]) {
 
 int64_t ValidatorSet::total_power() const {
   int64_t t = 0;
-  for (const auto& v : vals_) t += v.voting_power;
+  for (const auto& v : vals_) t = sat_add(t, v.voting_power);
   return t;
 }
 
@@ -314,9 +323,11 @@ int64_t ProposerRotation::step() {
     next[std::move(addr)] = (it == priorities_.end()) ? 0 : it->second;
   }
   priorities_ = std::move(next);
-  for (const auto& v : vals)
-    priorities_[std::vector<uint8_t>(v.public_key, v.public_key + 32)] +=
-        v.voting_power;
+  for (const auto& v : vals) {
+    int64_t& p =
+        priorities_[std::vector<uint8_t>(v.public_key, v.public_key + 32)];
+    p = sat_add(p, v.voting_power);
+  }
   int64_t best = 0;
   int64_t best_p = INT64_MIN;
   for (size_t i = 0; i < vals.size(); ++i) {
@@ -324,9 +335,9 @@ int64_t ProposerRotation::step() {
         vals[i].public_key, vals[i].public_key + 32)];
     if (p > best_p) { best_p = p; best = static_cast<int64_t>(i); }
   }
-  priorities_[std::vector<uint8_t>(vals[best].public_key,
-                                   vals[best].public_key + 32)] -=
-      set_->total_power();
+  int64_t& bp = priorities_[std::vector<uint8_t>(
+      vals[best].public_key, vals[best].public_key + 32)];
+  bp = sat_sub(bp, set_->total_power());
   return best;
 }
 

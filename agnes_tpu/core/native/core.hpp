@@ -85,8 +85,31 @@ void apply(const State& s, int64_t round, const Event& e,
 
 enum class ThreshKind : int32_t { Init = 0, Any = 1, Nil = 2, Value = 3 };
 
-inline bool is_quorum(int64_t v, int64_t total) { return 3 * v > 2 * total; }
-inline bool is_one_third(int64_t v, int64_t total) { return 3 * v > total; }
+// 128-bit products: the raw C ABI accepts arbitrary int64 weights, so
+// 3*v / 2*total must not overflow (reference round_votes.rs:31-33 is
+// safe only because Rust debug builds trap; here hostile callers reach
+// this directly through capi.cpp)
+inline bool is_quorum(int64_t v, int64_t total) {
+  return static_cast<__int128>(3) * v > static_cast<__int128>(2) * total;
+}
+inline bool is_one_third(int64_t v, int64_t total) {
+  return static_cast<__int128>(3) * v > static_cast<__int128>(total);
+}
+
+// saturating accumulate for weight tallies: hostile extreme weights
+// clamp instead of wrapping (wrap could un-cross a crossed quorum)
+inline int64_t sat_add(int64_t a, int64_t b) {
+  __int128 s = static_cast<__int128>(a) + b;
+  if (s > INT64_MAX) return INT64_MAX;
+  if (s < INT64_MIN) return INT64_MIN;
+  return static_cast<int64_t>(s);
+}
+inline int64_t sat_sub(int64_t a, int64_t b) {
+  __int128 s = static_cast<__int128>(a) - b;
+  if (s > INT64_MAX) return INT64_MAX;
+  if (s < INT64_MIN) return INT64_MIN;
+  return static_cast<int64_t>(s);
+}
 
 struct Equivocation {
   int64_t height, round;

@@ -341,7 +341,10 @@ def apply(s: State, round: int, event: Event) -> Tuple[State, Optional[Message]]
     if tag == E.PRECOMMIT_ANY and eqr:
         return _schedule_timeout_precommit(s)                # 47
     if tag == E.TIMEOUT_PRECOMMIT and eqr:
-        return _round_skip(s, round + 1)                     # 65
+        # rounds live in int64 everywhere (wire, device, C++); saturate
+        # at the edge so the oracle and the native core stay bit-for-bit
+        # even for hostile round = INT64_MAX inputs
+        return _round_skip(s, min(round + 1, 2**63 - 1))     # 65
     if tag == E.ROUND_SKIP and s.round < round:
         return _round_skip(s, round)                         # 55
     if tag == E.PRECOMMIT_VALUE:                             # no round guard!

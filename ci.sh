@@ -1,0 +1,49 @@
+#!/bin/bash
+# CI for agnes_tpu (SURVEY.md §5 "TSAN/ASAN CI jobs" slot).
+#
+#   1. sanitizer pass — rebuild the C++ core with ASan+UBSan and run
+#      the C++-vs-Python differential suite plus the adversarial C-ABI
+#      fuzz file under it (the raw-pointer ctypes surface, capi.cpp);
+#   2. full pytest on the virtual 8-device CPU mesh;
+#   3. bench smoke (CI_BENCH=0 skips; the driver runs the real bench
+#      on TPU hardware at end of round).
+#
+# The purity/testability argument the whole design serves (reference
+# README.md:8-14) is enforced by (2); memory safety of the native layer
+# by (1).  TSAN is not run: the C++ core is handle-per-caller with no
+# shared mutable state or threads (capi.cpp), so there is nothing for
+# a race detector to check yet — revisit when the C++ event loop lands.
+set -euo pipefail
+cd "$(dirname "$0")"
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+echo "=== [1/3] ASan+UBSan: native differential + C-ABI fuzz ==="
+ASAN_SO="$(g++ -print-file-name=libasan.so)"
+UBSAN_SO="$(g++ -print-file-name=libubsan.so)"
+# halt_on_error makes sanitizer findings fail CI; leak checking is off
+# because the host python itself leaks by design.  Reports go to
+# san_report.* files (pytest's capture can swallow the stderr report
+# when halt_on_error kills the process mid-test).
+SAN_LOG="$(mktemp -d)/san_report"
+AGNES_NATIVE_SANITIZE="address,undefined" \
+  LD_PRELOAD="$ASAN_SO $UBSAN_SO" \
+  ASAN_OPTIONS="detect_leaks=0,halt_on_error=1,log_path=$SAN_LOG" \
+  UBSAN_OPTIONS="halt_on_error=1,print_stacktrace=1,log_path=$SAN_LOG" \
+  python -m pytest tests/test_native_core.py tests/test_capi_fuzz.py \
+    tests/test_native_ingest.py -q -p no:cacheprovider \
+  || { cat "$SAN_LOG".* 2>/dev/null; exit 1; }
+
+echo "=== [2/3] full test suite (virtual 8-device CPU mesh) ==="
+# step 1 already ran the native differential + fuzz files under ASan
+# (a strict superset of the non-sanitized run) — skip them here
+python -m pytest tests/ -q -p no:cacheprovider \
+  --ignore=tests/test_native_core.py --ignore=tests/test_capi_fuzz.py \
+  --ignore=tests/test_native_ingest.py
+
+if [ "${CI_BENCH:-1}" != "0" ]; then
+  echo "=== [3/3] bench ==="
+  python bench.py
+else
+  echo "=== [3/3] bench skipped (CI_BENCH=0) ==="
+fi
+echo "CI GREEN"

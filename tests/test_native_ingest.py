@@ -1,0 +1,282 @@
+"""NativeIngestLoop (C++ event loop) vs VoteBatcher differential suite.
+
+The C++ pipeline in core/native/ingest.cpp must produce bit-identical
+dense phases to the vectorized-numpy VoteBatcher for the same vote
+stream: same screens, same window discipline, same dedup/layering,
+same slot interning order, same host-fallback events, same evidence.
+(The reference's analogue of this surface is the executor's inbound
+alphabet, consensus_executor.rs:16-20 — SURVEY §2.5.)
+"""
+
+import numpy as np
+import pytest
+
+from agnes_tpu.bridge import NativeIngestLoop, VoteBatcher, pack_wire_votes
+from agnes_tpu.core import native
+from agnes_tpu.types import VoteType
+
+PV, PC = int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)
+
+
+def _phases_np(phases):
+    """[(VotePhase, n)] -> comparable numpy tuples."""
+    out = []
+    for ph, n in phases:
+        out.append((int(np.asarray(ph.round)[0]),
+                    int(np.asarray(ph.typ)[0]),
+                    n,
+                    np.asarray(ph.slots),
+                    np.asarray(ph.mask)))
+    return out
+
+
+def _assert_same(native_phases, batcher_phases):
+    a, b = _phases_np(native_phases), _phases_np(batcher_phases)
+    assert len(a) == len(b), (len(a), len(b))
+    for (ra, ta, na, sa, ma), (rb, tb, nb, sb, mb) in zip(a, b):
+        assert (ra, ta, na) == (rb, tb, nb)
+        np.testing.assert_array_equal(sa, sb)
+        np.testing.assert_array_equal(ma, mb)
+
+
+def _pair(I, V, n_slots=4, W=4):
+    loop = NativeIngestLoop(I, V, n_slots=n_slots, n_rounds=W)
+    bat = VoteBatcher(I, V, n_slots=n_slots, n_rounds=W)
+    return loop, bat
+
+
+def _feed(loop, bat, cols):
+    inst, val, h, rnd, typ, value = (np.asarray(c) for c in cols)
+    loop.push(pack_wire_votes(inst, val, h, rnd, typ, value))
+    bat.add_arrays(inst, val, h, rnd, typ, value)
+    return loop.build_phases(), bat.build_phases()
+
+
+def test_honest_dense_tick_parity():
+    I, V = 8, 16
+    loop, bat = _pair(I, V)
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+    a, b = _feed(loop, bat, (inst, val, np.zeros(n), np.zeros(n),
+                             np.full(n, PV), np.full(n, 7)))
+    _assert_same(a, b)
+    assert len(a) == 1 and a[0][1] == n
+
+
+def test_layering_and_dedup_parity():
+    I, V = 4, 8
+    loop, bat = _pair(I, V)
+    # equivocating validator 2 (two values), duplicate from validator 3,
+    # nil from validator 4, mixed rounds/classes
+    inst = np.array([0, 0, 0, 0, 0, 1, 1, 2, 0])
+    val = np.array([2, 2, 3, 3, 4, 5, 5, 6, 2])
+    h = np.zeros(9)
+    rnd = np.array([0, 0, 0, 0, 0, 1, 1, 0, 0])
+    typ = np.array([PV, PV, PV, PV, PV, PC, PC, PV, PV])
+    value = np.array([7, 9, 7, 7, -1, 8, 8, -1, 7])
+    a, b = _feed(loop, bat, (inst, val, h, rnd, typ, value))
+    _assert_same(a, b)
+    # validator 2's second value must land in layer 1 => extra phase
+    assert len(a) >= 2
+
+
+def test_malformed_and_stale_screen_parity():
+    I, V = 4, 4
+    loop, bat = _pair(I, V)
+    inst = np.array([0, 99, 1, 2, 3])
+    val = np.array([0, 1, 99, 2, 3])
+    h = np.array([0, 0, 0, 5, 0])          # 5 = stale height
+    rnd = np.zeros(5)
+    typ = np.array([PV, PV, PV, PV, 9])    # 9 = hostile class
+    value = np.full(5, 7)
+    a, b = _feed(loop, bat, (inst, val, h, rnd, typ, value))
+    _assert_same(a, b)
+    c = loop.counters
+    assert c["rejected_malformed"] == 3 == bat.rejected_malformed
+    assert c["dropped_stale_height"] == 1 == bat.dropped_stale_height
+
+
+def test_future_holdback_and_rotation_reentry_parity():
+    I, V = 2, 4
+    loop, bat = _pair(I, V, W=4)
+    inst = np.zeros(4, np.int64)
+    val = np.arange(4)
+    # round 6 is outside the W=4 window at base 0 -> held
+    a, b = _feed(loop, bat, (inst, val, np.zeros(4), np.full(4, 6),
+                             np.full(4, PV), np.full(4, 7)))
+    _assert_same(a, b)
+    assert a == [] and loop.counters["held"] == 4
+    # rotation arrives: base 4 -> the held votes re-enter
+    base = np.full(I, 4, np.int64)
+    hts = np.zeros(I, np.int64)
+    loop.sync_device(base, hts)
+    bat.sync_device(base, hts)
+    a, b = loop.build_phases(), bat.build_phases()
+    _assert_same(a, b)
+    assert len(a) == 1 and a[0][1] == 4
+    assert loop.counters["held"] == 0
+
+
+def test_past_round_host_fallback_event_parity():
+    I, V = 2, 4
+    loop, bat = _pair(I, V)
+    base = np.array([2, 0], np.int64)      # instance 0's window moved on
+    hts = np.zeros(I, np.int64)
+    loop.sync_device(base, hts)
+    bat.sync_device(base, hts)
+    # +2/3 precommits for value 9 at (instance 0, round 1 < base) —
+    # must surface as a commit-from-any-round host event
+    inst = np.zeros(3, np.int64)
+    val = np.arange(3)
+    a, b = _feed(loop, bat, (inst, val, np.zeros(3), np.ones(3),
+                             np.full(3, PC), np.full(3, 9)))
+    _assert_same(a, b)
+    assert a == []
+    ev_l = loop.drain_host_events()
+    ev_b = bat.drain_host_events()
+    assert ev_l == [(0, 0, 1, 9)] == ev_b
+    assert loop.drain_host_events() == []
+
+
+def test_slot_overflow_spills_to_host_parity():
+    I, V = 1, 8
+    loop, bat = _pair(I, V, n_slots=2)
+    # 4 distinct values: slots 0,1 allocated, values 30/40 overflow
+    inst = np.zeros(8, np.int64)
+    val = np.arange(8)
+    value = np.array([10, 10, 20, 20, 30, 30, 40, 40])
+    a, b = _feed(loop, bat, (inst, val, np.zeros(8), np.zeros(8),
+                             np.full(8, PV), value))
+    _assert_same(a, b)
+    assert loop.counters["overflow_votes"] == 4 == bat.overflow_votes
+    assert loop.decode_slot(0, 0) == 10 and loop.decode_slot(0, 1) == 20
+    assert loop.decode_slot(0, 3) is None
+
+
+def test_height_advance_resets_slots():
+    I, V = 2, 4
+    loop, _ = _pair(I, V, n_slots=2)
+    loop.push(pack_wire_votes([0], [0], [0], [0], [PV], [10]))
+    loop.build_phases()
+    assert loop.decode_slot(0, 0) == 10
+    loop.sync_device(np.zeros(I, np.int64), np.array([1, 0], np.int64))
+    assert loop.decode_slot(0, 0) is None          # instance 0 advanced
+    loop.push(pack_wire_votes([0], [0], [1], [0], [PV], [50]))
+    loop.build_phases()
+    assert loop.decode_slot(0, 0) == 50
+
+
+def test_signed_path_verify_and_evidence():
+    I, V = 2, 4
+    seeds = [bytes([i + 1]) * 32 for i in range(V)]
+    pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in seeds])
+    from agnes_tpu.bridge.ingest import vote_messages_np
+
+    loop = NativeIngestLoop(I, V, n_slots=4, pubkeys=pubkeys)
+    bat = VoteBatcher(I, V, n_slots=4)
+
+    # validator 1 equivocates (7 then 9); validator 3's signature is
+    # forged (signed by the wrong key)
+    inst = np.array([0, 0, 0, 0, 0], np.int64)
+    val = np.array([0, 1, 1, 2, 3], np.int64)
+    h = np.zeros(5, np.int64)
+    rnd = np.zeros(5, np.int64)
+    typ = np.full(5, PV, np.int64)
+    value = np.array([7, 7, 9, 7, 7], np.int64)
+    msgs = vote_messages_np(h, rnd, typ, value)
+    sigs = np.zeros((5, 64), np.uint8)
+    for k in range(5):
+        signer = seeds[0] if k == 4 else seeds[val[k]]   # k=4: forged
+        sigs[k] = np.frombuffer(
+            native.sign(signer, msgs[k].tobytes()), np.uint8)
+
+    loop.push(pack_wire_votes(inst, val, h, rnd, typ, value, sigs))
+    bat.add_arrays(inst, val, h, rnd, typ, value, sigs)
+    a = loop.build_phases()
+    b = bat.build_phases(pubkeys)
+    _assert_same(a, b)
+    assert loop.counters["rejected_signature"] == 1 == bat.rejected_signature
+
+    # device flags (0, 1) as an equivocator: both signed votes recovered
+    ev = loop.signed_evidence(0, 1)
+    assert ev is not None
+    r1, r2 = ev
+    v1 = int.from_bytes(r1[24:32].tobytes(), "little")
+    v2 = int.from_bytes(r2[24:32].tobytes(), "little")
+    assert {v1, v2} == {7, 9}
+    for r in (r1, r2):
+        sig = r[32:96].tobytes()
+        vmsg = vote_messages_np(
+            np.array([0]), np.array([0]), np.array([PV]),
+            np.array([int.from_bytes(r[24:32].tobytes(), "little")
+                      if r[21] & 1 else -1]))[0].tobytes()
+        assert native.verify(seeds_pk(seeds, 1), vmsg, sig)
+    assert loop.signed_evidence(0, 0) is None      # honest validator
+
+
+def seeds_pk(seeds, i):
+    return native.pubkey(seeds[i])
+
+
+def test_wrapper_screens_pubkey_and_power_lengths():
+    """Short pubkeys/powers buffers must be rejected in the wrapper —
+    the C side copies V*32 / V*8 bytes blind (OOB read otherwise)."""
+    with pytest.raises(ValueError):
+        NativeIngestLoop(2, 4, n_slots=4,
+                         pubkeys=np.zeros((3, 32), np.uint8))
+    with pytest.raises(ValueError):
+        NativeIngestLoop(2, 4, n_slots=4,
+                         pubkeys=np.zeros((4, 31), np.uint8))
+    with pytest.raises(ValueError):
+        NativeIngestLoop(2, 4, n_slots=4,
+                         powers=np.ones(3, np.int64))
+    NativeIngestLoop(2, 4, n_slots=4,
+                     pubkeys=np.zeros((4, 32), np.uint8),
+                     powers=np.ones(4, np.int64))     # exact: fine
+
+
+def test_unsigned_loop_rejects_missing_verdicts():
+    """A loop built WITH pubkeys must refuse the unsigned emit path."""
+    pub = np.zeros((4, 32), np.uint8)
+    loop = NativeIngestLoop(2, 4, n_slots=4, pubkeys=pub)
+    loop.push(pack_wire_votes([0], [0], [0], [0], [PV], [7]))
+    # build_phases routes through the verify path by itself; driving
+    # the raw ABI with NULL verdicts must fail
+    from agnes_tpu.bridge.native_ingest import _lib
+
+    L = _lib()
+    n = L.ag_ing_stage(loop._h)
+    assert n == 1
+    assert L.ag_ing_apply_verdicts(loop._h, None) == -1
+
+
+def test_double_buffer_stability():
+    """Phases from emit k stay intact while emit k+1 is built (the
+    double-buffer contract the device consumer relies on)."""
+    import ctypes
+
+    from agnes_tpu.bridge.native_ingest import _lib
+
+    I, V = 2, 2
+    loop = NativeIngestLoop(I, V, n_slots=4)
+    L = _lib()
+
+    def raw_phase_view():
+        rnd, typ = ctypes.c_int32(), ctypes.c_int32()
+        nv = ctypes.c_int64()
+        sp = ctypes.POINTER(ctypes.c_int32)()
+        mp = ctypes.POINTER(ctypes.c_uint8)()
+        L.ag_ing_phase(loop._h, 0, ctypes.byref(rnd), ctypes.byref(typ),
+                       ctypes.byref(nv), ctypes.byref(sp),
+                       ctypes.byref(mp))
+        return np.ctypeslib.as_array(sp, shape=(I, V))
+
+    loop.push(pack_wire_votes([0], [0], [0], [0], [PV], [7]))
+    loop.build_phases()
+    first = raw_phase_view().copy()
+    view = raw_phase_view()                       # live view, set A
+    loop.push(pack_wire_votes([1], [1], [0], [0], [PC], [8]))
+    loop.build_phases()                           # fills set B
+    np.testing.assert_array_equal(view, first)    # set A untouched
