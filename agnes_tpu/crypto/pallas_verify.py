@@ -243,10 +243,12 @@ def _freeze(a: jnp.ndarray) -> jnp.ndarray:
     r = _add_const(a, _SUB_K)
     for _ in range(3):
         r = _vp(r, FOLD)
+    # limb-0 add via concat: Mosaic has no scatter-add, so .at[0].add
+    # does not lower inside a TPU kernel
     r, c = _chain_seq(r)
-    r = r.at[0].add(FOLD * c)
+    r = jnp.concatenate([r[0:1] + FOLD * c[None], r[1:]], axis=0)
     r, c2 = _chain_seq(r)
-    r = r.at[0].add(FOLD * c2)
+    r = jnp.concatenate([r[0:1] + FOLD * c2[None], r[1:]], axis=0)
     for m in (16, 8, 4, 2, 1, 1):
         mp = _const_limbs(m * P)
         ge = _geq_const(r, mp)
