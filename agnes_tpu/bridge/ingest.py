@@ -153,7 +153,8 @@ class VoteBatcher:
     def __init__(self, n_instances: int, n_validators: int, n_slots: int,
                  heights: Optional[np.ndarray] = None,
                  n_rounds: int = 4,
-                 powers: Optional[np.ndarray] = None):
+                 powers: Optional[np.ndarray] = None,
+                 held_cap: Optional[int] = None):
         self.I, self.V = n_instances, n_validators
         self.W = n_rounds
         self.slots = SlotMap(n_instances, n_slots)
@@ -166,11 +167,21 @@ class VoteBatcher:
                        else np.ones(n_validators, np.int64))
         self._pending: List[_Batch] = []
         self._held: List[_Batch] = []          # future-round hold-back
+        self._held_n = 0
+        # the hold-back fills BEFORE signature verification, so
+        # unbounded growth would be an unauthenticated memory-
+        # exhaustion vector; cap at a couple of full [I, V] ticks
+        # (NativeIngestLoop applies the same bound)
+        if held_cap is not None and int(held_cap) <= 0:
+            raise ValueError(f"held_cap must be positive: {held_cap}")
+        self.held_cap = (int(held_cap) if held_cap is not None
+                         else max(65536, 2 * self.I * self.V))
         self._log: List[_Batch] = []           # verified votes (evidence)
         self.rejected_signature = 0
         self.rejected_malformed = 0
         self.overflow_votes = 0
         self.dropped_stale_height = 0
+        self.dropped_held_overflow = 0
         # host fallback tallies for past (rotated-out) rounds
         self._host_tally: Dict[Tuple[int, int], RoundVotes] = {}
         self._host_events: List[Tuple[int, int, int]] = []
@@ -228,6 +239,7 @@ class VoteBatcher:
         self.base_round = np.asarray(base_round, np.int64)
         if self._held:
             held, self._held = self._held, []
+            self._held_n = 0
             self._pending.extend(held)
 
     def clear_log(self) -> None:
@@ -327,7 +339,14 @@ class VoteBatcher:
         widx = b.round - self.base_round[b.instance]
         future = widx >= self.W
         if future.any():
-            self._held.append(b.take(np.nonzero(future)[0]))
+            fut = np.nonzero(future)[0]
+            room = self.held_cap - self._held_n
+            if len(fut) > room:           # cap: fail closed, count
+                self.dropped_held_overflow += len(fut) - max(room, 0)
+                fut = fut[:max(room, 0)]
+            if len(fut):
+                self._held.append(b.take(fut))
+                self._held_n += len(fut)
             b = b.take(np.nonzero(~future)[0])
             if len(b) == 0:
                 return []

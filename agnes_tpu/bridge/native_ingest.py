@@ -47,6 +47,7 @@ def _lib() -> ctypes.CDLL:
         L.ag_ing_new.restype = c.c_void_p
         L.ag_ing_new.argtypes = [c.c_int64, c.c_int64, c.c_int64,
                                  c.c_int64, c.c_char_p, c.c_void_p]
+        L.ag_ing_set_held_cap.argtypes = [c.c_void_p, c.c_int64]
         L.ag_ing_free.argtypes = [c.c_void_p]
         L.ag_ing_sync.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
         L.ag_ing_push.restype = c.c_int64
@@ -110,7 +111,8 @@ class NativeIngestLoop:
     def __init__(self, n_instances: int, n_validators: int,
                  n_slots: int, n_rounds: int = 4,
                  pubkeys: Optional[np.ndarray] = None,
-                 powers: Optional[np.ndarray] = None):
+                 powers: Optional[np.ndarray] = None,
+                 held_cap: Optional[int] = None):
         self.I, self.V = n_instances, n_validators
         self.signed = pubkeys is not None
         L = _lib()
@@ -132,7 +134,18 @@ class NativeIngestLoop:
         self._h = L.ag_ing_new(
             n_instances, n_validators, n_rounds, n_slots, pk,
             pw.ctypes.data if pw is not None else None)
+        if not self._h:
+            # the C side fails closed (NULL) on hostile dimensions
+            raise ValueError(
+                f"invalid ingest-loop dimensions: I={n_instances} "
+                f"V={n_validators} W={n_rounds} S={n_slots}")
         self._free = L.ag_ing_free
+        if held_cap is not None:
+            # raw ABI treats cap <= 0 as reset-to-default; the wrapper
+            # contract (shared with VoteBatcher) requires a positive cap
+            if int(held_cap) <= 0:
+                raise ValueError(f"held_cap must be positive: {held_cap}")
+            L.ag_ing_set_held_cap(self._h, int(held_cap))
 
     def __del__(self):
         if getattr(self, "_h", None):
@@ -144,6 +157,11 @@ class NativeIngestLoop:
     def sync_device(self, base_round, heights) -> None:
         base = np.ascontiguousarray(base_round, np.int64)
         hts = np.ascontiguousarray(heights, np.int64)
+        if base.shape != (self.I,) or hts.shape != (self.I,):
+            # the C side reads I int64s from each blind (OOB otherwise)
+            raise ValueError(
+                f"base_round/heights must be [{self.I}], got "
+                f"{base.shape}/{hts.shape}")
         self._heights = hts
         _lib().ag_ing_sync(self._h, base.ctypes.data, hts.ctypes.data)
 
@@ -179,7 +197,10 @@ class NativeIngestLoop:
         if n:
             rc = L.ag_ing_apply_verdicts(
                 self._h, ok.tobytes() if ok is not None else None)
-            assert rc >= 0, "signed loop requires verdicts"
+            if rc < 0:      # not an assert: must survive python -O
+                raise RuntimeError(
+                    "ag_ing_apply_verdicts rejected the tick (signed "
+                    "loop requires verdicts)")
         n_phases = L.ag_ing_emit(self._h)
         hts = jnp.asarray(getattr(
             self, "_heights", np.zeros(self.I, np.int64)).astype(np.int32))
@@ -234,11 +255,12 @@ class NativeIngestLoop:
 
     @property
     def counters(self) -> dict:
-        buf = np.empty(6, np.int64)
+        buf = np.empty(7, np.int64)
         _lib().ag_ing_counters(self._h, buf.ctypes.data)
         return {"rejected_malformed": int(buf[0]),
                 "dropped_stale_height": int(buf[1]),
                 "rejected_signature": int(buf[2]),
                 "overflow_votes": int(buf[3]),
                 "held": int(buf[4]),
-                "log": int(buf[5])}
+                "log": int(buf[5]),
+                "dropped_held_overflow": int(buf[6])}

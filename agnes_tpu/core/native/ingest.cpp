@@ -101,7 +101,13 @@ struct Loop {
 
   using Block = std::shared_ptr<std::vector<Rec>>;
 
-  std::vector<Rec> pending;      // screened, in-window, pre-verify
+  std::vector<Rec> pending;      // parsed + malformed-screened; height
+                                 // and window screens run at stage()
+                                 // against the LAST-SYNCED state, the
+                                 // same moment VoteBatcher screens in
+                                 // build_phases — push-time screening
+                                 // would drop early next-height votes
+                                 // the numpy path keeps
   std::vector<Rec> staged;       // snapshot awaiting verdicts
   std::vector<Block> ready;      // verified (or unsigned), pre-emit —
                                  // BLOCKS shared with the log: the
@@ -109,7 +115,12 @@ struct Loop {
                                  // instead of copying per record (the
                                  // per-rec copy was the pipeline's
                                  // bandwidth bottleneck)
-  std::vector<Rec> held;         // future-round hold-back
+  std::vector<Rec> held;         // future-round hold-back (capped:
+                                 // filled before signature check, so
+                                 // unbounded growth would be an
+                                 // unauthenticated memory-exhaustion
+                                 // vector)
+  int64_t held_cap = 0;
   std::vector<Block> log;        // verified votes (slashable evidence)
 
   // per-instance value-id -> dense slot (bridge/value_table.py
@@ -131,6 +142,7 @@ struct Loop {
   int64_t dropped_stale_height = 0;
   int64_t rejected_signature = 0;
   int64_t overflow_votes = 0;
+  int64_t dropped_held_overflow = 0;
 
   EmitSet sets[2];
   int cur = 0;
@@ -191,27 +203,54 @@ extern "C" {
 void* ag_ing_new(int64_t I, int64_t V, int64_t W, int64_t S,
                  const uint8_t* pubkeys /* V*32 or NULL */,
                  const int64_t* powers /* V or NULL */) {
-  auto* L = new Loop();
-  L->I = I; L->V = V; L->W = W; L->S = S;
-  L->require_verify = pubkeys != nullptr;
-  L->heights.assign(static_cast<size_t>(I), 0);
-  L->base_round.assign(static_cast<size_t>(I), 0);
-  if (pubkeys)
-    L->pubkeys.assign(pubkeys, pubkeys + V * 32);
-  if (powers)
-    L->powers.assign(powers, powers + V);
-  else
-    L->powers.assign(static_cast<size_t>(V), 1);
-  L->total_power = 0;
-  for (int64_t p : L->powers) L->total_power = agnes::sat_add(L->total_power, p);
-  L->slot_vals.assign(static_cast<size_t>(I * S), agnes::kNoValue);
-  L->slot_count.assign(static_cast<size_t>(I), 0);
-  return L;
+  // hostile-dimension screen: this is a raw C ABI, so negative or huge
+  // dims must fail closed (NULL) instead of throwing bad_alloc across
+  // the extern-C boundary or overflowing the int64 cell math below
+  constexpr int64_t kDimMax = int64_t{1} << 31;
+  constexpr int64_t kCellMax = int64_t{1} << 40;
+  if (I <= 0 || V <= 0 || W <= 0 || S <= 0 || I > kDimMax ||
+      V > kDimMax || W > (int64_t{1} << 20) || S > (int64_t{1} << 20) ||
+      I > kCellMax / V || I > kCellMax / S)
+    return nullptr;
+  try {
+    auto L = std::make_unique<Loop>();
+    L->I = I; L->V = V; L->W = W; L->S = S;
+    L->require_verify = pubkeys != nullptr;
+    // cap the pre-verification hold-back queue at a couple of full
+    // [I, V] ticks (the legitimate future-round working set), floor
+    // 64k — see ag_ing_set_held_cap
+    L->held_cap = std::max<int64_t>(65536, 2 * I * V);
+    L->heights.assign(static_cast<size_t>(I), 0);
+    L->base_round.assign(static_cast<size_t>(I), 0);
+    if (pubkeys)
+      L->pubkeys.assign(pubkeys, pubkeys + V * 32);
+    if (powers)
+      L->powers.assign(powers, powers + V);
+    else
+      L->powers.assign(static_cast<size_t>(V), 1);
+    L->total_power = 0;
+    for (int64_t p : L->powers)
+      L->total_power = agnes::sat_add(L->total_power, p);
+    L->slot_vals.assign(static_cast<size_t>(I * S), agnes::kNoValue);
+    L->slot_count.assign(static_cast<size_t>(I), 0);
+    return L.release();
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+// bound on the pre-verify future-round hold-back queue (records);
+// cap <= 0 resets to the construction default
+void ag_ing_set_held_cap(void* h, int64_t cap) {
+  auto* L = static_cast<Loop*>(h);
+  L->held_cap = cap > 0 ? cap : std::max<int64_t>(65536, 2 * L->I * L->V);
 }
 
 void ag_ing_free(void* h) { delete static_cast<Loop*>(h); }
 
-// adopt device window bases + heights; re-screen held votes
+// adopt device window bases + heights; held votes re-enter pending
+// unconditionally (the next stage() re-screens them against the new
+// state — exactly when VoteBatcher.sync_device + build_phases do)
 void ag_ing_sync(void* h, const int64_t* base_round,
                  const int64_t* heights) {
   auto* L = static_cast<Loop*>(h);
@@ -228,24 +267,21 @@ void ag_ing_sync(void* h, const int64_t* base_round,
       }
     }
     L->heights[static_cast<size_t>(i)] = heights[i];
-    L->base_round[static_cast<size_t>(i)] = base_round[i];
+    // the device reports window bases >= 0; clamp hostile values so
+    // round-window arithmetic downstream cannot overflow int64
+    L->base_round[static_cast<size_t>(i)] =
+        base_round[i] < 0 ? 0 : base_round[i];
   }
-  std::vector<Rec> still_held;
-  for (auto& r : L->held) {
-    size_t i = static_cast<size_t>(r.instance);
-    if (r.height != L->heights[i]) {
-      ++L->dropped_stale_height;        // window arrived too late
-    } else if (r.round - L->base_round[i] >= L->W) {
-      still_held.push_back(r);
-    } else {
-      L->pending.push_back(r);
-    }
+  if (!L->held.empty()) {
+    grow_reserve(L->pending, L->held.size());
+    for (auto& r : L->held) L->pending.push_back(r);
+    L->held.clear();
   }
-  L->held.swap(still_held);
 }
 
-// parse + screen + window discipline; returns count accepted into
-// pending (held counts as accepted; rejects are counted on the handle)
+// parse + malformed screen; returns count accepted into pending
+// (height/window screens run at stage(); rejects are counted on the
+// handle)
 int64_t ag_ing_push(void* h, const uint8_t* buf, int64_t n) {
   auto* L = static_cast<Loop*>(h);
   int64_t accepted = 0;
@@ -266,37 +302,38 @@ int64_t ag_ing_push(void* h, const uint8_t* buf, int64_t n) {
     std::memcpy(r.sig, p + 32, 64);
     r.arrival = L->arrivals++;
 
-    // malformed screen (VoteBatcher.build_phases' `ok` mask)
+    // malformed screen (VoteBatcher.build_phases' `ok` mask); height
+    // and window screens run at stage() against last-synced state
     if (r.instance >= L->I || r.validator >= L->V || r.round < 0 ||
         r.typ > 1 || r.value >= kMaxValue) {
       ++L->rejected_malformed;
       continue;
     }
-    size_t i = static_cast<size_t>(r.instance);
-    if (r.height != L->heights[i]) {
-      ++L->dropped_stale_height;
-      continue;
-    }
-    if (r.round - L->base_round[i] >= L->W) {
-      L->held.push_back(r);             // future: hold for rotation
-    } else {
-      L->pending.push_back(r);
-    }
+    L->pending.push_back(r);
     ++accepted;
   }
   return accepted;
 }
 
-// snapshot pending for verification; returns lane count
+// screen pending against the last-synced heights/window and snapshot
+// the in-window lanes for verification; returns lane count
 int64_t ag_ing_stage(void* h) {
   auto* L = static_cast<Loop*>(h);
-  if (L->staged.empty()) {
-    L->staged.swap(L->pending);
-  } else {
-    L->staged.insert(L->staged.end(), L->pending.begin(),
-                     L->pending.end());
-    L->pending.clear();
+  grow_reserve(L->staged, L->pending.size());
+  for (auto& r : L->pending) {
+    size_t i = static_cast<size_t>(r.instance);
+    if (r.height != L->heights[i]) {
+      ++L->dropped_stale_height;
+    } else if (r.round >= agnes::sat_add(L->base_round[i], L->W)) {
+      if (static_cast<int64_t>(L->held.size()) < L->held_cap)
+        L->held.push_back(r);           // future: hold for rotation
+      else
+        ++L->dropped_held_overflow;     // cap: fail closed, count
+    } else {
+      L->staged.push_back(r);
+    }
   }
+  L->pending.clear();
   return static_cast<int64_t>(L->staged.size());
 }
 
@@ -634,7 +671,8 @@ int64_t ag_ing_evidence(void* h, int64_t instance, int64_t validator,
 
 void ag_ing_clear_log(void* h) { static_cast<Loop*>(h)->log.clear(); }
 
-// counters: [malformed, stale_height, signature, overflow, held, log]
+// counters: [malformed, stale_height, signature, overflow, held, log,
+//            held_overflow]
 void ag_ing_counters(void* h, int64_t* out) {
   auto* L = static_cast<Loop*>(h);
   out[0] = L->rejected_malformed;
@@ -646,6 +684,7 @@ void ag_ing_counters(void* h, int64_t* out) {
   for (const auto& blk : L->log)
     logged += static_cast<int64_t>(blk->size());
   out[5] = logged;
+  out[6] = L->dropped_held_overflow;
 }
 
 }  // extern "C"

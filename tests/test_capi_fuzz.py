@@ -280,7 +280,7 @@ def test_ingest_abi_hostile(L):
         rec[4:8] = np.frombuffer((3).to_bytes(4, "little"), np.uint8)
         rec[16:20] = 0xFF              # round = -1 -> malformed
         G.ag_ing_push(h, rec.tobytes(), 1)
-        cnt = np.empty(6, np.int64)
+        cnt = np.empty(7, np.int64)
         G.ag_ing_counters(h, cnt.ctypes.data)
         assert cnt[0] == 9             # all rejected malformed
         # stage/verdicts/emit on empty sets are no-ops
@@ -308,8 +308,36 @@ def test_ingest_abi_hostile(L):
         # evidence on an empty log
         buf = ctypes.create_string_buffer(2 * 96)
         assert G.ag_ing_evidence(h, 0, 0, buf) == 0
+        # hostile sync values must not poison window arithmetic:
+        # INT64_MIN base_round would make round - base overflow (UB)
+        base = np.full(4, -2**63, np.int64)
+        hts = np.zeros(4, np.int64)
+        G.ag_ing_sync(h, base.ctypes.data, hts.ctypes.data)
+        ok_rec = np.zeros(96, np.uint8)
+        ok_rec[16:20] = np.frombuffer(
+            (2**31 - 1).to_bytes(4, "little"), np.uint8)  # max round
+        assert G.ag_ing_push(h, ok_rec.tobytes(), 1) == 1
+        G.ag_ing_stage(h)              # held (future) — no UB, no crash
     finally:
         G.ag_ing_free(h)
+
+
+def test_ingest_abi_hostile_dims(L):
+    """ag_ing_new must fail closed (NULL) on hostile dimensions
+    instead of throwing bad_alloc across the C boundary or
+    overflowing the int64 cell math."""
+    from agnes_tpu.bridge.native_ingest import _lib as ing_lib
+
+    G = ing_lib()
+    for dims in [(-1, 4, 4, 2), (4, -1, 4, 2), (4, 4, -1, 2),
+                 (4, 4, 4, -1), (0, 4, 4, 2), (4, 0, 4, 2),
+                 (2**62, 4, 4, 2), (2**31, 2**31, 4, 2),
+                 (2**40, 2**40, 4, 2), (4, 4, 2**32, 2),
+                 (4, 4, 4, 2**32)]:
+        assert G.ag_ing_new(*dims, None, None) is None, dims
+    h = G.ag_ing_new(4, 4, 4, 2, None, None)   # sane dims still work
+    assert h is not None
+    G.ag_ing_free(h)
 
 
 def test_sha512_zero_and_large(L):

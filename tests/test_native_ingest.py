@@ -280,3 +280,92 @@ def test_double_buffer_stability():
     loop.push(pack_wire_votes([1], [1], [0], [0], [PC], [8]))
     loop.build_phases()                           # fills set B
     np.testing.assert_array_equal(view, first)    # set A untouched
+
+def test_early_next_height_vote_survives_sync_parity():
+    """A vote for height h+1 pushed just before the device advances
+    must NOT be dropped at push time: both paths screen heights at
+    build time against the last-synced state, so after sync(h+1) the
+    vote emits (the height-boundary case that a push-time screen
+    loses)."""
+    I, V = 2, 4
+    loop, bat = _pair(I, V)
+    inst = np.zeros(3, np.int64)
+    val = np.arange(3)
+    # votes for height 1 while both paths still believe height 0
+    loop.push(pack_wire_votes(inst, val, np.ones(3), np.zeros(3),
+                              np.full(3, PV), np.full(3, 7)))
+    bat.add_arrays(inst, val, np.ones(3), np.zeros(3),
+                   np.full(3, PV), np.full(3, 7))
+    # device advances instance 0 and 1 to height 1, then the tick builds
+    base = np.zeros(I, np.int64)
+    hts = np.ones(I, np.int64)
+    loop.sync_device(base, hts)
+    bat.sync_device(base, hts)
+    a, b = loop.build_phases(), bat.build_phases()
+    _assert_same(a, b)
+    assert len(a) == 1 and a[0][1] == 3
+    assert loop.counters["dropped_stale_height"] == 0
+    assert bat.dropped_stale_height == 0
+
+
+def test_stale_height_still_dropped_at_build_parity():
+    """Votes for a height the instance is NOT at when the tick builds
+    are dropped and counted — deferring the screen to build time must
+    not let genuinely stale votes through."""
+    I, V = 2, 4
+    loop, bat = _pair(I, V)
+    a, b = _feed(loop, bat, (np.zeros(2, np.int64), np.arange(2),
+                             np.array([5, 0]), np.zeros(2),
+                             np.full(2, PV), np.full(2, 7)))
+    _assert_same(a, b)
+    assert loop.counters["dropped_stale_height"] == 1
+    assert bat.dropped_stale_height == 1
+
+
+def test_held_cap_bounds_future_flood_parity():
+    """The pre-verification hold-back queue is capped: a flood of
+    future-round votes beyond the cap is dropped and counted, not
+    accumulated without bound (unauthenticated memory exhaustion)."""
+    I, V = 2, 4
+    loop = NativeIngestLoop(I, V, n_slots=4, held_cap=5)
+    bat = VoteBatcher(I, V, n_slots=4, held_cap=5)
+    n = 12
+    inst = np.arange(n, dtype=np.int64) % 2
+    val = (np.arange(n) // 2) % V       # first 5 cells are distinct
+    rnd = np.full(n, 9)                    # far future at base 0, W 4
+    loop.push(pack_wire_votes(inst, val, np.zeros(n), rnd,
+                              np.full(n, PV), np.full(n, 7)))
+    bat.add_arrays(inst, val, np.zeros(n), rnd,
+                   np.full(n, PV), np.full(n, 7))
+    assert loop.build_phases() == [] and bat.build_phases() == []
+    assert loop.counters["held"] == 5
+    assert loop.counters["dropped_held_overflow"] == 7
+    assert bat.dropped_held_overflow == 7
+    # the capped survivors still re-enter when the window arrives
+    base = np.full(I, 6, np.int64)
+    hts = np.zeros(I, np.int64)
+    loop.sync_device(base, hts)
+    bat.sync_device(base, hts)
+    a, b = loop.build_phases(), bat.build_phases()
+    _assert_same(a, b)
+    assert len(a) == 1 and a[0][1] == 5
+
+
+def test_sync_device_screens_array_lengths():
+    """Short base_round/heights arrays must be rejected in the wrapper
+    (the C side reads I int64s from each blind)."""
+    loop = NativeIngestLoop(8, 4, n_slots=4)
+    with pytest.raises(ValueError):
+        loop.sync_device(np.zeros(1, np.int64), np.zeros(8, np.int64))
+    with pytest.raises(ValueError):
+        loop.sync_device(np.zeros(8, np.int64), np.zeros(3, np.int64))
+    loop.sync_device(np.zeros(8, np.int64), np.zeros(8, np.int64))
+
+
+def test_hostile_dims_rejected_in_wrapper():
+    with pytest.raises(ValueError):
+        NativeIngestLoop(-1, 4, n_slots=4)
+    with pytest.raises(ValueError):
+        NativeIngestLoop(4, 4, n_slots=0)
+    with pytest.raises(ValueError):
+        NativeIngestLoop(2**40, 2**40, n_slots=4)
