@@ -154,7 +154,9 @@ class VoteBatcher:
                  heights: Optional[np.ndarray] = None,
                  n_rounds: int = 4,
                  powers: Optional[np.ndarray] = None,
-                 held_cap: Optional[int] = None):
+                 held_cap: Optional[int] = None,
+                 verify_mode: str = "lanes",
+                 msm_leaf: int = 64):
         self.I, self.V = n_instances, n_validators
         self.W = n_rounds
         self.slots = SlotMap(n_instances, n_slots)
@@ -174,6 +176,19 @@ class VoteBatcher:
         # (NativeIngestLoop applies the same bound)
         if held_cap is not None and int(held_cap) <= 0:
             raise ValueError(f"held_cap must be positive: {held_cap}")
+        if verify_mode not in ("lanes", "msm"):
+            raise ValueError(f"verify_mode must be lanes|msm: {verify_mode}")
+        # "lanes" = per-lane verification; "msm" = the batch
+        # random-linear-combination fast path with per-lane bisection
+        # fallback on any failure (crypto/msm_jax.py).  Both apply the
+        # framework's cofactored policy, so verdicts are identical —
+        # the mode is purely a throughput choice.
+        self.verify_mode = verify_mode
+        if int(msm_leaf) < 2:
+            # leaf 1 would make the adaptive bisection midpoint
+            # degenerate (lo + n//2 == lo) on a failing lane
+            raise ValueError(f"msm_leaf must be >= 2: {msm_leaf}")
+        self.msm_leaf = int(msm_leaf)
         self.held_cap = (int(held_cap) if held_cap is not None
                          else max(65536, 2 * self.I * self.V))
         self._log: List[_Batch] = []           # verified votes (evidence)
@@ -261,6 +276,10 @@ class VoteBatcher:
         blocks = jnp.asarray(_sha_blocks_np(r_bytes, a_bytes, msg))
         pub = jnp.asarray(a_bytes.astype(np.int32))
         sig = jnp.asarray(b.signature.astype(np.int32))
+        if self.verify_mode == "msm":
+            from agnes_tpu.crypto import msm_jax
+            return msm_jax.verify_batch_adaptive(pub, sig, blocks,
+                                                 leaf=self.msm_leaf)
         return np.asarray(ejax.verify_batch_jit(pub, sig, blocks))
 
     # -- host fallback for past rounds ---------------------------------------

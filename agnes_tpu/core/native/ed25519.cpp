@@ -425,13 +425,23 @@ bool ed25519_verify(const uint8_t pk[32], const uint8_t* msg, uint64_t n,
   hk.final(kh);
   U256 k = mod_l_bits(kh, 64);
 
-  // Q = [S]B + [k](-A); accept iff compress(Q) == R byte-for-byte
-  // (also enforces canonical R, mirroring the JAX verifier)
+  // COFACTORED check (the framework-wide policy; see
+  // crypto/ed25519_ref.py verify): R must decode canonically, then
+  // [8]([S]B + [k](-A)) == [8]R — multiply-by-8 makes single, batch
+  // (MSM) and per-lane verification agree on every input, so vote
+  // validity is a pure function of the signature bytes.
+  Ge r;
+  if (!ge_decompress(sig, &r)) return false;
   Ge q = ge_add(ge_scalar_mul(s.w, ge_base()),
                 ge_scalar_mul(k.w, ge_neg(a)));
-  uint8_t qb[32];
+  for (int i = 0; i < 3; ++i) {
+    q = ge_add(q, q);
+    r = ge_add(r, r);
+  }
+  uint8_t qb[32], rb[32];
   ge_compress(q, qb);
-  return std::memcmp(qb, sig, 32) == 0;
+  ge_compress(r, rb);
+  return std::memcmp(qb, rb, 32) == 0;
 }
 
 }  // namespace agnes

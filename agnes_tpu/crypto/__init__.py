@@ -12,6 +12,11 @@ surface the build adds:
                 arithmetic, vmapped double-scalar multiplication.
   sha512_jax    SHA-512 on device (uint32-pair word arithmetic) for the
                 H(R || A || M) challenge hash.
+  pallas_verify fused per-lane verification kernel (windowed Straus) —
+                the canonical, deterministic verifier.
+  msm_jax       MSM batch verification (random linear combination +
+                segmented-scan Pippenger) — the honest-stream fast
+                path; bisects to the per-lane verifier on failure.
 """
 
 from agnes_tpu.crypto.ed25519_ref import (  # noqa: F401

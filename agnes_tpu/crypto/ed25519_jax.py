@@ -213,6 +213,11 @@ def verify_batch(pub: jnp.ndarray, sig: jnp.ndarray,
     R || A || M (see sha512_jax.pack_padded_host / the bridge packer).
     Returns [B] bool.
 
+    COFACTORED semantics (framework-wide; rationale in
+    ed25519_ref.verify): A and R must decode canonically, S < L, and
+    [8]([S]B - [k]A) == [8]R — so this path, the Pallas kernel, the
+    host verifiers and the MSM batch check agree on every input.
+
     On the Pallas backend this routes to the fused windowed-Straus
     verify kernel (crypto/pallas_verify.py); the jnp path below is the
     portable XLA implementation and differential oracle."""
@@ -221,13 +226,15 @@ def verify_batch(pub: jnp.ndarray, sig: jnp.ndarray,
         return pv.verify_batch_pallas(pub, sig, msg_blocks,
                                       interpret=_INTERPRET)
     a_point, ok_a = decompress(pub)
+    r_point, ok_r = decompress(sig[..., :32])
     s = S.scalar_from_bytes32(sig[..., 32:])
     ok_s = S.is_canonical(s)
     k = S.barrett_reduce(S.digest_to_limbs(sha.sha512_blocks(msg_blocks)))
     q = straus_sub(s, k, a_point)
-    q_bytes = compress(q)
-    ok_eq = jnp.all(q_bytes == sig[..., :32].astype(I32), axis=-1)
-    return ok_a & ok_s & ok_eq
+    for _ in range(3):                       # x8: kill the torsion
+        q = point_add(q, q)
+        r_point = point_add(r_point, r_point)
+    return ok_a & ok_r & ok_s & point_equal(q, r_point)
 
 
 verify_batch_jit = jax.jit(verify_batch)

@@ -150,7 +150,18 @@ def sign(secret: bytes, msg: bytes) -> bytes:
 
 
 def verify(public: bytes, msg: bytes, sig: bytes) -> bool:
-    """Check [S]B == R + [k]A with k = SHA-512(R || A || M) mod L."""
+    """COFACTORED check: [8]([S]B) == [8](R + [k]A), k = SHA-512(
+    R || A || M) mod L, plus canonical encodings and S < L.
+
+    The multiply-by-8 (vs RFC 8032's either-form allowance) is the
+    framework's consensus-grade verification policy: it makes single,
+    batched (msm_jax), and per-lane-kernel verification provably agree
+    on every input — a signature's validity is a pure function of its
+    bytes under every verification strategy, so nodes can never
+    diverge on vote validity (the ZIP-215 agreement property).  All
+    verifiers in this package (this oracle, the C++ host verifier,
+    the jnp and Pallas batch verifiers, the MSM batch check) apply
+    the same rule and are differential-tested for agreement."""
     if len(sig) != 64 or len(public) != 32:
         return False
     A = _decompress(public)
@@ -163,4 +174,5 @@ def verify(public: bytes, msg: bytes, sig: bytes) -> bool:
     if s >= L:
         return False
     k = _sha512_int(sig[:32] + public + msg) % L
-    return point_equal(_mul(s, BASE), _add(R, _mul(k, A)))
+    return point_equal(_mul(8, _mul(s, BASE)),
+                       _mul(8, _add(R, _mul(k, A))))

@@ -1,0 +1,318 @@
+"""MSM-style Ed25519 batch verification (random linear combination).
+
+The SURVEY §7 "hard parts" mitigation and BASELINE north-star
+mechanism: instead of checking [Sᵢ]B == Rᵢ + [kᵢ]Aᵢ per lane, draw
+random 128-bit zᵢ and check ONE combined equation
+
+    [Σ zᵢSᵢ mod L]B  +  Σ [zᵢ](-Rᵢ)  +  Σ [zᵢkᵢ mod L](-Aᵢ)  ==  O
+
+(Bernstein et al.'s batch verification).  A lane that fails its own
+equation makes the combination nonzero except with probability
+~2⁻¹²⁸; a passing batch certifies every (structurally valid) lane.
+
+Why this is fast on TPU: per-lane double-scalar multiplication costs
+~253 doublings *per signature*.  Here the two big multi-scalar
+multiplications are done with a Pippenger bucket method whose serial
+doubling chain is shared by the WHOLE batch (c-bit windows, bucket
+accumulation per window, c doublings per window to combine), so the
+per-signature cost collapses to ~2 bucket additions per window
+(2·(253+128)/c adds total).  The bucket accumulation itself is
+expressed as a *segmented* `jax.lax.associative_scan` over the batch
+sorted by digit — sorting makes equal digits adjacent, the segmented
+combine sums each digit's run, and the scan is log-depth and fully
+vectorized: a TPU-idiomatic Pippenger with no scatter-adds and no
+data-dependent shapes.
+
+Agreement with the per-lane verifiers: the framework's verification
+policy is COFACTORED everywhere (rationale: ed25519_ref.verify) — the
+per-lane verifiers check [8]([S]B - [k]A) == [8]R, and this batch
+check multiplies the combined equation by 8 as well.  A torsion-only
+per-lane defect is therefore accepted by BOTH strategies (never by
+one and not the other), and a non-torsion defect fails the batch
+equation except with probability ~2⁻¹²⁸: batch-accept and per-lane
+accept provably agree, so vote validity stays a pure function of the
+signature bytes no matter which strategy a node uses.
+`verify_batch_adaptive` uses the batch check as the honest-stream
+fast path and bisects to the per-lane verifier to localize bad lanes
+when it fails.
+
+The reference engine has no crypto at all (votes are unsigned,
+SURVEY.md §2.1; signing stubbed at reference consensus_executor.rs:
+35-41); this module is part of the added TPU data plane.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agnes_tpu.crypto import ed25519_jax as E
+from agnes_tpu.crypto import field_jax as F
+from agnes_tpu.crypto import scalar_jax as S
+from agnes_tpu.crypto import sha512_jax as sha
+
+I32 = F.I32
+BITS = F.BITS
+
+Z_BITS = 128                     # random-coefficient width
+Z_LIMBS = -(-Z_BITS // BITS)     # 10
+WINDOW_C = 8                     # Pippenger window (bits)
+N_BUCKETS = 1 << WINDOW_C
+NW_Z = -(-Z_BITS // WINDOW_C)            # 16 windows for z scalars
+NW_FULL = -(-253 // WINDOW_C)            # 32 windows for full scalars
+
+
+# --- scalar helpers (mod L) -------------------------------------------------
+
+
+def mul_mod_L(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[..., na] x [..., nb] limb products mod L -> [..., 20] canonical.
+
+    Raw schoolbook columns stay int32-safe: limbs < 2^13, products
+    < 2^26, <= min(na, nb) <= 20 terms per column < 2^31."""
+    na, nb = a.shape[-1], b.shape[-1]
+    assert na <= 20 and nb <= 20
+    cols = jnp.zeros(a.shape[:-1] + (na + nb - 1,), I32)
+    for i in range(na):
+        cols = cols.at[..., i:i + nb].add(a[..., i:i + 1] * b)
+    limbs = S._chain(cols)                       # normalized, +1 limb
+    pad = S.N_HASH - limbs.shape[-1]
+    limbs = jnp.pad(limbs, [(0, 0)] * (limbs.ndim - 1) + [(0, pad)])
+    return S.barrett_reduce(limbs)
+
+
+def sum_mod_L(x: jnp.ndarray) -> jnp.ndarray:
+    """[B, 20] canonical scalars -> [20] limbs of the sum mod L.
+
+    Pure int32 (jnp.int64 silently downcasts without x64 mode):
+    chunked partial sums stay < 2^28 per column, are normalized, and
+    the <= B/2^15 normalized partials sum safely again — int32-exact
+    for B up to ~2^33 lanes."""
+    chunk = 1 << 15
+    B = x.shape[0]
+    pad_b = (-B) % chunk
+    xp = jnp.pad(x, ((0, pad_b), (0, 0)))
+    parts = xp.reshape(-1, chunk, x.shape[-1]).sum(axis=1)   # [m, n]
+    parts = S._chain(parts)                                  # normalized
+    tot = parts.sum(axis=0)                                  # < m * 2^28
+    limbs = S._chain(S._chain(tot[None]))[0]
+    pad = S.N_HASH - limbs.shape[-1]
+    return S.barrett_reduce(jnp.pad(limbs, [(0, pad)]))
+
+
+def window_digits(s: jnp.ndarray, n_windows: int,
+                  c: int = WINDOW_C) -> jnp.ndarray:
+    """[..., n_limbs] limbs -> [n_windows, ...] c-bit digits, least
+    significant window first."""
+    nl = s.shape[-1]
+    outs = []
+    for w in range(n_windows):
+        lo = c * w
+        li, off = lo // BITS, lo % BITS
+        d = s[..., li] >> off
+        if off > BITS - c and li + 1 < nl:
+            d = d | (s[..., li + 1] << (BITS - off))
+        outs.append(d & (N_BUCKETS - 1))
+    return jnp.stack(outs, axis=0)
+
+
+# --- segmented-scan Pippenger MSM -------------------------------------------
+
+
+def _seg_combine(a, b):
+    """Segmented-scan operator: flags mark segment starts; a right
+    element that starts a segment resets the running point sum."""
+    fa, pa = a
+    fb, pb = b
+    psum = E.point_add(E.Point(*pa), E.Point(*pb))
+    keep = fb[..., None]
+    out = tuple(jnp.where(keep, qb, qs)
+                for qb, qs in zip(pb, tuple(psum)))
+    return fa | fb, out
+
+
+def _bucket_sums(points: E.Point, digits: jnp.ndarray) -> E.Point:
+    """One window's bucket sums: [N]-lane points + [N] digits ->
+    [N_BUCKETS]-lane points where lane d = Σ points with digit d
+    (identity where empty).  Sort-by-digit + segmented scan."""
+    n = digits.shape[0]
+    order = jnp.argsort(digits)                  # stable
+    ds = digits[order]
+    pts = tuple(coord[order] for coord in points)
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), ds[1:] != ds[:-1]])
+    _, scanned = jax.lax.associative_scan(
+        _seg_combine, (seg_start, pts), axis=0)
+    seg_end = jnp.concatenate(
+        [ds[1:] != ds[:-1], jnp.ones((1,), bool)])
+    # scatter each segment total into its bucket; non-end lanes go to
+    # a dump slot (bucket arrays are [N_BUCKETS + 1])
+    idx = jnp.where(seg_end, ds, N_BUCKETS)
+    idn = E.identity((N_BUCKETS + 1,))
+    buckets = tuple(
+        ib.at[idx].set(sc) for ib, sc in zip(tuple(idn), scanned))
+    return E.Point(*tuple(b[:N_BUCKETS] for b in buckets))
+
+
+def _bucket_aggregate(buckets: E.Point) -> E.Point:
+    """Σ_{d=1}^{N_BUCKETS-1} d * bucket[d] via the running-suffix
+    trick: acc accumulates suffix sums, total accumulates acc."""
+    idn = E.identity(())
+
+    def body(j, carry):
+        acc, tot = carry
+        d = N_BUCKETS - 1 - j
+        bd = E.Point(*(c[d] for c in buckets))
+        acc = E.point_add(acc, bd)
+        tot = E.point_add(tot, acc)
+        return acc, tot
+
+    _, tot = jax.lax.fori_loop(0, N_BUCKETS - 1, body, (idn, idn))
+    return tot
+
+
+def msm(points: E.Point, scalars: jnp.ndarray,
+        n_windows: int) -> E.Point:
+    """Multi-scalar multiplication Σ [scalarᵢ] Pᵢ.
+
+    points: Point with [N, 20]-limb coords; scalars [N, n_limbs];
+    n_windows c-bit windows cover the scalar width.  The doubling
+    chain (c per window) is shared by all N points — the Pippenger
+    amortization that beats per-lane Straus for large N.  One
+    `lax.scan` over windows (MSB window first) keeps the traced graph
+    a single window body: acc <- [2^c] acc + Σ_d d * bucket_d."""
+    digits = window_digits(scalars, n_windows)   # [n_windows, N]
+
+    def body(acc: E.Point, dig):
+        for _ in range(WINDOW_C):
+            acc = E.point_add(acc, acc)
+        wsum = _bucket_aggregate(_bucket_sums(points, dig))
+        return E.point_add(acc, wsum), None
+
+    acc, _ = jax.lax.scan(body, E.identity(()), digits[::-1])
+    return acc
+
+
+# --- the batch check --------------------------------------------------------
+
+
+def scalar_mul_base(c_limbs: jnp.ndarray) -> E.Point:
+    """[c]B for one scalar ([20] limbs) — reuses the Straus scan with
+    the A term pinned to the identity."""
+    return E.straus_sub(c_limbs, jnp.zeros_like(c_limbs), E.identity(()))
+
+
+def make_z(batch: int, seed: Optional[int] = None) -> jnp.ndarray:
+    """[B, Z_LIMBS] random 128-bit coefficients.  Drawn host-side per
+    call (numpy CSPRNG-adjacent; unpredictable to the vote senders,
+    which is all the batch argument needs).  A fixed seed is for
+    tests only.
+
+    Vectorized repack: a 13-bit limb spans at most two adjacent
+    16-bit words, so limb i is a shift of the 32-bit window at word
+    (13i)//16 — no per-element Python on the verify hot path."""
+    rng = np.random.default_rng(seed)
+    words = rng.integers(0, 1 << 16, size=(batch, 9), dtype=np.int64)
+    words[:, 8] = 0                      # zero pad word for the window
+    idx = np.arange(Z_LIMBS)
+    wi, off = (BITS * idx) // 16, (BITS * idx) % 16
+    win = words[:, wi] | (words[:, wi + 1] << 16)
+    val = (win >> off) & F.LMASK
+    return jnp.asarray(val, I32)
+
+
+def verify_batch_msm(pub: jnp.ndarray, sig: jnp.ndarray,
+                     msg_blocks: jnp.ndarray, z: jnp.ndarray
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One combined check for the whole batch.
+
+    pub [B,32] / sig [B,64] byte-valued int arrays, msg_blocks
+    [B,n,32] uint32 (R||A||M pre-padded SHA-512 blocks), z [B,
+    Z_LIMBS] random coefficients.
+
+    Returns (batch_ok scalar bool, lane_ok [B] bool):
+      batch_ok  — the combined equation holds for every lane with
+                  lane_ok True (structurally invalid lanes are
+                  excluded by zeroing their coefficient);
+      lane_ok   — per-lane structural validity (A and R decode, S
+                  canonical).  Final verdict = batch_ok ? lane_ok :
+                  fallback to the per-lane verifier."""
+    a_point, ok_a = E.decompress(pub)
+    r_point, ok_r = E.decompress(sig[..., :32])
+    s = S.scalar_from_bytes32(sig[..., 32:])
+    ok_s = S.is_canonical(s)
+    lane_ok = ok_a & ok_r & ok_s
+
+    k = S.barrett_reduce(S.digest_to_limbs(sha.sha512_blocks(msg_blocks)))
+    z = jnp.where(lane_ok[..., None], z, 0)      # exclude invalid lanes
+    zk = mul_mod_L(z, k)                         # [B, 20]
+    zs = mul_mod_L(z, s)
+    c = sum_mod_L(zs)                            # [20]
+
+    t = E.point_add(
+        scalar_mul_base(c),
+        E.point_add(msm(E.point_neg(r_point), z, NW_Z),
+                    msm(E.point_neg(a_point), zk, NW_FULL)))
+    for _ in range(3):                   # x8: cofactored policy
+        t = E.point_add(t, t)
+    batch_ok = E.point_equal(t, E.identity(()))
+    return batch_ok, lane_ok
+
+
+verify_batch_msm_jit = jax.jit(verify_batch_msm)
+
+
+def _pad_pow2(arr: jnp.ndarray, n: int) -> jnp.ndarray:
+    return jnp.pad(arr, [(0, n - arr.shape[0])]
+                   + [(0, 0)] * (arr.ndim - 1))
+
+
+def verify_batch_adaptive(pub: jnp.ndarray, sig: jnp.ndarray,
+                          msg_blocks: jnp.ndarray,
+                          seed: Optional[int] = None,
+                          leaf: int = 64) -> np.ndarray:
+    """[B] bool verdicts with per-lane-identical semantics (the
+    cofactored policy holds on both paths): try the MSM fast path; on
+    failure bisect, settling sub-batches smaller than `leaf` with the
+    per-lane verifier.  An all-honest batch costs one MSM pass; an
+    adversary injecting bad lanes only pushes those sub-batches onto
+    the per-lane path.
+
+    Sub-batches are padded to the next power of two before the MSM
+    call (pad lanes get z = 0, contributing nothing) so the jit cache
+    holds O(log B) shapes — otherwise adversarial bisection at
+    varying tick sizes would force a fresh XLA compile per size, a
+    cheap unauthenticated latency-amplification vector."""
+    B = int(pub.shape[0])
+    out = np.zeros(B, bool)
+    # leaf >= 2: at leaf 1 the bisection midpoint lo + n//2 == lo and
+    # a failing lane would recurse forever
+    leaf = max(int(leaf), 2)
+
+    def solve(lo: int, hi: int) -> None:
+        n = hi - lo
+        if n == 0:
+            return
+        if n < leaf:
+            # pad to the fixed leaf size: one per-lane compile shape
+            out[lo:hi] = np.asarray(E.verify_batch_jit(
+                _pad_pow2(pub[lo:hi], leaf), _pad_pow2(sig[lo:hi], leaf),
+                _pad_pow2(msg_blocks[lo:hi], leaf)))[:n]
+            return
+        n2 = 1 << (n - 1).bit_length()
+        z = _pad_pow2(make_z(n, seed), n2)
+        batch_ok, lane_ok = verify_batch_msm_jit(
+            _pad_pow2(pub[lo:hi], n2), _pad_pow2(sig[lo:hi], n2),
+            _pad_pow2(msg_blocks[lo:hi], n2), z)
+        if bool(np.asarray(batch_ok)):
+            out[lo:hi] = np.asarray(lane_ok)[:n]
+            return
+        mid = lo + n // 2
+        solve(lo, mid)
+        solve(mid, hi)
+
+    solve(0, B)
+    return out

@@ -30,9 +30,12 @@ Why it is fast (vs `pallas_ed25519.straus_sub_pallas`, the v1 kernel):
   - **sqrt by addition chain**: 252 squarings + 11 muls, vs ~253
     squarings + ~125 muls of naive square-and-multiply.
 
-Checks per RFC 8032 §5.1.7 (exactly the set the v1 path enforced):
-A and R decode to curve points (canonical y, residue x^2, x=0/sign=1
-rejected), S < L (host/XLA side), cofactorless group equation.
+Checks per RFC 8032 §5.1.7: A and R decode to curve points (canonical
+y, residue x^2, x=0/sign=1 rejected), S < L (host/XLA side), and the
+COFACTORED group equation [8]([S]B - [k]A) == [8]R — the framework's
+consensus-grade policy (rationale: ed25519_ref.verify) under which
+this kernel, the host verifiers and the MSM batch check agree on
+every input.
 
 Differential oracles: `ed25519_ref.verify` (RFC vectors) and the jnp
 path `ed25519_jax.verify_batch` — see tests/test_pallas_verify.py.
@@ -451,9 +454,16 @@ def _verify_kernel(ya_ref, sa_ref, yr_ref, sr_ref, sdig_ref, kdig_ref,
     X, Y, Z = jax.lax.fori_loop(
         0, N_WIN, body, (zero, one, one))
 
-    # projective equality against affine R: X == x_R Z, Y == y_R Z
-    eqx = _is_zero(_fmul(xr, Z) - X)
-    eqy = _is_zero(_fmul(yr, Z) - Y)
+    # COFACTORED equality (framework-wide policy; see
+    # ed25519_ref.verify): [8]Q == [8]R so single/batch/MSM
+    # verification agree on every input.  Three doublings each side,
+    # then projective cross-multiplied equality.
+    RX, RY, RZ = xr, yr, one
+    for _ in range(3):
+        X, Y, Z, _ = _pt_dbl(X, Y, Z, want_t=False)
+        RX, RY, RZ, _ = _pt_dbl(RX, RY, RZ, want_t=False)
+    eqx = _is_zero(_fmul(X, RZ) - _fmul(RX, Z))
+    eqy = _is_zero(_fmul(Y, RZ) - _fmul(RY, Z))
     ok = ok_a & ok_r & eqx & eqy
     out_ref[...] = ok.astype(I32)
 

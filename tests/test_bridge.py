@@ -1,6 +1,7 @@
 """Bridge: value interning, slot maps, and the vote-batch ingestion ABI."""
 
 import numpy as np
+import pytest
 
 from agnes_tpu.bridge import SlotMap, ValueTable, VoteBatcher, WireVote
 from agnes_tpu.core import native
@@ -335,3 +336,40 @@ def test_native_verify_rejects_wrong_length_inputs():
     res = native.verify_batch([pk, pk[:5], pk], [msg, msg, msg],
                               [sig, sig, sig[:5]])
     assert res == [True, False, False]
+
+def test_batcher_msm_mode_matches_lane_mode():
+    """verify_mode='msm' (batch random-linear-combination fast path
+    with per-lane bisection fallback) must produce identical phases
+    and rejection counters to the per-lane mode.  The batch is sized
+    above msm_leaf so the MSM path actually executes: the forged lane
+    fails the combined equation and bisection settles the halves on
+    the per-lane verifier."""
+    I, V = 8, 4
+    seeds = [bytes([i + 1]) * 32 for i in range(V)]
+    pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in seeds])
+
+    def run(mode):
+        b = VoteBatcher(I, V, n_slots=4, verify_mode=mode, msm_leaf=33)
+        for inst in range(I):
+            for v in range(V):
+                b.add(_signed_vote(seeds, inst, v, 0, 0,
+                                   VoteType.PREVOTE, 7))
+        forged = native.sign(b"\xBB" * 32,
+                             vote_signing_bytes(0, 0, 0, 7))
+        b.add(WireVote(instance=1, validator=3, height=0, round=0,
+                       typ=VoteType.PREVOTE, value=9, signature=forged))
+        phases = b.build_phases(pubkeys)
+        return phases, b.rejected_signature
+
+    (ph_l, rej_l), (ph_m, rej_m) = run("lanes"), run("msm")
+    assert rej_l == 1 == rej_m
+    assert len(ph_l) == len(ph_m)
+    for (pa, na), (pb, nb) in zip(ph_l, ph_m):
+        assert na == nb
+        np.testing.assert_array_equal(np.asarray(pa.slots),
+                                      np.asarray(pb.slots))
+        np.testing.assert_array_equal(np.asarray(pa.mask),
+                                      np.asarray(pb.mask))
+    with pytest.raises(ValueError):
+        VoteBatcher(I, V, n_slots=4, verify_mode="nope")

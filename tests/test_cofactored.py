@@ -1,0 +1,71 @@
+"""The cofactored-verification agreement property, cross-implementation.
+
+The framework's policy (rationale: ed25519_ref.verify) is that every
+verifier — Python oracle, C++ host, jnp batch, Pallas kernel, MSM
+batch check — applies the COFACTORED equation [8]([S]B - [k]A) ==
+[8]R, so a signature's validity is a pure function of its bytes under
+every verification strategy.  The discriminating input is a
+torsion-defect signature (R offset by a small-order point): it fails
+the exact equation, satisfies the x8 one, and under a mixed policy
+would be accepted by some verifiers and rejected by others — exactly
+the divergence a consensus engine cannot tolerate.  (Pallas-kernel
+agreement on the same input is covered by tests/test_pallas_verify.py
+lane 17.)
+"""
+
+import numpy as np
+
+from agnes_tpu.core import native
+from agnes_tpu.crypto import ed25519_jax as E
+from agnes_tpu.crypto import ed25519_ref as ref
+from agnes_tpu.crypto import msm_jax as M
+from tests.test_pallas_verify import torsioned_sig
+
+MSG = b"\x05" * 45
+
+
+def _batch(entries):
+    pubs = [p for p, _, _ in entries]
+    msgs = [m for _, m, _ in entries]
+    sigs = [s for _, _, s in entries]
+    return E.pack_verify_inputs_host(pubs, msgs, sigs)
+
+
+def test_torsion_defect_is_pure_torsion():
+    """Sanity on the fixture itself: exact equation fails, x8 holds."""
+    pub, msg, sig = torsioned_sig(bytes([7]) * 32, MSG)
+    A = ref._decompress(pub)
+    R = ref._decompress(sig[:32])
+    s = int.from_bytes(sig[32:], "little")
+    k = ref._sha512_int(sig[:32] + pub + MSG) % ref.L
+    lhs = ref._mul(s, ref.BASE)
+    rhs = ref._add(R, ref._mul(k, A))
+    assert not ref.point_equal(lhs, rhs)           # exact: fails
+    assert ref.point_equal(ref._mul(8, lhs), ref._mul(8, rhs))
+
+
+def test_all_verifiers_agree_on_torsion_defect():
+    honest_seed = bytes([1]) * 32
+    sk, pk = ref.keypair(honest_seed)
+    honest = (pk, MSG, ref.sign(sk, MSG))
+    tors = torsioned_sig(bytes([7]) * 32, MSG)
+    forged = (pk, MSG, bytes([honest[2][0] ^ 1]) + honest[2][1:])
+    entries = [honest, tors, forged]
+    want = [True, True, False]
+
+    # python oracle
+    assert [ref.verify(p, m, s) for p, m, s in entries] == want
+    # C++ host verifier
+    assert [native.verify(p, m, s) for p, m, s in entries] == want
+    # jnp batch path
+    pub, sig, blocks = _batch(entries)
+    assert np.asarray(E.verify_batch_jit(pub, sig, blocks)).tolist() == want
+    # MSM batch check: torsion lane is structurally valid and the x8
+    # combined equation holds for it, so with the forged lane removed
+    # the batch accepts; with it, the adaptive path localizes it
+    pub2, sig2, blocks2 = _batch(entries[:2])
+    batch_ok, lane_ok = M.verify_batch_msm_jit(
+        pub2, sig2, blocks2, M.make_z(2, seed=11))
+    assert bool(batch_ok) and np.asarray(lane_ok).all()
+    got = M.verify_batch_adaptive(pub, sig, blocks, seed=12, leaf=2)
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,123 @@
+"""MSM batch verification (crypto/msm_jax.py) vs the per-lane oracle.
+
+Scalar arithmetic is oracled by plain Python bignums; the segmented-
+scan Pippenger MSM by ref-implementation point arithmetic; the batch
+check end-to-end by `ed25519_ref.verify` / `ed25519_jax.verify_batch`
+on honest, forged, and structurally-invalid lanes.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from agnes_tpu.core import native
+from agnes_tpu.crypto import ed25519_jax as E
+from agnes_tpu.crypto import ed25519_ref as ref
+from agnes_tpu.crypto import field_jax as F
+from agnes_tpu.crypto import msm_jax as M
+from agnes_tpu.crypto import scalar_jax as S
+from agnes_tpu.crypto.encoding import vote_signing_bytes
+
+
+def _limbs_of(x: int, n: int) -> jnp.ndarray:
+    return jnp.asarray([(x >> (F.BITS * i)) & F.LMASK for i in range(n)],
+                       F.I32)
+
+
+def _int_of(limbs) -> int:
+    arr = np.asarray(limbs)
+    return sum(int(v) << (F.BITS * i) for i, v in enumerate(arr))
+
+
+def test_mul_mod_L_oracle():
+    rng = np.random.default_rng(7)
+    for _ in range(10):
+        a = int(rng.integers(0, 1 << 63)) << 64 | int(
+            rng.integers(0, 1 << 63))                    # ~127 bits
+        b = int(rng.integers(0, 1 << 63)) << 190         # ~253 bits
+        got = M.mul_mod_L(_limbs_of(a, M.Z_LIMBS)[None],
+                          _limbs_of(b, 20)[None])[0]
+        assert _int_of(got) == a * b % S.L
+
+
+def test_sum_mod_L_oracle():
+    rng = np.random.default_rng(8)
+    vals = [int(rng.integers(0, 1 << 62)) * int(rng.integers(0, 1 << 62))
+            for _ in range(33)]
+    x = jnp.stack([_limbs_of(v, 20) for v in vals])
+    assert _int_of(M.sum_mod_L(x)) == sum(vals) % S.L
+
+
+def test_window_digits_oracle():
+    v = 0x1234_5678_9ABC_DEF0_1357
+    d = M.window_digits(_limbs_of(v, 20)[None], 10)
+    for w in range(10):
+        assert int(d[w, 0]) == (v >> (8 * w)) & 0xFF
+
+
+def _point_limbs(pt) -> E.Point:
+    """ref projective point -> single-lane extended Point limbs."""
+    zi = ref._inv(pt[2])
+    x, y = pt[0] * zi % ref.P, pt[1] * zi % ref.P
+    return E.Point(F.to_limbs(x), F.to_limbs(y), F.to_limbs(1),
+                   F.to_limbs(x * y % ref.P))
+
+
+def test_msm_oracle_small():
+    """Σ [sᵢ]Pᵢ over 8 points vs ref arithmetic (16-bit scalars so the
+    two-window graph stays small on CPU)."""
+    rng = np.random.default_rng(9)
+    ms = [int(rng.integers(1, 1 << 30)) for _ in range(8)]
+    ss = [int(rng.integers(0, 1 << 16)) for _ in range(8)]
+    pts = [ref._mul(m, ref.BASE) for m in ms]
+    points = E.Point(*[jnp.stack(c) for c in zip(
+        *[tuple(_point_limbs(p)) for p in pts])])
+    scalars = jnp.stack([_limbs_of(s, 20) for s in ss])
+    got = M.msm(points, scalars, n_windows=2)
+    want = ref._mul(sum(s * m for s, m in zip(ss, ms)) % S.L, ref.BASE)
+    assert bool(E.point_equal(got, _point_limbs(want)))
+
+
+def _signed_batch(n, forge=()):
+    seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(n)]
+    msgs = [vote_signing_bytes(1, 0, 0, i % 5) for i in range(n)]
+    pks = [native.pubkey(s) for s in seeds]
+    sigs = [bytearray(native.sign(s, m)) for s, m in zip(seeds, msgs)]
+    for i in forge:
+        sigs[i][0] ^= 1
+    return E.pack_verify_inputs_host(pks, msgs, [bytes(s) for s in sigs])
+
+
+def test_batch_msm_honest():
+    pub, sig, blocks = _signed_batch(32)
+    z = M.make_z(32, seed=1)
+    batch_ok, lane_ok = M.verify_batch_msm_jit(pub, sig, blocks, z)
+    assert bool(batch_ok)
+    assert bool(np.asarray(lane_ok).all())
+
+
+def test_batch_msm_detects_forgery_and_invalid_lanes():
+    pub, sig, blocks = _signed_batch(32, forge=(5,))
+    z = M.make_z(32, seed=2)
+    batch_ok, _ = M.verify_batch_msm_jit(pub, sig, blocks, z)
+    assert not bool(batch_ok)
+
+    # structurally invalid lanes are EXCLUDED (z zeroed), so the batch
+    # still passes and lane_ok pinpoints them: S >= L on lane 3
+    pub, sig, blocks = _signed_batch(32)
+    sig = np.asarray(sig).copy()
+    sig[3, 32:] = 0xFF                       # S way above L
+    batch_ok, lane_ok = M.verify_batch_msm_jit(
+        pub, jnp.asarray(sig), blocks, M.make_z(32, seed=3))
+    assert bool(batch_ok)
+    lane_ok = np.asarray(lane_ok)
+    assert not lane_ok[3] and lane_ok.sum() == 31
+
+
+def test_adaptive_matches_per_lane_oracle():
+    pub, sig, blocks = _signed_batch(64, forge=(7, 40))
+    got = M.verify_batch_adaptive(pub, sig, blocks, seed=4, leaf=33)
+    want = np.asarray(E.verify_batch_jit(pub, sig, blocks))
+    np.testing.assert_array_equal(got, want)
+    assert not want[7] and not want[40] and want.sum() == 62

@@ -66,9 +66,29 @@ def _cases():
     enc_id = bytearray((1).to_bytes(32, "little"))
     enc_id[31] |= 0x80
     add(bytes(enc_id), base_msgs[0], base_sigs[0])
+    # 17: torsion-defect signature (R' = [r]B + tau, tau of order 4,
+    # S solved for R') — ACCEPTED under the framework's cofactored
+    # policy by every verifier alike (the agreement property; see
+    # ed25519_ref.verify)
+    add(*torsioned_sig(bytes([9]) * 32, base_msgs[0]))
     # pad all messages to the fixed length
     msgs = [m[:45].ljust(45, b"\0") for m in msgs]
     return pubs, msgs, sigs
+
+
+def torsioned_sig(seed, msg):
+    """(pub, sig) whose verification defect is a pure small-order
+    torsion point: fails the exact equation, satisfies the x8 one."""
+    h = ref._sha512(seed)
+    a = ref._clamp(h)
+    pub = ref._compress(ref._mul(a, ref.BASE))
+    r = ref._sha512_int(h[32:] + b"torsion" + msg) % ref.L
+    tau = ref._decompress(bytes(32))       # y = 0: order-4 point
+    rp = ref._add(ref._mul(r, ref.BASE), tau)
+    rb = ref._compress(rp)
+    k = ref._sha512_int(rb + pub + msg) % ref.L
+    s = (r + k * a) % ref.L
+    return pub, msg, rb + s.to_bytes(32, "little")
 
 
 def test_fused_kernel_matches_oracle():
@@ -81,6 +101,7 @@ def test_fused_kernel_matches_oracle():
     assert (got == want).all(), (got.tolist(), want.tolist())
     assert want[:4].all()          # sanity: honest lanes verify
     assert not want[4:12].any()    # adversarial lanes all rejected
+    assert want[17]                # torsion defect: cofactored-accepted
 
 
 def test_digits65_roundtrip():
