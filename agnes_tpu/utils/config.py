@@ -33,6 +33,14 @@ class RunConfig:
     # dtype policy: tally weights stay int32; this switches any future
     # floating-point surfaces (bf16 on TPU by default)
     float_dtype: str = "bfloat16"
+    # signature verification strategy for the ingestion bridge:
+    # "lanes" = per-lane kernel; "msm" = batch random-linear-
+    # combination fast path with per-lane fallback (both cofactored,
+    # identical verdicts — a throughput choice; crypto/msm_jax.py)
+    verify_mode: str = "lanes"
+    # bound on the bridge's pre-verification future-round hold-back
+    # queue (None = 2 full [instances, validators] ticks, floor 64k)
+    held_cap: Optional[int] = None
     # checkpointing
     checkpoint_dir: Optional[str] = None
     checkpoint_every_steps: int = 0     # 0 = disabled
@@ -45,10 +53,36 @@ class RunConfig:
             assert self.n_instances % d == 0, "instances % mesh data axis"
             assert self.n_validators % v == 0, "validators % mesh val axis"
         assert self.float_dtype in ("bfloat16", "float32")
+        assert self.verify_mode in ("lanes", "msm")
+        assert self.held_cap is None or self.held_cap > 0
         return self
 
     def as_dict(self) -> dict:
         return asdict(self)
+
+    # -- bridge factories: THE way a deployment's ingestion bridge is
+    # built, so verify_mode/held_cap actually govern the run ----------------
+
+    def make_batcher(self, **kw):
+        """VoteBatcher sized and policied by this config (kw overrides
+        forward to the constructor)."""
+        from agnes_tpu.bridge import VoteBatcher
+        kw.setdefault("n_slots", self.n_slots)
+        kw.setdefault("n_rounds", self.n_rounds)
+        kw.setdefault("held_cap", self.held_cap)
+        kw.setdefault("verify_mode", self.verify_mode)
+        return VoteBatcher(self.n_instances, self.n_validators, **kw)
+
+    def make_native_loop(self, pubkeys=None, powers=None, **kw):
+        """NativeIngestLoop (C++ event loop) for this config.  Note the
+        native loop's Python verify stage is per-lane; verify_mode
+        'msm' applies to the numpy batcher path."""
+        from agnes_tpu.bridge import NativeIngestLoop
+        kw.setdefault("n_slots", self.n_slots)
+        kw.setdefault("n_rounds", self.n_rounds)
+        kw.setdefault("held_cap", self.held_cap)
+        return NativeIngestLoop(self.n_instances, self.n_validators,
+                                pubkeys=pubkeys, powers=powers, **kw)
 
     @classmethod
     def from_args(cls, argv=None) -> "RunConfig":
@@ -60,6 +94,9 @@ class RunConfig:
         p.add_argument("--mesh", type=str, default=None,
                        help="DxV, e.g. 4x2")
         p.add_argument("--float-dtype", default=cls.float_dtype)
+        p.add_argument("--verify-mode", default=cls.verify_mode,
+                       choices=("lanes", "msm"))
+        p.add_argument("--held-cap", type=int, default=None)
         p.add_argument("--checkpoint-dir", default=None)
         p.add_argument("--checkpoint-every", type=int, default=0)
         a = p.parse_args(argv)
@@ -70,5 +107,6 @@ class RunConfig:
         return cls(n_validators=a.validators, n_instances=a.instances,
                    n_rounds=a.rounds, n_slots=a.slots, mesh=mesh,
                    float_dtype=a.float_dtype,
+                   verify_mode=a.verify_mode, held_cap=a.held_cap,
                    checkpoint_dir=a.checkpoint_dir,
                    checkpoint_every_steps=a.checkpoint_every).validate()

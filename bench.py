@@ -218,10 +218,10 @@ def bench_pipeline(n_instances: int = 1024, n_validators: int = 128,
     lane; instances share the validator set, so each height signs 2V
     fresh messages and tiles them across instances — the verify kernel
     still checks all 2*I*V lanes."""
-    from agnes_tpu.bridge import VoteBatcher
     from agnes_tpu.bridge.ingest import vote_messages_np
     from agnes_tpu.core import native
     from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.utils.config import RunConfig
 
     I, V = n_instances, n_validators
     seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(V)]
@@ -229,7 +229,8 @@ def bench_pipeline(n_instances: int = 1024, n_validators: int = 128,
                         for s in seeds])
 
     d = DeviceDriver(I, V, advance_height=True)
-    bat = VoteBatcher(I, V, n_slots=4)
+    bat = RunConfig(n_validators=V, n_instances=I,
+                    n_slots=4).validate().make_batcher()
     inst = np.repeat(np.arange(I), V)
     val = np.tile(np.arange(V), I)
     n = I * V

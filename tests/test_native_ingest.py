@@ -369,3 +369,26 @@ def test_hostile_dims_rejected_in_wrapper():
         NativeIngestLoop(4, 4, n_slots=0)
     with pytest.raises(ValueError):
         NativeIngestLoop(2**40, 2**40, n_slots=4)
+
+def test_push_chunking_invariance():
+    """Within one tick, the dense phases are a function of the record
+    stream, not of how it was chunked across push() calls."""
+    I, V = 4, 8
+    rng = np.random.default_rng(12)
+    n = 64
+    inst = rng.integers(0, I, n)
+    val = rng.integers(0, V, n)
+    rnd = rng.integers(0, 2, n)
+    typ = rng.integers(0, 2, n)
+    value = rng.integers(-1, 3, n)
+    wire = pack_wire_votes(inst, val, np.zeros(n), rnd, typ, value)
+
+    loop1 = NativeIngestLoop(I, V, n_slots=4)
+    loop1.push(wire)
+    a = loop1.build_phases()
+
+    loop2 = NativeIngestLoop(I, V, n_slots=4)
+    for lo, hi in ((0, 7), (7, 40), (40, 64)):
+        loop2.push(wire[lo * 96:hi * 96])
+    b = loop2.build_phases()
+    _assert_same(a, b)

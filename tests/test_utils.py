@@ -153,3 +153,18 @@ def test_checkpoint_files_are_atomic(tmp_path):
     save_driver(d, path)
     assert os.path.exists(path)
     assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+def test_runconfig_bridge_factories_apply_policy():
+    """verify_mode/held_cap must actually govern the bridges a config
+    builds (dead configuration would silently misreport the run)."""
+    from agnes_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(n_validators=4, n_instances=2, n_slots=3,
+                    verify_mode="msm", held_cap=123).validate()
+    b = cfg.make_batcher()
+    assert b.verify_mode == "msm" and b.held_cap == 123
+    assert b.I == 2 and b.V == 4 and b.slots.n_slots == 3
+    loop = cfg.make_native_loop()
+    assert loop.I == 2 and loop.V == 4
+    # override forwards
+    assert cfg.make_batcher(verify_mode="lanes").verify_mode == "lanes"
