@@ -74,9 +74,14 @@ class RunConfig:
         return VoteBatcher(self.n_instances, self.n_validators, **kw)
 
     def make_native_loop(self, pubkeys=None, powers=None, **kw):
-        """NativeIngestLoop (C++ event loop) for this config.  Note the
-        native loop's Python verify stage is per-lane; verify_mode
-        'msm' applies to the numpy batcher path."""
+        """NativeIngestLoop (C++ event loop) for this config.  The
+        native loop's verify stage is per-lane only; a config
+        declaring verify_mode='msm' must use make_batcher (failing
+        loudly here beats silently misreporting the run)."""
+        if self.verify_mode != "lanes":
+            raise ValueError(
+                f"verify_mode={self.verify_mode!r} is not supported by "
+                "the native ingest loop; use make_batcher()")
         from agnes_tpu.bridge import NativeIngestLoop
         kw.setdefault("n_slots", self.n_slots)
         kw.setdefault("n_rounds", self.n_rounds)
