@@ -156,3 +156,94 @@ def load_executor_into(ex, path: str) -> Tuple[int, dict]:
                             edge_triggered=True)
     ex.wheel.now = doc["now"]
     return ex.height, ex.decided
+
+
+# --- ingestion bridge snapshots ---------------------------------------------
+
+
+def save_batcher(bat, path: str) -> None:
+    """Persist a bridge.VoteBatcher's durable state: the slot<->value
+    maps (without which device decision slots cannot be decoded after
+    a crash), the synced window (heights/base_round), counters, and
+    the retained verified-vote log — the SLASHING EVIDENCE, which must
+    survive restarts just like the executor's equivocation records.
+    In-flight votes (pending/held) and host-fallback tallies are NOT
+    persisted: a restarted node re-receives them from peers, the same
+    crash-recovery story as `save_executor`."""
+    from agnes_tpu.bridge.ingest import _concat
+
+    leaves = {
+        "meta": np.asarray(
+            [bat.I, bat.V, bat.W, bat.slots.n_slots, bat.held_cap,
+             bat.msm_leaf, bat.rejected_signature, bat.rejected_malformed,
+             bat.overflow_votes, bat.dropped_stale_height,
+             bat.dropped_held_overflow, bat.slots.overflowed], np.int64),
+        "verify_mode": np.asarray(bat.verify_mode),
+        "heights": bat.heights,
+        "base_round": bat.base_round,
+        "powers": bat.powers,
+    }
+    # slot maps as a dense [I, S] value-id array in slot order
+    smap = np.full((bat.I, bat.slots.n_slots), -1, np.int64)
+    for i, m in enumerate(bat.slots._maps):
+        for vid, s in m.items():
+            smap[i, s] = vid
+    leaves["slot_values"] = smap
+    if bat._log:
+        log = _concat(bat._log)
+        for f in ("instance", "validator", "height", "round", "typ",
+                  "value"):
+            leaves["log." + f] = getattr(log, f)
+        if log.signature is not None:
+            leaves["log.signature"] = log.signature
+            # _concat zero-fills batches logged WITHOUT signatures; a
+            # per-row mask keeps those None after restore (all-zero
+            # bytes must never surface as 'signed' evidence)
+            leaves["log.has_sig"] = np.concatenate(
+                [np.full(len(b), b.signature is not None)
+                 for b in bat._log])
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **leaves)
+    os.replace(tmp, path)
+
+
+def load_batcher(path: str):
+    """Rebuild a VoteBatcher from a snapshot (decoding and evidence
+    extraction work immediately; in-flight votes re-arrive from
+    peers)."""
+    from agnes_tpu.bridge.ingest import VoteBatcher, _Batch
+
+    with np.load(path) as z:
+        m = z["meta"]
+        bat = VoteBatcher(int(m[0]), int(m[1]), n_slots=int(m[3]),
+                          n_rounds=int(m[2]), powers=z["powers"],
+                          held_cap=int(m[4]),
+                          verify_mode=str(z["verify_mode"]),
+                          msm_leaf=int(m[5]))
+        bat.heights = z["heights"].astype(np.int64)
+        bat.base_round = z["base_round"].astype(np.int64)
+        (bat.rejected_signature, bat.rejected_malformed,
+         bat.overflow_votes, bat.dropped_stale_height,
+         bat.dropped_held_overflow) = (int(x) for x in m[6:11])
+        bat.slots.overflowed = int(m[11])
+        smap = z["slot_values"]
+        for i in range(smap.shape[0]):
+            for s in range(smap.shape[1]):
+                if smap[i, s] >= 0:
+                    bat.slots._maps[i][int(smap[i, s])] = s
+        if "log.instance" in z.files:
+            cols = tuple(z["log." + f] for f in
+                         ("instance", "validator", "height", "round",
+                          "typ", "value"))
+            if "log.signature" not in z.files:
+                bat._log = [_Batch(*cols, None)]
+            else:
+                has = z["log.has_sig"]
+                sig = z["log.signature"]
+                bat._log = [
+                    _Batch(*(c[sel] for c in cols),
+                           sig[sel] if signed else None)
+                    for signed, sel in ((True, has), (False, ~has))
+                    if sel.any()]
+    return bat

@@ -224,7 +224,7 @@ def _pipeline_harness(n_instances: int, n_validators: int, heights: int,
     timed region, while tiling/packing/verify/densify — the actual
     per-tick ingest cost — stay inside it.
 
-    `make_feeder(pubkeys) -> (sync, feed, rejected)`:
+    `make_feeder(I, V, pubkeys) -> (sync, feed, rejected)`:
       sync(base_round, heights)     adopt the device window/heights
       feed(h, typ, sigs[V, 64])     ingest one phase; -> [(phase, n)]
       rejected()                    running bad-signature count
