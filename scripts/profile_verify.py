@@ -99,6 +99,28 @@ def main():
     dt = timeit(f, q)
     print(f"compress (w/ pow)      B={B}: {dt*1e3:8.2f} ms")
 
+    # --- v2 fused kernel (crypto/pallas_verify.py) --------------------------
+    from agnes_tpu.crypto import pallas_verify as pv
+
+    dt = timeit(lambda: pv.verify_batch_pallas(pub, sig, blocks))
+    print(f"v2 fused kernel        B={B}: {dt*1e3:8.2f} ms  {B/dt:,.0f}/s")
+
+    # v2 host/XLA preprocessing alone (sha, digits, tiling — everything
+    # except the pallas_call): bound by subtracting from the full time
+    f = jax.jit(lambda s_, bl: (
+        pv._digits65(S.barrett_reduce(
+            S.digest_to_limbs(sha.sha512_blocks(bl)))),
+        pv._digits65(S.scalar_from_bytes32(s_[..., 32:]))))
+    dt = timeit(f, sig, blocks)
+    print(f"v2 xla-side prep       B={B}: {dt*1e3:8.2f} ms")
+
+    # MSM batch check (production adaptive path)
+    from agnes_tpu.crypto import msm_jax as M
+
+    z = M.make_z(B, seed=0)
+    dt = timeit(M.verify_batch_msm_jit, pub, sig, blocks, z)
+    print(f"msm batch check        B={B}: {dt*1e3:8.2f} ms  {B/dt:,.0f}/s")
+
 
 if __name__ == "__main__":
     main()
