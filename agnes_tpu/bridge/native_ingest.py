@@ -76,6 +76,13 @@ def _lib() -> ctypes.CDLL:
                                       c.c_char_p]
         L.ag_ing_clear_log.argtypes = [c.c_void_p]
         L.ag_ing_counters.argtypes = [c.c_void_p, c.c_void_p]
+        L.ag_ing_export_slots.argtypes = [c.c_void_p, c.c_void_p]
+        L.ag_ing_import_slots.argtypes = [c.c_void_p, c.c_void_p]
+        L.ag_ing_log_size.restype = c.c_int64
+        L.ag_ing_log_size.argtypes = [c.c_void_p]
+        L.ag_ing_export_log.argtypes = [c.c_void_p, c.c_void_p]
+        L.ag_ing_import_log.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        L.ag_ing_restore_counters.argtypes = [c.c_void_p, c.c_void_p]
         _configured = True
     return L
 
@@ -114,6 +121,7 @@ class NativeIngestLoop:
                  powers: Optional[np.ndarray] = None,
                  held_cap: Optional[int] = None):
         self.I, self.V = n_instances, n_validators
+        self._n_slots, self._n_rounds = n_slots, n_rounds
         self.signed = pubkeys is not None
         L = _lib()
         if pubkeys is not None:
@@ -131,6 +139,7 @@ class NativeIngestLoop:
             if pw.shape != (n_validators,):
                 raise ValueError(
                     f"powers must be [{n_validators}], got {pw.shape}")
+        self._powers = pw
         self._h = L.ag_ing_new(
             n_instances, n_validators, n_rounds, n_slots, pk,
             pw.ctypes.data if pw is not None else None)
@@ -146,6 +155,8 @@ class NativeIngestLoop:
             if int(held_cap) <= 0:
                 raise ValueError(f"held_cap must be positive: {held_cap}")
             L.ag_ing_set_held_cap(self._h, int(held_cap))
+        self.held_cap = (int(held_cap) if held_cap is not None
+                         else max(65536, 2 * n_instances * n_validators))
 
     def __del__(self):
         if getattr(self, "_h", None):
@@ -163,6 +174,7 @@ class NativeIngestLoop:
                 f"base_round/heights must be [{self.I}], got "
                 f"{base.shape}/{hts.shape}")
         self._heights = hts
+        self._base_round = base
         _lib().ag_ing_sync(self._h, base.ctypes.data, hts.ctypes.data)
 
     def push(self, wire_bytes: bytes) -> int:
@@ -252,6 +264,52 @@ class NativeIngestLoop:
 
     def clear_log(self) -> None:
         _lib().ag_ing_clear_log(self._h)
+
+    # -- snapshot surface (utils.checkpoint.save/load_native_loop) ----------
+
+    def export_state(self) -> dict:
+        """The durable state a crash must not lose: slot maps (decision
+        decode), the verified-vote log (slashing evidence), counters,
+        and the synced window.  In-flight votes are not exported (a
+        restarted node re-receives them from peers)."""
+        L = _lib()
+        slots = np.empty(self.I * self._n_slots, np.int64)
+        L.ag_ing_export_slots(self._h, slots.ctypes.data)
+        n = L.ag_ing_log_size(self._h)
+        log = np.empty((n, REC_SIZE), np.uint8)
+        if n:
+            L.ag_ing_export_log(self._h, log.ctypes.data)
+        c = self.counters
+        return {
+            "slots": slots.reshape(self.I, self._n_slots),
+            "log": log,
+            "counters": np.asarray(
+                [c["rejected_malformed"], c["dropped_stale_height"],
+                 c["rejected_signature"], c["overflow_votes"],
+                 c["dropped_held_overflow"]], np.int64),
+            "heights": getattr(self, "_heights",
+                               np.zeros(self.I, np.int64)),
+            "base_round": getattr(self, "_base_round",
+                                  np.zeros(self.I, np.int64)),
+        }
+
+    def import_state(self, st: dict) -> None:
+        L = _lib()
+        slots = np.ascontiguousarray(st["slots"], np.int64)
+        if slots.shape != (self.I, self._n_slots):
+            raise ValueError(f"slots must be [{self.I}, {self._n_slots}]")
+        self.sync_device(st["base_round"], st["heights"])
+        L.ag_ing_import_slots(self._h, slots.ctypes.data)
+        log = np.ascontiguousarray(st["log"], np.uint8)
+        if log.ndim != 2 or log.shape[1] != REC_SIZE:
+            # the C side reads n*96 bytes blind; screen the shape here
+            raise ValueError(f"log must be [n, {REC_SIZE}]: {log.shape}")
+        if len(log):
+            L.ag_ing_import_log(self._h, log.tobytes(), len(log))
+        cnt = np.ascontiguousarray(st["counters"], np.int64)
+        if cnt.shape != (5,):
+            raise ValueError("counters must be [5]")
+        L.ag_ing_restore_counters(self._h, cnt.ctypes.data)
 
     @property
     def counters(self) -> dict:

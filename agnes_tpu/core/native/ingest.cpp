@@ -196,6 +196,45 @@ inline int32_t slot_for(Loop* L, int64_t inst, int64_t value) {
   return n++;
 }
 
+// wire-record layout (the module-top comment) in ONE place: push,
+// evidence, and the snapshot export/import all share these
+void pack_rec(const Rec& r, uint8_t* p) {
+  std::memset(p, 0, kRecSize);
+  uint32_t u32 = static_cast<uint32_t>(r.instance);
+  std::memcpy(p + 0, &u32, 4);
+  u32 = static_cast<uint32_t>(r.validator);
+  std::memcpy(p + 4, &u32, 4);
+  std::memcpy(p + 8, &r.height, 8);
+  int32_t i32 = static_cast<int32_t>(r.round);
+  std::memcpy(p + 16, &i32, 4);
+  p[20] = static_cast<uint8_t>(r.typ);
+  p[21] = r.value == kNil ? 0 : 1;
+  int64_t v = r.value == kNil ? 0 : r.value;
+  std::memcpy(p + 24, &v, 8);
+  std::memcpy(p + 32, r.sig, 64);
+}
+
+void parse_rec(const uint8_t* p, Rec* r) {
+  uint32_t u32;
+  std::memcpy(&u32, p + 0, 4);  r->instance = u32;
+  std::memcpy(&u32, p + 4, 4);  r->validator = u32;
+  std::memcpy(&r->height, p + 8, 8);
+  int32_t i32;
+  std::memcpy(&i32, p + 16, 4); r->round = i32;
+  r->typ = p[20];
+  bool has_value = (p[21] & 1) != 0;
+  std::memcpy(&r->value, p + 24, 8);
+  if (!has_value || r->value < 0) r->value = kNil;
+  std::memcpy(r->sig, p + 32, 64);
+}
+
+// the malformed screen every ingress shares (push AND snapshot import
+// — a corrupted snapshot must not inject records push would reject)
+inline bool rec_malformed(const Loop* L, const Rec& r) {
+  return r.instance >= L->I || r.validator >= L->V || r.round < 0 ||
+         r.typ > 1 || r.value >= kMaxValue;
+}
+
 }  // namespace
 
 extern "C" {
@@ -257,6 +296,11 @@ void ag_ing_sync(void* h, const int64_t* base_round,
   for (int64_t i = 0; i < L->I; ++i) {
     if (heights[i] > L->heights[static_cast<size_t>(i)]) {
       L->slot_count[static_cast<size_t>(i)] = 0;
+      // clear the values too: the snapshot export derives counts from
+      // the kNoValue sentinel, so stale entries would resurrect
+      // pre-advance slots on restore
+      std::fill_n(L->slot_vals.begin() + i * L->S,
+                  static_cast<size_t>(L->S), agnes::kNoValue);
       // decided heights can never commit again: drop their host tallies
       for (auto it = L->host_tally.begin(); it != L->host_tally.end();) {
         if (std::get<0>(it->first) == i &&
@@ -287,25 +331,12 @@ int64_t ag_ing_push(void* h, const uint8_t* buf, int64_t n) {
   int64_t accepted = 0;
   grow_reserve(L->pending, static_cast<size_t>(n));
   for (int64_t k = 0; k < n; ++k) {
-    const uint8_t* p = buf + k * kRecSize;
     Rec r;
-    uint32_t u32;
-    std::memcpy(&u32, p + 0, 4);  r.instance = u32;
-    std::memcpy(&u32, p + 4, 4);  r.validator = u32;
-    std::memcpy(&r.height, p + 8, 8);
-    int32_t i32;
-    std::memcpy(&i32, p + 16, 4); r.round = i32;
-    r.typ = p[20];
-    bool has_value = (p[21] & 1) != 0;
-    std::memcpy(&r.value, p + 24, 8);
-    if (!has_value || r.value < 0) r.value = kNil;
-    std::memcpy(r.sig, p + 32, 64);
+    parse_rec(buf + k * kRecSize, &r);
     r.arrival = L->arrivals++;
-
     // malformed screen (VoteBatcher.build_phases' `ok` mask); height
     // and window screens run at stage() against last-synced state
-    if (r.instance >= L->I || r.validator >= L->V || r.round < 0 ||
-        r.typ > 1 || r.value >= kMaxValue) {
+    if (rec_malformed(L, r)) {
       ++L->rejected_malformed;
       continue;
     }
@@ -645,23 +676,8 @@ int64_t ag_ing_evidence(void* h, int64_t instance, int64_t validator,
       const Rec& y = *cand[bidx];
       if (x.height == y.height && x.round == y.round && x.typ == y.typ &&
           x.value != y.value) {
-        const Rec* two[2] = {&x, &y};
-        for (int j = 0; j < 2; ++j) {
-          uint8_t* p = out + j * kRecSize;
-          std::memset(p, 0, kRecSize);
-          uint32_t u32 = static_cast<uint32_t>(two[j]->instance);
-          std::memcpy(p + 0, &u32, 4);
-          u32 = static_cast<uint32_t>(two[j]->validator);
-          std::memcpy(p + 4, &u32, 4);
-          std::memcpy(p + 8, &two[j]->height, 8);
-          int32_t i32 = static_cast<int32_t>(two[j]->round);
-          std::memcpy(p + 16, &i32, 4);
-          p[20] = static_cast<uint8_t>(two[j]->typ);
-          p[21] = two[j]->value == kNil ? 0 : 1;
-          int64_t v = two[j]->value == kNil ? 0 : two[j]->value;
-          std::memcpy(p + 24, &v, 8);
-          std::memcpy(p + 32, two[j]->sig, 64);
-        }
+        pack_rec(x, out);
+        pack_rec(y, out + kRecSize);
         return 1;
       }
     }
@@ -670,6 +686,80 @@ int64_t ag_ing_evidence(void* h, int64_t instance, int64_t validator,
 }
 
 void ag_ing_clear_log(void* h) { static_cast<Loop*>(h)->log.clear(); }
+
+// --- snapshot surface (utils/checkpoint.py save/load_native_loop) ----------
+// The durable state a crash must not lose: slot interning (decision
+// decode), the verified-vote log (slashing evidence), counters, and
+// the window (restored via ag_ing_sync by the caller).  In-flight
+// votes (pending/staged/held) and host tallies are NOT exported —
+// a restarted node re-receives them from peers (save_executor's
+// crash-recovery story).
+
+// dump slot values as [I*S] (kNoValue where unallocated)
+void ag_ing_export_slots(void* h, int64_t* out) {
+  auto* L = static_cast<Loop*>(h);
+  std::memcpy(out, L->slot_vals.data(),
+              sizeof(int64_t) * static_cast<size_t>(L->I * L->S));
+}
+
+// restore slot values (counts derived from the kNoValue sentinel);
+// slots are allocated densely, so the first sentinel ends the row
+void ag_ing_import_slots(void* h, const int64_t* vals) {
+  auto* L = static_cast<Loop*>(h);
+  L->slot_vals.assign(vals, vals + L->I * L->S);
+  for (int64_t i = 0; i < L->I; ++i) {
+    int32_t n = 0;
+    while (n < L->S && vals[i * L->S + n] != agnes::kNoValue) ++n;
+    L->slot_count[static_cast<size_t>(i)] = n;
+  }
+}
+
+int64_t ag_ing_log_size(void* h) {
+  auto* L = static_cast<Loop*>(h);
+  int64_t n = 0;
+  for (const auto& blk : L->log) n += static_cast<int64_t>(blk->size());
+  return n;
+}
+
+// dump the verified-vote log as packed wire records (the same 96-byte
+// layout ag_ing_push consumes)
+void ag_ing_export_log(void* h, uint8_t* out) {
+  auto* L = static_cast<Loop*>(h);
+  for (const auto& blk : L->log)
+    for (const Rec& r : *blk) {
+      pack_rec(r, out);
+      out += kRecSize;
+    }
+}
+
+// restore the log from packed wire records.  These lanes were
+// verified before the snapshot, but the snapshot itself is untrusted
+// input to this raw ABI: the same malformed screen as push applies —
+// a corrupted file must not inject records push would reject into
+// the slashing-evidence log.
+void ag_ing_import_log(void* h, const uint8_t* buf, int64_t n) {
+  auto* L = static_cast<Loop*>(h);
+  auto blk = std::make_shared<std::vector<Rec>>();
+  blk->reserve(static_cast<size_t>(n));
+  for (int64_t k = 0; k < n; ++k) {
+    Rec r;
+    parse_rec(buf + k * kRecSize, &r);
+    r.arrival = L->arrivals++;
+    if (!rec_malformed(L, r)) blk->push_back(r);
+  }
+  if (!blk->empty()) L->log.push_back(std::move(blk));
+}
+
+// restore counters: [malformed, stale_height, signature, overflow,
+// held_overflow] (held size and log size are structural, not set)
+void ag_ing_restore_counters(void* h, const int64_t* in) {
+  auto* L = static_cast<Loop*>(h);
+  L->rejected_malformed = in[0];
+  L->dropped_stale_height = in[1];
+  L->rejected_signature = in[2];
+  L->overflow_votes = in[3];
+  L->dropped_held_overflow = in[4];
+}
 
 // counters: [malformed, stale_height, signature, overflow, held, log,
 //            held_overflow]

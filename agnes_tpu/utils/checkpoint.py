@@ -247,3 +247,45 @@ def load_batcher(path: str):
                     for signed, sel in ((True, has), (False, ~has))
                     if sel.any()]
     return bat
+
+
+def save_native_loop(loop, path: str) -> None:
+    """Persist a bridge.NativeIngestLoop's durable state (same policy
+    as `save_batcher`: slot decode, evidence log, counters, window;
+    in-flight votes re-arrive from peers)."""
+    st = loop.export_state()
+    leaves = {"meta": np.asarray(
+        [loop.I, loop.V, loop._n_rounds, loop._n_slots,
+         int(loop.signed), loop.held_cap], np.int64)}
+    if loop._powers is not None:
+        leaves["powers"] = loop._powers
+    leaves.update(st)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **leaves)
+    os.replace(tmp, path)
+
+
+def load_native_loop(path: str, pubkeys=None, powers=None):
+    """Rebuild a NativeIngestLoop from a snapshot.  A loop saved with
+    signature verification enabled must be given the pubkey table
+    again (it is the validator set, not snapshot-private state);
+    voting powers and the held cap restore from the snapshot unless
+    overridden."""
+    from agnes_tpu.bridge import NativeIngestLoop
+
+    with np.load(path) as z:
+        m = z["meta"]
+        if bool(m[4]) and pubkeys is None:
+            raise ValueError(
+                "snapshot was taken with signature verification on; "
+                "pass the validator pubkey table")
+        if powers is None and "powers" in z.files:
+            powers = z["powers"]
+        loop = NativeIngestLoop(int(m[0]), int(m[1]), n_slots=int(m[3]),
+                                n_rounds=int(m[2]), pubkeys=pubkeys,
+                                powers=powers, held_cap=int(m[5]))
+        loop.import_state({k: z[k] for k in
+                           ("slots", "log", "counters", "heights",
+                            "base_round")})
+    return loop
