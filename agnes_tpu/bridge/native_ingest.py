@@ -81,8 +81,11 @@ def _lib() -> ctypes.CDLL:
         L.ag_ing_log_size.restype = c.c_int64
         L.ag_ing_log_size.argtypes = [c.c_void_p]
         L.ag_ing_export_log.argtypes = [c.c_void_p, c.c_void_p]
+        L.ag_ing_import_log.restype = c.c_int64
         L.ag_ing_import_log.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
         L.ag_ing_restore_counters.argtypes = [c.c_void_p, c.c_void_p]
+        L.ag_ing_get_held_cap.restype = c.c_int64
+        L.ag_ing_get_held_cap.argtypes = [c.c_void_p]
         _configured = True
     return L
 
@@ -155,8 +158,8 @@ class NativeIngestLoop:
             if int(held_cap) <= 0:
                 raise ValueError(f"held_cap must be positive: {held_cap}")
             L.ag_ing_set_held_cap(self._h, int(held_cap))
-        self.held_cap = (int(held_cap) if held_cap is not None
-                         else max(65536, 2 * n_instances * n_validators))
+        # read back the enforced cap — the C side owns the default
+        self.held_cap = int(L.ag_ing_get_held_cap(self._h))
 
     def __del__(self):
         if getattr(self, "_h", None):
@@ -295,20 +298,33 @@ class NativeIngestLoop:
 
     def import_state(self, st: dict) -> None:
         L = _lib()
+        # validate EVERY leaf before mutating anything: a malformed
+        # snapshot must not leave a half-imported loop behind
         slots = np.ascontiguousarray(st["slots"], np.int64)
         if slots.shape != (self.I, self._n_slots):
             raise ValueError(f"slots must be [{self.I}, {self._n_slots}]")
-        self.sync_device(st["base_round"], st["heights"])
-        L.ag_ing_import_slots(self._h, slots.ctypes.data)
         log = np.ascontiguousarray(st["log"], np.uint8)
         if log.ndim != 2 or log.shape[1] != REC_SIZE:
             # the C side reads n*96 bytes blind; screen the shape here
             raise ValueError(f"log must be [n, {REC_SIZE}]: {log.shape}")
-        if len(log):
-            L.ag_ing_import_log(self._h, log.tobytes(), len(log))
         cnt = np.ascontiguousarray(st["counters"], np.int64)
         if cnt.shape != (5,):
             raise ValueError("counters must be [5]")
+        base = np.ascontiguousarray(st["base_round"], np.int64)
+        hts = np.ascontiguousarray(st["heights"], np.int64)
+        if base.shape != (self.I,) or hts.shape != (self.I,):
+            raise ValueError(f"base_round/heights must be [{self.I}]")
+
+        self.sync_device(base, hts)
+        L.ag_ing_import_slots(self._h, slots.ctypes.data)
+        if len(log):
+            dropped = L.ag_ing_import_log(self._h, log.tobytes(),
+                                          len(log))
+            if dropped:
+                # evidence silently vanishing is worse than failing
+                raise RuntimeError(
+                    f"snapshot log corrupt: {dropped} record(s) failed "
+                    "the malformed screen")
         L.ag_ing_restore_counters(self._h, cnt.ctypes.data)
 
     @property

@@ -285,6 +285,12 @@ void ag_ing_set_held_cap(void* h, int64_t cap) {
   L->held_cap = cap > 0 ? cap : std::max<int64_t>(65536, 2 * L->I * L->V);
 }
 
+// the enforced cap (single source of truth: wrappers/snapshots read
+// it back instead of re-deriving the default formula)
+int64_t ag_ing_get_held_cap(void* h) {
+  return static_cast<Loop*>(h)->held_cap;
+}
+
 void ag_ing_free(void* h) { delete static_cast<Loop*>(h); }
 
 // adopt device window bases + heights; held votes re-enter pending
@@ -736,18 +742,25 @@ void ag_ing_export_log(void* h, uint8_t* out) {
 // verified before the snapshot, but the snapshot itself is untrusted
 // input to this raw ABI: the same malformed screen as push applies —
 // a corrupted file must not inject records push would reject into
-// the slashing-evidence log.
-void ag_ing_import_log(void* h, const uint8_t* buf, int64_t n) {
+// the slashing-evidence log.  Returns the number of records DROPPED
+// by the screen: a nonzero count means the snapshot is corrupt
+// (evidence silently vanishing would be worse than failing).
+int64_t ag_ing_import_log(void* h, const uint8_t* buf, int64_t n) {
   auto* L = static_cast<Loop*>(h);
   auto blk = std::make_shared<std::vector<Rec>>();
   blk->reserve(static_cast<size_t>(n));
+  int64_t dropped = 0;
   for (int64_t k = 0; k < n; ++k) {
     Rec r;
     parse_rec(buf + k * kRecSize, &r);
     r.arrival = L->arrivals++;
-    if (!rec_malformed(L, r)) blk->push_back(r);
+    if (rec_malformed(L, r))
+      ++dropped;
+    else
+      blk->push_back(r);
   }
   if (!blk->empty()) L->log.push_back(std::move(blk));
+  return dropped;
 }
 
 // restore counters: [malformed, stale_height, signature, overflow,

@@ -33,6 +33,21 @@ _TALLY_PREFIX = "tally."
 _STATS_PREFIX = "stats."
 
 
+
+def _atomic_savez(path: str, leaves: dict) -> None:
+    """Write-then-rename so a crash mid-save never clobbers the
+    previous snapshot (shared by every .npz saver here)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **leaves)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
 def save_driver(driver, path: str) -> None:
     """Snapshot a harness.DeviceDriver (device arrays + stats) to
     `path` (.npz).  One device_get for the whole tree."""
@@ -60,10 +75,7 @@ def save_driver(driver, path: str) -> None:
                                  driver.stats.steps,
                                  int(driver.advance_height),
                                  driver.stats.decisions_total], np.int64)
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **leaves)
-    os.replace(tmp, path)
+    _atomic_savez(path, leaves)
 
 
 def load_driver(path: str):
@@ -202,10 +214,7 @@ def save_batcher(bat, path: str) -> None:
             leaves["log.has_sig"] = np.concatenate(
                 [np.full(len(b), b.signature is not None)
                  for b in bat._log])
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **leaves)
-    os.replace(tmp, path)
+    _atomic_savez(path, leaves)
 
 
 def load_batcher(path: str):
@@ -260,10 +269,7 @@ def save_native_loop(loop, path: str) -> None:
     if loop._powers is not None:
         leaves["powers"] = loop._powers
     leaves.update(st)
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **leaves)
-    os.replace(tmp, path)
+    _atomic_savez(path, leaves)
 
 
 def load_native_loop(path: str, pubkeys=None, powers=None):

@@ -32,7 +32,39 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
+
+
+def _backend_hung(timeout_s: int = 240) -> bool:
+    """True iff backend init HANGS (wedged axon relay after a client
+    died mid-claim): probed in a SUBPROCESS because jax.devices()
+    blocks forever in-process — and some agnes module imports below
+    create device arrays, so even importing this file would hang.
+    A fast nonzero exit (broken jax install, etc.) is NOT a hang —
+    the caller proceeds and the real import error surfaces loudly."""
+    try:
+        # DEVNULL, not PIPE: a killed child's helper processes can hold
+        # a captured pipe open and block the post-kill drain forever
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        return False
+    except subprocess.TimeoutExpired:
+        return True
+
+
+# the guard must run BEFORE the jax/agnes imports below (they trigger
+# backend init at import time)
+if __name__ == "__main__" and _backend_hung():
+    print(json.dumps({
+        "metric": "pipeline_votes_per_sec", "value": -1,
+        "unit": "votes/sec/chip", "vs_baseline": -1,
+        "note": "backend init timed out (wedged accelerator tunnel); "
+                "no stage was run"}))
+    sys.exit(0)
 
 import jax
 
@@ -334,7 +366,6 @@ def bench_pipeline_native(n_instances: int = 1024, n_validators: int = 128,
 
 
 def main() -> None:
-    import sys
     import traceback
 
     def guarded(fn):
