@@ -313,11 +313,15 @@ class NativeIngestLoop:
         base = np.ascontiguousarray(st["base_round"], np.int64)
         hts = np.ascontiguousarray(st["heights"], np.int64)
         if base.shape != (self.I,) or hts.shape != (self.I,):
+            # load-bearing duplicate of sync_device's screen: sync runs
+            # AFTER the log import below, so its own check would fire
+            # too late to keep a failed import side-effect-free
             raise ValueError(f"base_round/heights must be [{self.I}]")
 
-        self.sync_device(base, hts)
-        L.ag_ing_import_slots(self._h, slots.ctypes.data)
         if len(log):
+            # the C side screens record CONTENT two-pass (a corrupt
+            # snapshot commits nothing); run it first so a failure
+            # leaves the loop fully untouched
             dropped = L.ag_ing_import_log(self._h, log.tobytes(),
                                           len(log))
             if dropped:
@@ -325,6 +329,8 @@ class NativeIngestLoop:
                 raise RuntimeError(
                     f"snapshot log corrupt: {dropped} record(s) failed "
                     "the malformed screen")
+        self.sync_device(base, hts)
+        L.ag_ing_import_slots(self._h, slots.ctypes.data)
         L.ag_ing_restore_counters(self._h, cnt.ctypes.data)
 
     @property

@@ -742,25 +742,29 @@ void ag_ing_export_log(void* h, uint8_t* out) {
 // verified before the snapshot, but the snapshot itself is untrusted
 // input to this raw ABI: the same malformed screen as push applies —
 // a corrupted file must not inject records push would reject into
-// the slashing-evidence log.  Returns the number of records DROPPED
-// by the screen: a nonzero count means the snapshot is corrupt
-// (evidence silently vanishing would be worse than failing).
+// the slashing-evidence log.  TWO-PASS: the screen runs over ALL
+// records first and a corrupt snapshot (nonzero return) commits
+// NOTHING — a partial evidence log masquerading as a successful
+// restore would be worse than failing.
 int64_t ag_ing_import_log(void* h, const uint8_t* buf, int64_t n) {
   auto* L = static_cast<Loop*>(h);
-  auto blk = std::make_shared<std::vector<Rec>>();
-  blk->reserve(static_cast<size_t>(n));
   int64_t dropped = 0;
   for (int64_t k = 0; k < n; ++k) {
     Rec r;
     parse_rec(buf + k * kRecSize, &r);
+    if (rec_malformed(L, r)) ++dropped;
+  }
+  if (dropped) return dropped;
+  auto blk = std::make_shared<std::vector<Rec>>();
+  blk->reserve(static_cast<size_t>(n));
+  for (int64_t k = 0; k < n; ++k) {
+    Rec r;
+    parse_rec(buf + k * kRecSize, &r);
     r.arrival = L->arrivals++;
-    if (rec_malformed(L, r))
-      ++dropped;
-    else
-      blk->push_back(r);
+    blk->push_back(r);
   }
   if (!blk->empty()) L->log.push_back(std::move(blk));
-  return dropped;
+  return 0;
 }
 
 // restore counters: [malformed, stale_height, signature, overflow,

@@ -11,6 +11,18 @@ router policies + misbehaving signers layered on honest nodes:
                 different value to every peer (double-sign; feeds the
                 slashing surface, BASELINE config 5)
   nil_flood     replaces own votes with nil votes (liveness attack)
+
+Network faults are router policies too: `partition(groups)` HOLDS
+BACK every message crossing a group boundary (the consumer's gossip
+layer retransmits once connectivity returns, so a partition delays
+rather than destroys — README.md:46-49 leaves transport to the
+consumer) and `heal()` delivers the held traffic.  A side without
++2/3 power cannot decide while split (nodes stall exactly where
+Tendermint stalls: Prevote with no PolkaAny means no timeout), and
+after heal the mixed nil/value prevotes drive PolkaAny ->
+TimeoutPrevote -> PrecommitAny -> TimeoutPrecommit -> a fresh round
+where the reunited quorum decides — the classic liveness-recovery
+scenario, no cluster required.
 """
 
 from __future__ import annotations
@@ -67,6 +79,9 @@ class Network:
             for i in range(self.n)]
         self._delivered = [0] * self.n
         self.dropped = 0
+        self._group: Optional[List[int]] = None   # node -> partition id
+        self._held_cross: List = []               # (target, msg) queue
+        self.held_partition = 0
 
     # -- fault models -------------------------------------------------------
 
@@ -89,6 +104,28 @@ class Network:
             return [dc_replace(msg, value=None, signature=sig)]
         return [msg]
 
+    # -- network faults -----------------------------------------------------
+
+    def partition(self, *groups: Sequence[int]) -> None:
+        """Split the network: messages between different groups are
+        held back until `heal()`.  Every node must appear in exactly
+        one group (sorted-set indices, like `specs`)."""
+        gmap = [-1] * self.n
+        for g, members in enumerate(groups):
+            for i in members:
+                assert gmap[i] == -1, f"node {i} in two groups"
+                gmap[i] = g
+        assert -1 not in gmap, "every node must be in a group"
+        self._group = gmap
+
+    def heal(self) -> None:
+        """Restore connectivity and deliver the held cross-partition
+        traffic (gossip retransmission)."""
+        self._group = None
+        held, self._held_cross = self._held_cross, []
+        for j, msg in held:
+            self.nodes[j].execute(msg)
+
     # -- driving ------------------------------------------------------------
 
     def start(self) -> None:
@@ -105,8 +142,14 @@ class Network:
                 progress = True
                 for out in self._outbound(i, msg):
                     for j, other in enumerate(self.nodes):
-                        if j != i:
-                            other.execute(out)
+                        if j == i:
+                            continue
+                        if (self._group is not None
+                                and self._group[i] != self._group[j]):
+                            self._held_cross.append((j, out))
+                            self.held_partition += 1
+                            continue
+                        other.execute(out)
         return progress
 
     def advance_time(self, to: float) -> None:
@@ -117,8 +160,11 @@ class Network:
     def run_until(self, pred: Callable[[], bool], max_iters: int = 500,
                   time_step: float = 5.0) -> None:
         """Route until `pred()`; when the network quiesces without
-        progress, advance the virtual clock (fires timeouts)."""
-        t = 0.0
+        progress, advance the virtual clock (fires timeouts).  The
+        clock resumes from the furthest node wheel, not 0 — a second
+        run_until must not burn its budget re-advancing through time
+        the first one already covered."""
+        t = max((n.wheel.now for n in self.nodes), default=0.0)
         for _ in range(max_iters):
             if pred():
                 return
