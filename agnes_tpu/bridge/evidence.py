@@ -1,0 +1,97 @@
+"""Join device equivocation flags to slashable signed evidence.
+
+The device tally flags double-signers as a dense [instances,
+validators] bool plane (device/tally.py `equiv` — the per-validator
+seen-record the reference's tally lacks, reference round_votes.rs:
+48-56, SURVEY §2.3 fix 2).  A flag alone proves nothing to a third
+party; the PROOF is the two conflicting signed votes, which the
+ingestion bridges retain (`VoteBatcher._log` / the C++ loop's block
+log).  This module is the production join between the two: sweep the
+flags, pull each validator's conflicting pair, and emit one record
+per (instance, validator) ready for the executor's evidence archive
+or a slashing transaction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from agnes_tpu.bridge.ingest import VoteBatcher, WireVote
+from agnes_tpu.bridge.native_ingest import REC_SIZE, NativeIngestLoop
+
+
+@dataclass(frozen=True)
+class DeviceEvidence:
+    """One device-detected double-sign with its slashable proof."""
+
+    instance: int
+    validator: int
+    first: WireVote
+    second: WireVote
+
+
+def _wire_from_record(rec: np.ndarray) -> WireVote:
+    """Packed 96-byte wire record -> WireVote (the C++ loop's evidence
+    format; layout documented at core/native/ingest.cpp top)."""
+    b = rec.tobytes()
+    value = int.from_bytes(b[24:32], "little")
+    return WireVote(
+        instance=int.from_bytes(b[0:4], "little"),
+        validator=int.from_bytes(b[4:8], "little"),
+        height=int.from_bytes(b[8:16], "little", signed=True),
+        round=int.from_bytes(b[16:20], "little", signed=True),
+        typ=b[20],
+        value=value if b[21] & 1 else None,
+        signature=b[32:96],
+    )
+
+
+def collect_device_evidence(
+    flags, bridge: Union[VoteBatcher, NativeIngestLoop],
+) -> List[DeviceEvidence]:
+    """Sweep a device equivocation plane and return the signed proofs.
+
+    `flags` is the [I, V] bool plane `DeviceDriver.tally.equiv` (the
+    driver's `equivocators_detected()` is its per-instance reduction);
+    `bridge` is whichever ingestion bridge fed the device and
+    therefore holds the retained verified votes.  Flagged
+    pairs whose conflicting votes are no longer in the bridge's log
+    (e.g. cleared after a prior extraction) are skipped — the flag
+    stays visible in metrics, but there is nothing left to prove with.
+    """
+    out: List[DeviceEvidence] = []
+    f = np.asarray(flags)
+    for inst, val in zip(*np.nonzero(f)):
+        pair = bridge.signed_evidence(int(inst), int(val))
+        if pair is None:
+            continue
+        a, b = pair
+        if isinstance(a, np.ndarray):          # native loop: raw records
+            a, b = _wire_from_record(a), _wire_from_record(b)
+        out.append(DeviceEvidence(int(inst), int(val), a, b))
+    return out
+
+
+def verify_evidence(ev: DeviceEvidence, pubkey: bytes) -> bool:
+    """Third-party check of one evidence record: both votes are by the
+    same validator for the same (height, round, class) with different
+    values, and both signatures verify under `pubkey`."""
+    from agnes_tpu.bridge.ingest import vote_messages_np
+    from agnes_tpu.crypto import host_verify
+
+    a, b = ev.first, ev.second
+    if (a.height, a.round, int(a.typ)) != (b.height, b.round, int(b.typ)):
+        return False
+    if a.value == b.value or a.signature is None or b.signature is None:
+        return False
+    for v in (a, b):
+        msg = vote_messages_np(
+            np.asarray([v.height]), np.asarray([v.round]),
+            np.asarray([int(v.typ)]),
+            np.asarray([-1 if v.value is None else v.value]))[0]
+        if not host_verify(pubkey, msg.tobytes(), v.signature):
+            return False
+    return True

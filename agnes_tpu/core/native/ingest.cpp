@@ -748,21 +748,19 @@ void ag_ing_export_log(void* h, uint8_t* out) {
 // restore would be worse than failing.
 int64_t ag_ing_import_log(void* h, const uint8_t* buf, int64_t n) {
   auto* L = static_cast<Loop*>(h);
+  auto blk = std::make_shared<std::vector<Rec>>();
+  blk->reserve(static_cast<size_t>(n));
   int64_t dropped = 0;
   for (int64_t k = 0; k < n; ++k) {
     Rec r;
     parse_rec(buf + k * kRecSize, &r);
-    if (rec_malformed(L, r)) ++dropped;
+    if (rec_malformed(L, r))
+      ++dropped;
+    else
+      blk->push_back(r);
   }
-  if (dropped) return dropped;
-  auto blk = std::make_shared<std::vector<Rec>>();
-  blk->reserve(static_cast<size_t>(n));
-  for (int64_t k = 0; k < n; ++k) {
-    Rec r;
-    parse_rec(buf + k * kRecSize, &r);
-    r.arrival = L->arrivals++;
-    blk->push_back(r);
-  }
+  if (dropped) return dropped;        // blk is local: nothing committed
+  for (Rec& r : *blk) r.arrival = L->arrivals++;
   if (!blk->empty()) L->log.push_back(std::move(blk));
   return 0;
 }

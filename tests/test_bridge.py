@@ -373,3 +373,55 @@ def test_batcher_msm_mode_matches_lane_mode():
                                       np.asarray(pb.mask))
     with pytest.raises(ValueError):
         VoteBatcher(I, V, n_slots=4, verify_mode="nope")
+
+def test_collect_device_evidence_joins_flags_to_proofs():
+    """The production join: device equivocation flags + either bridge's
+    retained log -> third-party-verifiable signed double-sign proofs."""
+    from agnes_tpu.bridge import NativeIngestLoop, pack_wire_votes
+    from agnes_tpu.bridge.evidence import (collect_device_evidence,
+                                           verify_evidence)
+    from agnes_tpu.bridge.ingest import vote_messages_np
+
+    I, V = 2, 4
+    seeds = [bytes([i + 1]) * 32 for i in range(V)]
+    pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in seeds])
+
+    def double_sign_feed(bridge, use_wire):
+        inst = np.array([0, 0, 1], np.int64)
+        val = np.array([2, 2, 1], np.int64)
+        h = np.zeros(3, np.int64)
+        rnd = np.zeros(3, np.int64)
+        typ = np.zeros(3, np.int64)
+        value = np.array([7, 9, 7], np.int64)
+        msgs = vote_messages_np(h, rnd, typ, value)
+        sigs = np.stack([np.frombuffer(
+            native.sign(seeds[val[k]], msgs[k].tobytes()), np.uint8)
+            for k in range(3)])
+        if use_wire:
+            bridge.push(pack_wire_votes(inst, val, h, rnd, typ, value,
+                                        sigs))
+            bridge.build_phases()
+        else:
+            bridge.add_arrays(inst, val, h, rnd, typ, value, sigs)
+            bridge.build_phases(pubkeys)
+
+    flags = np.zeros((I, V), bool)
+    flags[0, 2] = True          # the double-signer
+    flags[1, 1] = True          # honest: flag with single vote -> no pair
+
+    bat = VoteBatcher(I, V, n_slots=4)
+    double_sign_feed(bat, use_wire=False)
+    ev = collect_device_evidence(flags, bat)
+    assert len(ev) == 1 and (ev[0].instance, ev[0].validator) == (0, 2)
+    assert {ev[0].first.value, ev[0].second.value} == {7, 9}
+    assert verify_evidence(ev[0], native.pubkey(seeds[2]))
+    assert not verify_evidence(ev[0], native.pubkey(seeds[1]))
+
+    loop = NativeIngestLoop(I, V, n_slots=4, pubkeys=pubkeys)
+    loop.sync_device(np.zeros(I, np.int64), np.zeros(I, np.int64))
+    double_sign_feed(loop, use_wire=True)
+    ev2 = collect_device_evidence(flags, loop)
+    assert len(ev2) == 1
+    assert {ev2[0].first.value, ev2[0].second.value} == {7, 9}
+    assert verify_evidence(ev2[0], native.pubkey(seeds[2]))
