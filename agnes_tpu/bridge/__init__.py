@@ -16,11 +16,19 @@ README.md:46-49) is exactly where the TPU/host boundary goes (SURVEY.md
                   VoteBatcher (core/native/ingest.cpp) — packed wire
                   BYTES in, double-buffered dense phases out; the
                   network-facing fast lane.
+  evidence.py     the slashing join: device equivocation flags +
+                  either bridge's retained verified votes ->
+                  third-party-verifiable signed double-sign proofs.
 
 The device side of the ABI is device/step.py's VotePhase/ExtEvent and
 the validator table from ValidatorSet.device_arrays().
 """
 
+from agnes_tpu.bridge.evidence import (  # noqa: F401
+    DeviceEvidence,
+    collect_device_evidence,
+    verify_evidence,
+)
 from agnes_tpu.bridge.ingest import VoteBatcher, WireVote  # noqa: F401
 from agnes_tpu.bridge.native_ingest import (  # noqa: F401
     NativeIngestLoop,

@@ -15,12 +15,12 @@ or a slashing transaction.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Tuple, Union
+from typing import List, Union
 
 import numpy as np
 
 from agnes_tpu.bridge.ingest import VoteBatcher, WireVote
-from agnes_tpu.bridge.native_ingest import REC_SIZE, NativeIngestLoop
+from agnes_tpu.bridge.native_ingest import NativeIngestLoop
 
 
 @dataclass(frozen=True)
@@ -71,6 +71,12 @@ def collect_device_evidence(
         a, b = pair
         if isinstance(a, np.ndarray):          # native loop: raw records
             a, b = _wire_from_record(a), _wire_from_record(b)
+        if a.signature is None or b.signature is None:
+            # votes ingested without signatures (unverified path)
+            # conflict but prove nothing to a third party — emitting
+            # them as "signed proofs" would ship evidence every
+            # checker rejects
+            continue
         out.append(DeviceEvidence(int(inst), int(val), a, b))
     return out
 

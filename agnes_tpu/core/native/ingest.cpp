@@ -742,10 +742,10 @@ void ag_ing_export_log(void* h, uint8_t* out) {
 // verified before the snapshot, but the snapshot itself is untrusted
 // input to this raw ABI: the same malformed screen as push applies —
 // a corrupted file must not inject records push would reject into
-// the slashing-evidence log.  TWO-PASS: the screen runs over ALL
-// records first and a corrupt snapshot (nonzero return) commits
-// NOTHING — a partial evidence log masquerading as a successful
-// restore would be worse than failing.
+// the slashing-evidence log.  ALL-OR-NOTHING: records are screened
+// while parsing into a LOCAL staging block, and a corrupt snapshot
+// (nonzero return) commits nothing — a partial evidence log
+// masquerading as a successful restore would be worse than failing.
 int64_t ag_ing_import_log(void* h, const uint8_t* buf, int64_t n) {
   auto* L = static_cast<Loop*>(h);
   auto blk = std::make_shared<std::vector<Rec>>();

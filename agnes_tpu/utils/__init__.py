@@ -15,8 +15,14 @@ reference, present here):
 """
 
 from agnes_tpu.utils.checkpoint import (  # noqa: F401
+    load_batcher,
     load_driver,
+    load_executor_into,
+    load_native_loop,
+    save_batcher,
     save_driver,
+    save_executor,
+    save_native_loop,
 )
 from agnes_tpu.utils.config import RunConfig  # noqa: F401
 from agnes_tpu.utils.metrics import Metrics  # noqa: F401

@@ -425,3 +425,16 @@ def test_collect_device_evidence_joins_flags_to_proofs():
     assert len(ev2) == 1
     assert {ev2[0].first.value, ev2[0].second.value} == {7, 9}
     assert verify_evidence(ev2[0], native.pubkey(seeds[2]))
+
+def test_collect_device_evidence_skips_unsigned_pairs():
+    """Conflicting votes ingested WITHOUT signatures prove nothing to
+    a third party — they must not be packaged as 'signed proofs'."""
+    from agnes_tpu.bridge.evidence import collect_device_evidence
+
+    b = VoteBatcher(1, 4, n_slots=4)
+    b.add(WireVote(0, 2, 0, 0, VoteType.PREVOTE, 7))   # no signature
+    b.add(WireVote(0, 2, 0, 0, VoteType.PREVOTE, 9))
+    b.build_phases()                                    # unverified path
+    flags = np.zeros((1, 4), bool)
+    flags[0, 2] = True
+    assert collect_device_evidence(flags, b) == []
