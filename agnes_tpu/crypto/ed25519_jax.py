@@ -1,7 +1,7 @@
 """Batched Ed25519 verification in JAX — the north-star data plane.
 
-Verifies [S]B == R + [k]A (equivalently Q := [S]B + [k](-A) == R) for a
-whole batch of signatures at once:
+Verifies [8]([S]B - [k]A) == [8]R (cofactored; Q := [S]B + [k](-A))
+for a whole batch of signatures at once:
 
   - curve arithmetic on `field_jax` 13-bit int32 limbs, extended
     twisted-Edwards coordinates with the complete unified addition law
@@ -13,12 +13,13 @@ whole batch of signatures at once:
     fine under the complete law, keeping the select branch-free);
   - k = SHA-512(R || A || M) via `sha512_jax`, reduced by
     `scalar_jax.barrett_reduce`;
-  - R is never decompressed: Q is compressed and byte-compared against
-    the signature's R, which also enforces canonical R encoding.
+  - R decompresses under the same canonical rules as A; the equality
+    is projective after three doublings of each side (no inversion).
 
-Checks applied per RFC 8032 §5.1.7: A decodes to a curve point,
-S < L, and the (cofactorless) group equation.  Oracle:
-`ed25519_ref.verify`, pinned to the RFC vectors.
+Checks applied per RFC 8032 §5.1.7: A and R decode to curve points,
+S < L, and the COFACTORED group equation [8]([S]B - [k]A) == [8]R
+(the framework-wide policy; rationale in ed25519_ref.verify).
+Oracle: `ed25519_ref.verify`, pinned to the RFC vectors.
 
 The reference engine verifies nothing (vote identity/signatures are
 "notably absent", SURVEY.md §2.1); this kernel is the added surface
