@@ -11,7 +11,7 @@ philosophy to both planes:
                     fabricates dense vote phases, routes the step's own
                     output votes back in, reads decisions off the
                     message stream.
-  configs.py        the five BASELINE.json benchmark configs, runnable
+  configs.py        the five BASELINE.json benchmark configs (+ a partition/heal liveness drill), runnable
                     as `python -m agnes_tpu.harness.configs N`.
 """
 

@@ -1,4 +1,4 @@
-"""Harness: Byzantine network simulations + the five configs (small)."""
+"""Harness: Byzantine network simulations + the six configs (small)."""
 
 import numpy as np
 import pytest
@@ -72,10 +72,11 @@ def test_device_driver_equivocation_detection():
     assert d.all_decided(value=1)
 
 
-@pytest.mark.parametrize("n", [1, 2, 3, 4, 5])
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 6])
 def test_configs_small(n):
     out = CONFIGS[n](small=True)
     assert out["config"] == n
+
 
 def test_partition_stalls_then_heals_to_decision():
     """The liveness-recovery scenario: a 2-2 partition of 4 honest
