@@ -205,22 +205,7 @@ def config6_partition_liveness(small: bool = False) -> dict:
     t0 = time.perf_counter()
     net = Network(n=4)
     net.start()
-    net.partition([0, 1], [2, 3])
-    stalled = False
-    try:
-        net.run_until(lambda: net.decided(0), max_iters=30)
-    except AssertionError as e:
-        # only run_until's exhaustion counts as the expected stall; a
-        # consensus-invariant assert must surface, not read as success
-        assert "predicate" in str(e), e
-        stalled = True
-    assert stalled and not any(0 in n.decided for n in net.nodes)
-    net.heal()
-    net.run_until(lambda: net.decided(0))
-    assert len(set(net.decisions(0))) == 1
-    heal_round = min(n.decided[0].round for n in net.nodes)
-    # the stall was real iff nobody could have decided at round 0
-    assert heal_round >= 1, heal_round
+    heal_round = net.partition_heal_drill([0, 1], [2, 3])
 
     # majority side must keep +2/3: 4-1 at small, 5-2 at full
     n2, n_min = (5, 1) if small else (7, 2)

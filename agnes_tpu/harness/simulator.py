@@ -126,6 +126,31 @@ class Network:
         for j, msg in held:
             self.nodes[j].execute(msg)
 
+    def partition_heal_drill(self, *groups: Sequence[int],
+                             stall_iters: int = 30) -> int:
+        """The canonical quorum-less-split liveness drill (shared by
+        config 6 and the harness tests): partition into `groups` (none
+        with +2/3 power), prove nobody decides the current height
+        (only run_until's exhaustion counts as the stall — any other
+        assert surfaces), heal, converge, and return the earliest
+        decision round — asserted >= 1, since a real stall means the
+        round-0 quorum never assembled."""
+        h = min(n.height for n in self.nodes)
+        self.partition(*groups)
+        stalled = False
+        try:
+            self.run_until(lambda: self.decided(h), max_iters=stall_iters)
+        except AssertionError as e:
+            assert "predicate" in str(e), e
+            stalled = True
+        assert stalled and not any(h in n.decided for n in self.nodes)
+        self.heal()
+        self.run_until(lambda: self.decided(h))
+        assert len(set(self.decisions(h))) == 1
+        heal_round = min(n.decided[h].round for n in self.nodes)
+        assert heal_round >= 1, heal_round
+        return int(heal_round)
+
     # -- driving ------------------------------------------------------------
 
     def start(self) -> None:

@@ -251,3 +251,30 @@ def test_exactly_one_timeout_precommit_per_round():
         state, tally, msgs = _step(state, tally, proposer=False)
         n_tp2 += count(msgs)
     assert (n_tp2 == 1).all(), n_tp2
+
+
+def test_device_plane_bitwise_deterministic():
+    """SURVEY §5 race-detection slot: the device plane is functionally
+    updated, so the same phase stream must produce BITWISE-identical
+    state/tally across independent runs (determinism is the purity
+    invariant's observable)."""
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    def run():
+        d = DeviceDriver(8, 16, advance_height=True)
+        d.run_heights(2)
+        d.run_nil_round(int(np.asarray(d.state.round)[0]))
+        return d
+
+    a, b = run(), run()
+    for name in a.state._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.state, name)),
+            np.asarray(getattr(b.state, name)), err_msg=f"state.{name}")
+    for name in a.tally._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.tally, name)),
+            np.asarray(getattr(b.tally, name)), err_msg=f"tally.{name}")
+    np.testing.assert_array_equal(a.stats.decided, b.stats.decided)
+    np.testing.assert_array_equal(a.stats.decision_value,
+                                  b.stats.decision_value)
