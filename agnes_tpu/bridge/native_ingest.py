@@ -160,6 +160,10 @@ class NativeIngestLoop:
             L.ag_ing_set_held_cap(self._h, int(held_cap))
         # read back the enforced cap — the C side owns the default
         self.held_cap = int(L.ag_ing_get_held_cap(self._h))
+        # freshness for import_state: ANY interaction (push/sync/build/
+        # clear_log) makes the loop non-restorable — the evidence log
+        # alone is a weak proxy (pushed-but-unbuilt votes leave it empty)
+        self._used = False
 
     def __del__(self):
         if getattr(self, "_h", None):
@@ -178,12 +182,14 @@ class NativeIngestLoop:
                 f"{base.shape}/{hts.shape}")
         self._heights = hts
         self._base_round = base
+        self._used = True
         _lib().ag_ing_sync(self._h, base.ctypes.data, hts.ctypes.data)
 
     def push(self, wire_bytes: bytes) -> int:
         """Packed wire records in; returns lanes accepted (held counts
         as accepted; rejects show up in `counters`)."""
         n = len(wire_bytes) // REC_SIZE
+        self._used = True
         return _lib().ag_ing_push(self._h, wire_bytes, n)
 
     def build_phases(self) -> List[Tuple[VotePhase, int]]:
@@ -191,6 +197,7 @@ class NativeIngestLoop:
         [(phase, n_votes)] like VoteBatcher.build_phases; the phase
         arrays are zero-copy views into the C++ double buffer."""
         L = _lib()
+        self._used = True
         n = L.ag_ing_stage(self._h)
         if n == 0:
             ok = None
@@ -266,6 +273,7 @@ class NativeIngestLoop:
         return raw[:REC_SIZE].copy(), raw[REC_SIZE:].copy()
 
     def clear_log(self) -> None:
+        self._used = True
         _lib().ag_ing_clear_log(self._h)
 
     # -- snapshot surface (utils.checkpoint.save/load_native_loop) ----------
@@ -298,6 +306,17 @@ class NativeIngestLoop:
 
     def import_state(self, st: dict) -> None:
         L = _lib()
+        # snapshots restore only into a FRESH loop: merging into live
+        # state would mix pre-restore votes/evidence with the
+        # snapshot's slots/window/counters.  `_used` trips on ANY
+        # interaction (push/sync/build/clear_log) — the log-emptiness
+        # check alone would miss pushed-but-unbuilt votes; the C-side
+        # log guard (ingest.cpp ag_ing_import_log) stays as defense in
+        # depth for direct ABI users.
+        if self._used:
+            raise RuntimeError(
+                "import_state: loop has already been used (push/sync/"
+                "build); snapshots restore only into a fresh loop")
         # validate EVERY leaf before mutating anything: a malformed
         # snapshot must not leave a half-imported loop behind
         slots = np.ascontiguousarray(st["slots"], np.int64)
@@ -325,10 +344,13 @@ class NativeIngestLoop:
             dropped = L.ag_ing_import_log(self._h, log.tobytes(),
                                           len(log))
             if dropped:
-                # evidence silently vanishing is worse than failing
+                # >0: records failed the malformed screen; -1: C-side
+                # fresh-only refusal (unreachable via this method — the
+                # _used guard above fires first; the -1 exists for
+                # direct ABI users of ag_ing_import_log)
                 raise RuntimeError(
-                    f"snapshot log corrupt: {dropped} record(s) failed "
-                    "the malformed screen")
+                    f"snapshot log rejected (code {dropped}): corrupt "
+                    "records or non-fresh loop; nothing was imported")
         self.sync_device(base, hts)
         L.ag_ing_import_slots(self._h, slots.ctypes.data)
         L.ag_ing_restore_counters(self._h, cnt.ctypes.data)

@@ -746,8 +746,12 @@ void ag_ing_export_log(void* h, uint8_t* out) {
 // while parsing into a LOCAL staging block, and a corrupt snapshot
 // (nonzero return) commits nothing — a partial evidence log
 // masquerading as a successful restore would be worse than failing.
+// FRESH-ONLY: the import targets a freshly constructed loop; merging a
+// snapshot's log into live evidence would duplicate records and skew
+// every log counter, so a non-empty log is rejected outright (-1).
 int64_t ag_ing_import_log(void* h, const uint8_t* buf, int64_t n) {
   auto* L = static_cast<Loop*>(h);
+  if (!L->log.empty()) return -1;     // refuse to merge with live state
   auto blk = std::make_shared<std::vector<Rec>>();
   blk->reserve(static_cast<size_t>(n));
   int64_t dropped = 0;

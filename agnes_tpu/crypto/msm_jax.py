@@ -43,6 +43,7 @@ SURVEY.md §2.1; signing stubbed at reference consensus_executor.rs:
 
 from __future__ import annotations
 
+import secrets
 from typing import Optional, Tuple
 
 import jax
@@ -207,16 +208,23 @@ def scalar_mul_base(c_limbs: jnp.ndarray) -> E.Point:
 
 def make_z(batch: int, seed: Optional[int] = None) -> jnp.ndarray:
     """[B, Z_LIMBS] random 128-bit coefficients.  Drawn host-side per
-    call (numpy CSPRNG-adjacent; unpredictable to the vote senders,
-    which is all the batch argument needs).  A fixed seed is for
-    tests only.
+    call from OS entropy (`secrets.token_bytes`), so the 2⁻¹²⁸
+    soundness bound of the random-linear-combination check rests only
+    on the CSPRNG, not on PCG64 indistinguishability.  A fixed seed
+    (tests only) switches to a deterministic numpy stream.
 
     Vectorized repack: a 13-bit limb spans at most two adjacent
     16-bit words, so limb i is a shift of the 32-bit window at word
     (13i)//16 — no per-element Python on the verify hot path."""
-    rng = np.random.default_rng(seed)
-    words = rng.integers(0, 1 << 16, size=(batch, 9), dtype=np.int64)
-    words[:, 8] = 0                      # zero pad word for the window
+    if seed is None:
+        raw = np.frombuffer(secrets.token_bytes(batch * 16), dtype="<u2")
+        words16 = raw.reshape(batch, 8).astype(np.int64)
+    else:
+        rng = np.random.default_rng(seed)
+        words16 = rng.integers(0, 1 << 16, size=(batch, 8), dtype=np.int64)
+    # zero pad word for the 32-bit window at the top limb
+    words = np.concatenate(
+        [words16, np.zeros((batch, 1), dtype=np.int64)], axis=1)
     idx = np.arange(Z_LIMBS)
     wi, off = (BITS * idx) // 16, (BITS * idx) % 16
     win = words[:, wi] | (words[:, wi + 1] << 16)

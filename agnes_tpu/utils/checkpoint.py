@@ -248,13 +248,21 @@ def load_batcher(path: str):
             if "log.signature" not in z.files:
                 bat._log = [_Batch(*cols, None)]
             else:
+                # Rebuild preserving the ARRIVAL interleaving: split the
+                # concatenated rows into maximal runs of constant
+                # signedness (the original batch boundaries are gone, but
+                # run order == arrival order), so signed_evidence() scans
+                # rows in the same order before and after a restore and
+                # extracts the same conflicting pair.
                 has = z["log.has_sig"]
                 sig = z["log.signature"]
+                cuts = np.flatnonzero(np.diff(has.astype(np.int8)))
+                bounds = np.concatenate(([0], cuts + 1, [len(has)]))
                 bat._log = [
-                    _Batch(*(c[sel] for c in cols),
-                           sig[sel] if signed else None)
-                    for signed, sel in ((True, has), (False, ~has))
-                    if sel.any()]
+                    _Batch(*(c[lo:hi] for c in cols),
+                           sig[lo:hi] if has[lo] else None)
+                    for lo, hi in zip(bounds[:-1], bounds[1:])
+                    if hi > lo]
     return bat
 
 

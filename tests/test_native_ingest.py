@@ -485,7 +485,58 @@ def test_native_loop_checkpoint_powers_heldcap_and_stale_slots(tmp_path):
     assert fresh.drain_host_events() == [(0, 1, 0, 5)]
 
     # corrupt snapshot: flat log leaf must be rejected, not OOB-read
+    # (target must be fresh — a live loop is refused before the shape
+    # screen even runs, see test_import_state_requires_fresh_loop)
     st = fresh.export_state()
     st["log"] = np.zeros(96 * 3, np.uint8)       # wrong shape
+    blank = NativeIngestLoop(I, V, n_slots=4, powers=powers)
     with pytest.raises(ValueError):
-        fresh.import_state(st)
+        blank.import_state(st)
+
+
+def test_import_state_requires_fresh_loop():
+    """import_state must refuse a loop that already holds verified
+    votes: merging a snapshot's evidence log into live state would
+    duplicate records and inflate every log counter."""
+    loop = NativeIngestLoop(1, 4, n_slots=4)
+    loop.sync_device(np.zeros(1, np.int64), np.zeros(1, np.int64))
+    loop.push(pack_wire_votes(np.array([0]), np.array([1]),
+                              np.array([0]), np.array([0]),
+                              np.array([PV]), np.array([7])))
+    loop.build_phases()
+    st = loop.export_state()
+    assert loop.counters["log"] == 1
+    with pytest.raises(RuntimeError, match="fresh"):
+        loop.import_state(st)
+    # the refused import must leave live state untouched
+    assert loop.counters["log"] == 1
+
+
+def test_import_state_refuses_even_empty_snapshot_log():
+    """The fresh-loop guard must not depend on the SNAPSHOT's log being
+    non-empty: importing a fresh loop's (empty-log) snapshot into a
+    live loop would merge states just as silently."""
+    fresh = NativeIngestLoop(1, 4, n_slots=4)
+    st = fresh.export_state()                  # empty log snapshot
+    live = NativeIngestLoop(1, 4, n_slots=4)
+    live.sync_device(np.zeros(1, np.int64), np.zeros(1, np.int64))
+    live.push(pack_wire_votes(np.array([0]), np.array([1]),
+                              np.array([0]), np.array([0]),
+                              np.array([PV]), np.array([7])))
+    live.build_phases()
+    with pytest.raises(RuntimeError, match="fresh"):
+        live.import_state(st)
+    assert live.counters["log"] == 1
+
+
+def test_import_state_refuses_pushed_unbuilt_loop():
+    """The freshness guard must trip on ANY prior interaction, not just
+    a non-empty evidence log: pushed-but-unbuilt votes leave the log
+    empty but would merge into the restored state at the next build."""
+    live = NativeIngestLoop(1, 4, n_slots=4)
+    live.push(pack_wire_votes(np.array([0]), np.array([1]),
+                              np.array([0]), np.array([0]),
+                              np.array([PV]), np.array([7])))
+    st = NativeIngestLoop(1, 4, n_slots=4).export_state()
+    with pytest.raises(RuntimeError, match="fresh"):
+        live.import_state(st)

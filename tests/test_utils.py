@@ -252,3 +252,55 @@ def test_batcher_checkpoint_mixed_signed_unsigned_log():
     assert ev is not None
     a, b = ev
     assert a.signature is None and b.signature is None   # not zeros
+
+
+def test_batcher_restore_preserves_log_interleaving(tmp_path):
+    """Evidence extraction must be restore-stable: load_batcher keeps
+    the log's arrival interleaving (unsigned/signed/unsigned runs), so
+    signed_evidence scans rows in the same order before and after a
+    restart and extracts the SAME conflicting pair."""
+    import numpy as np
+
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.utils.checkpoint import load_batcher, save_batcher
+
+    bat = VoteBatcher(1, 4, n_slots=4)
+    # three ticks, validator 2 equivocating across them; the middle
+    # tick carries a signature column, the outer two do not
+    bat.add_arrays([0], [2], [0], [0], [0], [7])
+    bat.build_phases()
+    bat.add_arrays([0], [2], [0], [0], [0], [9],
+                   np.ones((1, 64), np.uint8))
+    bat.build_phases()
+    bat.add_arrays([0], [2], [0], [0], [0], [5])
+    bat.build_phases()
+
+    before = bat.signed_evidence(0, 2)
+    order_before = [int(v) for b in bat._log for v in b.value]
+
+    p = str(tmp_path / "bat.npz")
+    save_batcher(bat, p)
+    fresh = load_batcher(p)
+
+    order_after = [int(v) for b in fresh._log for v in b.value]
+    assert order_after == order_before          # arrival order preserved
+    after = fresh.signed_evidence(0, 2)
+    assert before is not None and after is not None
+    assert ([(w.value, w.signature) for w in after]
+            == [(w.value, w.signature) for w in before])
+
+
+def test_make_z_is_fresh_os_entropy():
+    """Batch-verification coefficients must come from OS entropy when
+    unseeded (soundness rests on the CSPRNG, not PCG64) and stay
+    deterministic when seeded (tests only)."""
+    import numpy as np
+
+    from agnes_tpu.crypto import msm_jax as M
+
+    a, b = np.asarray(M.make_z(4)), np.asarray(M.make_z(4))
+    assert a.shape == b.shape == (4, M.Z_LIMBS)
+    assert (a >= 0).all() and (a <= M.F.LMASK).all()
+    assert not np.array_equal(a, b)             # fresh entropy per call
+    np.testing.assert_array_equal(np.asarray(M.make_z(4, seed=1)),
+                                  np.asarray(M.make_z(4, seed=1)))
