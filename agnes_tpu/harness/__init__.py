@@ -13,7 +13,15 @@ philosophy to both planes:
                     message stream.
   configs.py        the five BASELINE.json benchmark configs (+ a partition/heal liveness drill), runnable
                     as `python -m agnes_tpu.harness.configs N`.
+  replay.py         cross-plane differential: tap a Network's nodes,
+                    replay each node's exact processing stream through
+                    the bridge + fused device step, compare decisions.
 """
 
 from agnes_tpu.harness.simulator import Network, NodeSpec  # noqa: F401
 from agnes_tpu.harness.device_driver import DeviceDriver  # noqa: F401
+from agnes_tpu.harness.replay import (  # noqa: F401
+    ReplayResult,
+    replay_trace,
+    trace_network,
+)

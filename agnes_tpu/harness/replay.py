@@ -1,0 +1,198 @@
+"""Cross-plane differential replay: host-plane traffic into the device plane.
+
+The framework has two full consensus planes over one state machine:
+
+  host plane    harness.Network routing N `ConsensusExecutor`s
+                (core/executor.py — the completed consensus_executor.rs
+                driver, with re-entrant execute and a TimerWheel);
+  device plane  bridge.VoteBatcher densifying wire votes into phases
+                for the fused device step (device/step.py), with the
+                batcher's host fallback covering past-window rounds.
+
+Each plane is pinned to the shared Python oracle by its own suite, but
+the planes do NOT share tally/event *ordering* (device re-query cursor,
+device/step.py stages 3-4, vs the executor's `_requery`,
+core/executor.py) — exactly where an ordering divergence would hide.
+This module closes that gap with a replay differential:
+
+  1. `trace_network` taps every node's `execute` — because the executor
+     is re-entrant (self-produced proposals/votes and fired timeouts
+     all loop back through `execute`, the reference's
+     consensus_executor.rs:36,:40 intent), the tap captures the node's
+     COMPLETE processing stream in exact order: peer deliveries,
+     self-deliveries, timeouts.
+  2. `replay_trace` replays one node's stream through the production
+     device path — VoteBatcher (layering/dedup/slot interning/window
+     hold-back/host fallback) feeding the fused device step — and
+     reports what the device plane decided.
+
+Identical decisions per (node, height) across planes is the invariant
+the reference's testability argument (README.md:8-14) demands once two
+implementations of the executor loop exist.  tests/test_cross_plane.py
+fuzzes this over seeded Byzantine schedules (honest/silent/
+equivocator/nil-flood mixes, partition/heal).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from agnes_tpu.core.executor import WireProposal, WireTimeout
+from agnes_tpu.core.state_machine import EventTag, TimeoutStep
+from agnes_tpu.types import Vote
+
+_TIMEOUT_TAG = {
+    TimeoutStep.PROPOSE: int(EventTag.TIMEOUT_PROPOSE),
+    TimeoutStep.PREVOTE: int(EventTag.TIMEOUT_PREVOTE),
+    TimeoutStep.PRECOMMIT: int(EventTag.TIMEOUT_PRECOMMIT),
+}
+
+
+def trace_network(net) -> List[List[object]]:
+    """Install a processing-order tap on every node of a
+    harness.Network (before `net.start()`).  Returns one list per node;
+    each fills with the wire messages that node processes, in exact
+    order (including re-entrant self-deliveries and timeout firings)."""
+    traces: List[List[object]] = [[] for _ in net.nodes]
+
+    def _wrap(node, rec):
+        orig = node.execute
+
+        def tapped(msg):
+            rec.append(msg)
+            orig(msg)
+
+        node.execute = tapped
+
+    for node, rec in zip(net.nodes, traces):
+        _wrap(node, rec)
+    return traces
+
+
+@dataclass
+class ReplayResult:
+    """Device-plane outcome of replaying one node's stream."""
+
+    decided: bool = False
+    value: Optional[int] = None          # decoded value id
+    round: Optional[int] = None
+    equivocators: Set[int] = field(default_factory=set)
+    steps: int = 0
+    host_fallback_decisions: int = 0     # decided via PRECOMMIT_VALUE ext
+
+
+def replay_trace(trace: List[object], n_validators: int,
+                 powers: Optional[np.ndarray] = None,
+                 n_rounds: int = 4, n_slots: int = 4) -> ReplayResult:
+    """Replay one node's processed-message stream through the
+    bridge + fused-device pipeline (the production device plane) and
+    return the height-0 outcome.
+
+    The device instance is built as a NON-proposer: the node's own
+    proposal arrives in the trace as a re-entrant WireProposal and is
+    injected as a PROPOSAL ext event, its own votes ride the dense
+    phases like peer votes (device/step.py module docstring), and
+    timeouts fire exactly where the host TimerWheel fired them."""
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    d = DeviceDriver(1, n_validators, n_rounds=n_rounds, n_slots=n_slots,
+                     proposer_is_self=False, advance_height=True)
+    if powers is not None:
+        import jax.numpy as jnp
+        from agnes_tpu.device.encoding import I32
+        d.powers = jnp.asarray(powers, I32)
+        d.total = jnp.asarray(int(np.sum(powers)), I32)
+    bat = VoteBatcher(1, n_validators, n_slots=n_slots, n_rounds=n_rounds,
+                      powers=powers)
+    res = ReplayResult()
+
+    def height() -> int:
+        return int(np.asarray(d.state.height)[0])
+
+    def after_step() -> None:
+        res.steps += 1
+        if res.decided or not bool(d.stats.decided[0]):
+            return
+        # decode NOW: the next sync_device resets the slot maps for the
+        # advanced height.  Slot-space decisions decode through the
+        # batcher; host-fallback decisions carry the raw 31-bit value
+        # id in the lane (drain_host_events docstring) — value ids are
+        # content-derived/harness ints >= n_slots, so the ranges are
+        # disjoint.
+        dv = int(d.stats.decision_value[0])
+        res.decided = True
+        res.round = int(d.stats.decision_round[0])
+        res.value = bat.decode_slot(0, dv) if 0 <= dv < n_slots else dv
+
+    def step(ext=None, phase=None) -> None:
+        d.step(ext=ext, phase=phase)
+        after_step()
+
+    def sync() -> None:
+        bat.sync_device(np.asarray(d.tally.base_round),
+                        np.asarray(d.state.height))
+
+    def drain() -> None:
+        for inst, hgt, rnd, vid in bat.drain_host_events():
+            if hgt == height():   # commit-from-any-round, still live
+                # the decode in after_step tells slots from value ids by
+                # range — enforce the disjointness it relies on
+                assert vid >= n_slots, (
+                    f"value id {vid} collides with the slot range "
+                    f"[0, {n_slots}); use larger value ids")
+                was_decided = res.decided
+                step(ext=d.ext(int(EventTag.PRECOMMIT_VALUE), rnd, vid))
+                if res.decided and not was_decided:
+                    res.host_fallback_decisions += 1
+
+    def flush(chunk: List[Vote]) -> None:
+        if not chunk:
+            return
+        sync()
+        bat.add_arrays(
+            np.zeros(len(chunk), np.int64),
+            np.asarray([v.validator for v in chunk], np.int64),
+            np.asarray([v.height for v in chunk], np.int64),
+            np.asarray([v.round for v in chunk], np.int64),
+            np.asarray([int(v.typ) for v in chunk], np.int64),
+            np.asarray([-1 if v.value is None else v.value for v in chunk],
+                       np.int64))
+        for phase, _ in bat.build_phases():
+            step(phase=phase)
+        drain()
+
+    step()                       # round-0 entry, like the host start()
+    chunk: List[Vote] = []
+    for msg in trace:
+        if isinstance(msg, Vote):
+            if chunk and (msg.round != chunk[-1].round
+                          or msg.typ != chunk[-1].typ
+                          or msg.height != chunk[-1].height):
+                flush(chunk)
+                chunk = []
+            chunk.append(msg)
+            continue
+        flush(chunk)
+        chunk = []
+        if isinstance(msg, WireProposal):
+            if msg.height != height():
+                continue          # same screen as executor._on_proposal
+            sync()
+            slot = bat.slots.slot_for(0, msg.value)
+            if slot is None:      # slot overflow: host-fallback territory
+                continue
+            step(ext=d.ext(int(EventTag.PROPOSAL), msg.round, slot,
+                           msg.pol_round))
+        elif isinstance(msg, WireTimeout):
+            if msg.height != height():
+                continue          # same screen as executor._on_timeout
+            step(ext=d.ext(_TIMEOUT_TAG[msg.step], msg.round))
+    flush(chunk)
+
+    res.equivocators = {int(v) for v in
+                        np.nonzero(np.asarray(d.tally.equiv)[0])[0]}
+    return res
