@@ -57,9 +57,19 @@ class DeviceDriver:
     def __init__(self, n_instances: int, n_validators: int,
                  n_rounds: int = 4, n_slots: int = 4,
                  proposer_is_self: bool = True,
-                 advance_height: bool = False):
+                 advance_height: bool = False,
+                 mesh=None):
+        """With `mesh` (flat data x val or hierarchical
+        slice x data x val, parallel/mesh.py) the closed loop runs the
+        shard_map-sharded step with every argument placed per the
+        parallel/sharded.py layout — the multi-chip driver, same API."""
         self.I, self.V = n_instances, n_validators
         self.advance_height = advance_height
+        self.mesh = mesh
+        if mesh is not None:
+            from agnes_tpu.parallel import make_sharded_step
+            self._sharded_step = make_sharded_step(
+                mesh, advance_height=advance_height)
         self.cfg = TallyConfig(n_validators=n_validators, n_rounds=n_rounds,
                                n_slots=n_slots)
         self.state = DeviceState.new((self.I,))
@@ -131,10 +141,17 @@ class DeviceDriver:
         """One fused step; returns the stacked DeviceMessage batch."""
         ext = ext if ext is not None else self.ext()
         phase = phase if phase is not None else self.empty_phase()
-        out = consensus_step_jit(self.state, self.tally, ext, phase,
-                                 self.powers, self.total,
-                                 self.proposer_flag, self.propose_value,
-                                 advance_height=self.advance_height)
+        if self.mesh is not None:
+            from agnes_tpu.parallel import shard_step_args
+            out = self._sharded_step(*shard_step_args(
+                self.mesh, self.state, self.tally, ext, phase,
+                self.powers, self.total, self.proposer_flag,
+                self.propose_value))
+        else:
+            out = consensus_step_jit(self.state, self.tally, ext, phase,
+                                     self.powers, self.total,
+                                     self.proposer_flag, self.propose_value,
+                                     advance_height=self.advance_height)
         self.state, self.tally = out.state, out.tally
         self.stats.steps += 1
         self.stats.votes_ingested += int(np.asarray(phase.mask).sum())

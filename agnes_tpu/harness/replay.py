@@ -149,10 +149,26 @@ def replay_trace(trace: List[object], n_validators: int,
                 if res.decided and not was_decided:
                     res.host_fallback_decisions += 1
 
+    def pump() -> None:
+        """Sync the batcher to the device window and feed until quiet.
+        Looping matters: feeding a phase can advance the device round,
+        and the NEXT sync may release votes the batcher held back as
+        future-window — without the loop (or after window-moving ext
+        steps / at end of trace) held votes the host tallied would
+        silently never reach the device."""
+        while True:
+            sync()
+            phases = bat.build_phases()
+            if not phases:
+                drain()
+                return
+            for phase, _ in phases:
+                step(phase=phase)
+            drain()
+
     def flush(chunk: List[Vote]) -> None:
         if not chunk:
             return
-        sync()
         bat.add_arrays(
             np.zeros(len(chunk), np.int64),
             np.asarray([v.validator for v in chunk], np.int64),
@@ -161,9 +177,7 @@ def replay_trace(trace: List[object], n_validators: int,
             np.asarray([int(v.typ) for v in chunk], np.int64),
             np.asarray([-1 if v.value is None else v.value for v in chunk],
                        np.int64))
-        for phase, _ in bat.build_phases():
-            step(phase=phase)
-        drain()
+        pump()
 
     step()                       # round-0 entry, like the host start()
     chunk: List[Vote] = []
@@ -191,7 +205,9 @@ def replay_trace(trace: List[object], n_validators: int,
             if msg.height != height():
                 continue          # same screen as executor._on_timeout
             step(ext=d.ext(_TIMEOUT_TAG[msg.step], msg.round))
+            pump()                # timeouts move the window: release holds
     flush(chunk)
+    pump()                        # end of trace: release remaining holds
 
     res.equivocators = {int(v) for v in
                         np.nonzero(np.asarray(d.tally.equiv)[0])[0]}

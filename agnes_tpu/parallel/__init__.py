@@ -8,11 +8,19 @@ parallel, sharded as data parallelism) and *validators* (the tally /
 signature axis — sharded as tensor parallelism whose quorum reductions
 are `psum`s over the mesh axis, riding ICI intra-slice and DCN across
 slices).
+
+Multi-slice is first-class: `make_hierarchical_mesh` builds a
+(slice, data, val) mesh whose outer axis models the DCN boundary —
+instances shard across slices (no collectives cross it, ever), quorum
+psums stay on the intra-slice val axis.  The sharded step detects the
+slice axis and widens its instance-dimension specs automatically.
 """
 
 from agnes_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
+    SLICE_AXIS,
     VAL_AXIS,
+    make_hierarchical_mesh,
     make_mesh,
 )
 from agnes_tpu.parallel.sharded import (  # noqa: F401

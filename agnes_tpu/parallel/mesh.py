@@ -1,14 +1,25 @@
 """Device mesh construction for the consensus data plane.
 
 Axes:
-  * ``data`` — instance axis: independent consensus instances, no
-    cross-talk, pure data parallelism.
+  * ``slice`` — (hierarchical meshes only) the multi-slice axis: one
+    shard per TPU slice/host-group, connected by DCN.  Carries ONLY
+    instance data parallelism — nothing in the step communicates over
+    it, so slice-to-slice bandwidth never gates throughput.
+  * ``data`` — instance axis within a slice: independent consensus
+    instances, no cross-talk, pure data parallelism.
   * ``val``  — validator axis: the vote tally's reduction axis; partial
     tallies are combined with `psum` (SURVEY.md §2.3 "TPU mapping").
 
 On a real slice, lay ``val`` on the innermost (fastest-ICI) mesh dim —
 it carries the per-phase quorum psums; ``data`` shards never
-communicate, so they can span DCN.
+communicate, so they can span DCN.  On real multi-slice hardware build
+the hierarchical mesh's device grid with
+`jax.experimental.mesh_utils.create_hybrid_device_mesh` so the outer
+axis actually follows slice boundaries; `make_hierarchical_mesh` takes
+any device list (the virtual CPU mesh in tests, the driver's dryrun)
+and reshapes it (slice, data, val) slice-major, which matches the
+hybrid layout when devices are enumerated slice-by-slice (JAX's
+default enumeration order).
 """
 
 from __future__ import annotations
@@ -19,6 +30,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+SLICE_AXIS = "slice"
 DATA_AXIS = "data"
 VAL_AXIS = "val"
 
@@ -35,3 +47,22 @@ def make_mesh(n_data: int, n_val: int,
             f"mesh {n_data}x{n_val} needs {need} devices, have {len(devices)}")
     grid = np.asarray(devices[:need]).reshape(n_data, n_val)
     return Mesh(grid, (DATA_AXIS, VAL_AXIS))
+
+
+def make_hierarchical_mesh(n_slices: int, n_data: int, n_val: int,
+                           devices: Optional[Sequence[jax.Device]] = None
+                           ) -> Mesh:
+    """A (slice=n_slices, data=n_data, val=n_val) hierarchical mesh:
+    instances shard over slice x data (slice crosses DCN), the tally's
+    psum reduction stays on val (intra-slice ICI).  sharded.py detects
+    the slice axis and widens its instance-dimension specs to
+    ("slice", "data") automatically."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_slices * n_data * n_val
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {n_slices}x{n_data}x{n_val} needs {need} devices, "
+            f"have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_slices, n_data, n_val)
+    return Mesh(grid, (SLICE_AXIS, DATA_AXIS, VAL_AXIS))

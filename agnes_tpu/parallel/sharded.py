@@ -1,35 +1,43 @@
 """shard_map-wrapped consensus step: dp over instances, tp over validators.
 
-Sharding layout (I = instances, V = validators, W = rounds, S = slots):
+Sharding layout (I = instances, V = validators, W = rounds, S = slots;
+``data*`` is the instance-dimension axis set — ("data",) on a flat
+mesh, ("slice", "data") on a hierarchical multi-slice mesh, where the
+outer slice axis crosses DCN and carries no collectives at all):
 
   =================  ==================  =========================
   array              shape               PartitionSpec
   =================  ==================  =========================
-  DeviceState.*      [I]                 (data,)
-  tally.weights      [I, W, 2, S+1]      (data,)        replicated over val
-  tally.voted        [I, W, 2, V]        (data,,,val)   the per-validator record
-  tally.emitted      [I, W, 2]           (data,)
-  tally.skipped      [I, W]              (data,)
-  tally.equiv        [I, V]              (data, val)
-  ExtEvent.*         [I]                 (data,)
-  phase.round/typ    [I]                 (data,)
-  phase.slots/mask   [I, V]              (data, val)
+  DeviceState.*      [I]                 (data*,)
+  tally.weights      [I, W, 2, S+1]      (data*,)       replicated over val
+  tally.voted        [I, W, 2, V]        (data*,,,val)  the per-validator record
+  tally.emitted      [I, W, 2]           (data*,)
+  tally.skipped      [I, W]              (data*,)
+  tally.equiv        [I, V]              (data*, val)
+  ExtEvent.*         [I]                 (data*,)
+  phase.round/typ    [I]                 (data*,)
+  phase.slots/mask   [I, V]              (data*, val)
   powers             [V]                 (val,)
   total_power        []                  ()
-  proposer_flag      [I, W]              (data,)
-  propose_value      [I]                 (data,)
-  msgs out           [n_stages, I]       (None, data)
+  proposer_flag      [I, W]              (data*,)
+  propose_value      [I]                 (data*,)
+  msgs out           [n_stages, I]       (None, data*)
   =================  ==================  =========================
 
 Only the tally's two validator reductions communicate (psum over
 ``val``, see device/tally.py); the state machine replicates over the
 val axis — its per-instance state is a handful of ints, so replicating
-beats communicating.
+beats communicating.  Nothing ever reduces over ``slice`` or ``data``:
+instance parallelism is embarrassingly parallel by design, which is
+what makes the multi-slice story work — DCN only ever carries the
+initial shard placement, never a per-step collective (SURVEY.md §2.7
+comm-backend row: ICI for quorum psums, DCN for instance DP).
 """
 
 from __future__ import annotations
 
 from functools import partial
+from typing import Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding
@@ -42,59 +50,69 @@ from agnes_tpu.device.step import (
     consensus_step,
 )
 from agnes_tpu.device.tally import TallyState
-from agnes_tpu.parallel.mesh import DATA_AXIS, VAL_AXIS
+from agnes_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS, VAL_AXIS
 
-_DATA = P(DATA_AXIS)
 _SCALAR = P()
 
-_STATE_SPEC_LEAF = _DATA
-_TALLY_SPEC = TallyState(
-    weights=_DATA,
-    voted=P(DATA_AXIS, None, None, VAL_AXIS),
-    emitted=_DATA,
-    skipped=_DATA,
-    equiv=P(DATA_AXIS, VAL_AXIS),
-    q_round=_DATA,
-    q_step=_DATA,
-    pc_done=_DATA,
-    skip_w=_DATA,
-    base_round=_DATA,
-)
-_EXT_SPEC = ExtEvent(tag=_DATA, round=_DATA, value=_DATA, pol_round=_DATA)
-_PHASE_SPEC = VotePhase(round=_DATA, typ=_DATA,
-                        slots=P(DATA_AXIS, VAL_AXIS),
-                        mask=P(DATA_AXIS, VAL_AXIS),
-                        height=_DATA)
+
+def _data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The axis set sharding the instance dimension: widened with the
+    slice axis on hierarchical meshes."""
+    return ((SLICE_AXIS, DATA_AXIS) if SLICE_AXIS in mesh.axis_names
+            else (DATA_AXIS,))
 
 
-def _state_spec():
-    from agnes_tpu.device.encoding import DeviceState
-
-    return DeviceState(*([_STATE_SPEC_LEAF] * len(DeviceState._fields)))
-
-
-def _in_specs():
+def _in_specs(da: Tuple[str, ...]):
     """One source of truth for the step's argument shardings — used both
     by shard_map and by shard_step_args placement, so they cannot
     silently disagree."""
-    return (_state_spec(), _TALLY_SPEC, _EXT_SPEC, _PHASE_SPEC,
-            P(VAL_AXIS), _SCALAR, _DATA, _DATA)
+    data = P(da)
+    state_spec = _state_spec(da)
+    tally_spec = TallyState(
+        weights=data,
+        voted=P(da, None, None, VAL_AXIS),
+        emitted=data,
+        skipped=data,
+        equiv=P(da, VAL_AXIS),
+        q_round=data,
+        q_step=data,
+        pc_done=data,
+        skip_w=data,
+        base_round=data,
+    )
+    ext_spec = ExtEvent(tag=data, round=data, value=data, pol_round=data)
+    phase_spec = VotePhase(round=data, typ=data,
+                           slots=P(da, VAL_AXIS),
+                           mask=P(da, VAL_AXIS),
+                           height=data)
+    return (state_spec, tally_spec, ext_spec, phase_spec,
+            P(VAL_AXIS), _SCALAR, data, data)
+
+
+def _state_spec(da: Tuple[str, ...]):
+    from agnes_tpu.device.encoding import DeviceState
+
+    return DeviceState(*([P(da)] * len(DeviceState._fields)))
 
 
 def make_sharded_step(mesh: Mesh, advance_height: bool = False):
-    """A jitted consensus_step sharded over `mesh`; call with arrays
-    already placed by `shard_step_args` (or let jit reshard).
+    """A jitted consensus_step sharded over `mesh` (flat data x val or
+    hierarchical slice x data x val); call with arrays already placed
+    by `shard_step_args` (or let jit reshard).
 
     check_vma=True: shard_map statically validates the replication
     claims of every output spec (VERDICT r2 weak #6); the bitwise
     sharded-vs-unsharded scenario suite in tests/test_sharded.py checks
     the values on top."""
-    out_specs = StepOutputs(state=_state_spec(), tally=_TALLY_SPEC,
-                            msgs=P(None, DATA_AXIS))
+    da = _data_axes(mesh)
+    specs = _in_specs(da)
+    out_specs = StepOutputs(state=_state_spec(da),
+                            tally=specs[1],
+                            msgs=P(None, da))
     fn = jax.shard_map(
         partial(consensus_step, axis_name=VAL_AXIS,
                 advance_height=advance_height),
-        mesh=mesh, in_specs=_in_specs(), out_specs=out_specs,
+        mesh=mesh, in_specs=specs, out_specs=out_specs,
         check_vma=True)
     return jax.jit(fn)
 
@@ -108,4 +126,4 @@ def shard_step_args(mesh: Mesh, state, tally, ext, phase, powers,
         jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             a, spec, is_leaf=lambda x: x is None)
-        for a, spec in zip(args, _in_specs()))
+        for a, spec in zip(args, _in_specs(_data_axes(mesh))))
