@@ -86,6 +86,11 @@ def _lib() -> ctypes.CDLL:
         L.ag_ing_restore_counters.argtypes = [c.c_void_p, c.c_void_p]
         L.ag_ing_get_held_cap.restype = c.c_int64
         L.ag_ing_get_held_cap.argtypes = [c.c_void_p]
+        L.ag_ing_push_async.restype = c.c_int64
+        L.ag_ing_push_async.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        L.ag_ing_flush.argtypes = [c.c_void_p]
+        L.ag_ing_async_depth.restype = c.c_int64
+        L.ag_ing_async_depth.argtypes = [c.c_void_p]
         _configured = True
     return L
 
@@ -191,6 +196,28 @@ class NativeIngestLoop:
         n = len(wire_bytes) // REC_SIZE
         self._used = True
         return _lib().ag_ing_push(self._h, wire_bytes, n)
+
+    def push_async(self, wire_bytes: bytes) -> int:
+        """Queue packed wire records for the C++ worker thread, which
+        parses + malformed-screens them CONCURRENTLY with whatever the
+        caller does next (drive the device step, pack the next batch) —
+        the host-driver overlap of SURVEY.md §2.7.  Returns the record
+        count queued; `build_phases` (and `flush`) synchronize, so
+        per-tick semantics are identical to `push` — differential:
+        tests/test_native_ingest.py async suite."""
+        n = len(wire_bytes) // REC_SIZE
+        self._used = True
+        return _lib().ag_ing_push_async(self._h, wire_bytes, n)
+
+    def flush(self) -> None:
+        """Block until every queued async buffer has been parsed into
+        the pending set (build_phases implies this via stage)."""
+        _lib().ag_ing_flush(self._h)
+
+    @property
+    def async_depth(self) -> int:
+        """Records queued or mid-parse on the worker thread."""
+        return int(_lib().ag_ing_async_depth(self._h))
 
     def build_phases(self) -> List[Tuple[VotePhase, int]]:
         """Stage -> (verify on device if signed) -> emit.  Returns

@@ -36,10 +36,14 @@
 
 #include <algorithm>
 #include <array>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <memory>
+#include <mutex>
+#include <thread>
 #include <tuple>
 #include <unordered_map>
 #include <vector>
@@ -151,7 +155,76 @@ struct Loop {
   // per-emit O(I*V) clear
   std::vector<uint64_t> cell_epoch;
   uint64_t epoch = 0;
+
+  // --- async ingestion (the actual host-driver concurrency of
+  // SURVEY.md §2.7: a worker thread parses + malformed-screens inbound
+  // wire buffers while the tick thread drives verify/emit/device).
+  // `mu` guards exactly the state both threads touch: inbox, pending,
+  // arrivals, rejected_malformed, and the lifecycle flags.  Everything
+  // else (staged/held/slots/log/emit sets) is tick-thread-only.
+  std::mutex mu;
+  std::condition_variable cv_in;    // worker: work available / stop
+  std::condition_variable cv_idle;  // flush(): queue drained
+  std::deque<std::vector<uint8_t>> inbox;
+  int64_t inbox_recs = 0;           // records queued, not yet in pending
+  bool worker_busy = false;
+  bool stop_worker = false;
+  std::thread worker;               // spawned lazily on first push_async
+
+  ~Loop() {
+    if (worker.joinable()) {
+      {
+        std::lock_guard<std::mutex> g(mu);
+        stop_worker = true;
+      }
+      cv_in.notify_all();
+      worker.join();
+    }
+  }
 };
+
+void parse_rec(const uint8_t* p, Rec* r);            // defined below
+inline bool rec_malformed(const Loop* L, const Rec& r);
+
+// worker thread: pop one wire buffer at a time, parse + screen OFF the
+// lock, then append to pending in FIFO order (arrival stamps are
+// assigned under the lock, so layering order == push_async order,
+// matching the synchronous path exactly)
+void ingest_worker_main(Loop* L) {
+  std::unique_lock<std::mutex> lk(L->mu);
+  for (;;) {
+    L->cv_in.wait(lk, [&] { return L->stop_worker || !L->inbox.empty(); });
+    if (L->inbox.empty()) return;    // stop requested and drained
+    std::vector<uint8_t> buf = std::move(L->inbox.front());
+    L->inbox.pop_front();
+    L->worker_busy = true;
+    lk.unlock();
+
+    const int64_t n = static_cast<int64_t>(buf.size()) / kRecSize;
+    std::vector<Rec> local;
+    local.reserve(static_cast<size_t>(n));
+    int64_t malformed = 0;
+    for (int64_t k = 0; k < n; ++k) {
+      Rec r;
+      parse_rec(buf.data() + k * kRecSize, &r);
+      if (rec_malformed(L, r))       // dims are immutable: lock-free read
+        ++malformed;
+      else
+        local.push_back(r);
+    }
+
+    lk.lock();
+    grow_reserve(L->pending, local.size());
+    for (Rec& r : local) {
+      r.arrival = L->arrivals++;
+      L->pending.push_back(r);
+    }
+    L->rejected_malformed += malformed;
+    L->inbox_recs -= n;
+    L->worker_busy = false;
+    if (L->inbox.empty()) L->cv_idle.notify_all();
+  }
+}
 
 void host_tally_add(Loop* L, const Rec& r) {
   auto key = std::make_tuple(r.instance, r.height, r.round);
@@ -323,6 +396,8 @@ void ag_ing_sync(void* h, const int64_t* base_round,
         base_round[i] < 0 ? 0 : base_round[i];
   }
   if (!L->held.empty()) {
+    // pending is shared with the async worker; held is tick-only
+    std::lock_guard<std::mutex> g(L->mu);
     grow_reserve(L->pending, L->held.size());
     for (auto& r : L->held) L->pending.push_back(r);
     L->held.clear();
@@ -331,10 +406,12 @@ void ag_ing_sync(void* h, const int64_t* base_round,
 
 // parse + malformed screen; returns count accepted into pending
 // (height/window screens run at stage(); rejects are counted on the
-// handle)
+// handle).  Takes the async mutex: pending/arrivals/rejected_malformed
+// are shared with the worker thread when push_async is in use.
 int64_t ag_ing_push(void* h, const uint8_t* buf, int64_t n) {
   auto* L = static_cast<Loop*>(h);
   int64_t accepted = 0;
+  std::lock_guard<std::mutex> g(L->mu);
   grow_reserve(L->pending, static_cast<size_t>(n));
   for (int64_t k = 0; k < n; ++k) {
     Rec r;
@@ -352,12 +429,54 @@ int64_t ag_ing_push(void* h, const uint8_t* buf, int64_t n) {
   return accepted;
 }
 
+// queue one wire buffer for the worker thread (copies the bytes: the
+// caller's buffer is free the moment this returns).  The worker
+// parses/screens while the tick thread drives verify/emit/device —
+// the overlap that makes densify(k+1) concurrent with step(k).
+int64_t ag_ing_push_async(void* h, const uint8_t* buf, int64_t n) {
+  auto* L = static_cast<Loop*>(h);
+  std::vector<uint8_t> copy(buf, buf + n * kRecSize);
+  {
+    std::lock_guard<std::mutex> g(L->mu);
+    if (!L->worker.joinable())
+      L->worker = std::thread(ingest_worker_main, L);
+    L->inbox.push_back(std::move(copy));
+    L->inbox_recs += n;
+  }
+  L->cv_in.notify_one();
+  return n;
+}
+
+// wait until every queued async buffer has landed in pending — after
+// this, stage() sees exactly the records a synchronous push would have
+void ag_ing_flush(void* h) {
+  auto* L = static_cast<Loop*>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_idle.wait(lk, [&] { return L->inbox.empty() && !L->worker_busy; });
+}
+
+// records queued/in-flight on the worker (observability + tests);
+// counts a buffer until its records have landed in pending
+int64_t ag_ing_async_depth(void* h) {
+  auto* L = static_cast<Loop*>(h);
+  std::lock_guard<std::mutex> g(L->mu);
+  return L->inbox_recs;
+}
+
 // screen pending against the last-synced heights/window and snapshot
-// the in-window lanes for verification; returns lane count
+// the in-window lanes for verification; returns lane count.  Implies
+// flush(): a stage must never run ahead of queued async pushes.
 int64_t ag_ing_stage(void* h) {
   auto* L = static_cast<Loop*>(h);
-  grow_reserve(L->staged, L->pending.size());
-  for (auto& r : L->pending) {
+  std::vector<Rec> work;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_idle.wait(lk,
+                    [&] { return L->inbox.empty() && !L->worker_busy; });
+    work.swap(L->pending);
+  }
+  grow_reserve(L->staged, work.size());
+  for (const auto& r : work) {
     size_t i = static_cast<size_t>(r.instance);
     if (r.height != L->heights[i]) {
       ++L->dropped_stale_height;
@@ -370,7 +489,14 @@ int64_t ag_ing_stage(void* h) {
       L->staged.push_back(r);
     }
   }
-  L->pending.clear();
+  // hand pending's buffer back (hot per-tick path: keep steady-state
+  // ticks allocation-free) unless the worker already refilled it
+  work.clear();
+  {
+    std::lock_guard<std::mutex> g(L->mu);
+    if (L->pending.empty() && work.capacity() > L->pending.capacity())
+      L->pending.swap(work);
+  }
   return static_cast<int64_t>(L->staged.size());
 }
 
@@ -784,6 +910,9 @@ void ag_ing_restore_counters(void* h, const int64_t* in) {
 //            held_overflow]
 void ag_ing_counters(void* h, int64_t* out) {
   auto* L = static_cast<Loop*>(h);
+  // rejected_malformed is worker-shared; the rest are tick-only (the
+  // one lock covers the lot — this is a cold observability path)
+  std::lock_guard<std::mutex> g(L->mu);
   out[0] = L->rejected_malformed;
   out[1] = L->dropped_stale_height;
   out[2] = L->rejected_signature;

@@ -58,13 +58,23 @@ class DeviceDriver:
                  n_rounds: int = 4, n_slots: int = 4,
                  proposer_is_self: bool = True,
                  advance_height: bool = False,
-                 mesh=None):
+                 mesh=None, defer_collect: bool = False):
         """With `mesh` (flat data x val or hierarchical
         slice x data x val, parallel/mesh.py) the closed loop runs the
         shard_map-sharded step with every argument placed per the
-        parallel/sharded.py layout — the multi-chip driver, same API."""
+        parallel/sharded.py layout — the multi-chip driver, same API.
+
+        `defer_collect` exploits JAX async dispatch deliberately: the
+        per-step message collection (`_collect`) forces a host sync on
+        the step OUTPUTS, serializing host feed k+1 behind device step
+        k.  Deferred, step() returns the moment dispatch is queued and
+        the host overlaps densify/verify of the next phase with the
+        running device step; `collect()` (or `block_until_ready`)
+        drains the queued message batches when the stats are needed."""
         self.I, self.V = n_instances, n_validators
         self.advance_height = advance_height
+        self.defer_collect = defer_collect
+        self._deferred_msgs: list = []
         self.mesh = mesh
         if mesh is not None:
             from agnes_tpu.parallel import make_sharded_step
@@ -155,7 +165,10 @@ class DeviceDriver:
         self.state, self.tally = out.state, out.tally
         self.stats.steps += 1
         self.stats.votes_ingested += int(np.asarray(phase.mask).sum())
-        self._collect(out.msgs)
+        if self.defer_collect:
+            self._deferred_msgs.append(out.msgs)
+        else:
+            self._collect(out.msgs)
         return out.msgs
 
     def _collect(self, msgs) -> None:
@@ -228,13 +241,22 @@ class DeviceDriver:
         return np.asarray(self.tally.equiv).sum(axis=1)
 
     def all_decided(self, value: Optional[int] = None) -> bool:
+        self.collect()               # stats must see deferred batches
         if not bool(self.stats.decided.all()):
             return False
         if value is not None:
             return bool((self.stats.decision_value == value).all())
         return True
 
+    def collect(self) -> None:
+        """Drain deferred message batches into the stats (in step
+        order — decision latching is order-sensitive)."""
+        msgs, self._deferred_msgs = self._deferred_msgs, []
+        for m in msgs:
+            self._collect(m)
+
     def block_until_ready(self):
+        self.collect()
         jax.block_until_ready(self.state)
         return self
 

@@ -351,6 +351,94 @@ def _native_feeder(I, V, pubkeys):
             lambda: loop.counters["rejected_signature"])
 
 
+def _pipeline_overlapped(n_instances: int, n_validators: int,
+                         heights: int, tracer=None) -> float:
+    """END-TO-END with BOTH overlap mechanisms on (VERDICT r3 next #4):
+
+      * push_async — the C++ worker thread parses/screens the next
+        phase's wire records while this thread packs more and drives
+        the device (core/native/ingest.cpp ingest_worker_main);
+      * defer_collect — JAX async dispatch is left to run: the per-step
+        message sync is deferred to the end of the run, so host
+        pack/push/verify/emit of phase k+1 overlaps device step k.
+
+    Same wire traffic, same assertions as the synchronous native path;
+    the rate difference IS the measured overlap.
+
+    `tracer` (utils.tracing.Tracer) wraps each host-side stage in a
+    chrome-trace span — scripts/profile_overlap.py uses this to show
+    the device time hidden inside the host spans."""
+    import contextlib
+
+    from agnes_tpu.bridge.ingest import vote_messages_np
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+    from agnes_tpu.core import native
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.utils.config import RunConfig
+
+    span = tracer.span if tracer is not None \
+        else (lambda name: contextlib.nullcontext())
+    I, V = n_instances, n_validators
+    seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(V)]
+    pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in seeds])
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    loop = RunConfig(n_validators=V, n_instances=I,
+                     n_slots=4).validate().make_native_loop(pubkeys=pubkeys)
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+
+    def sign_height(h):
+        out = {}
+        for typ in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
+            msgs = vote_messages_np(
+                np.full(V, h), np.zeros(V, np.int64),
+                np.full(V, typ), np.full(V, 7))
+            out[typ] = np.stack([
+                np.frombuffer(native.sign(seeds[v], msgs[v].tobytes()),
+                              np.uint8) for v in range(V)])
+        return out
+
+    def run_height(h, sigs_by_typ):
+        with span("entry_dispatch"):
+            d.step()               # entry (async dispatch, not awaited)
+        with span("sync"):
+            loop.sync_device(np.asarray(d.tally.base_round),
+                             np.asarray(d.state.height))
+        # queue BOTH classes: the worker parses while we keep packing
+        # and while the entry step runs on device
+        for typ in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
+            with span("pack"):
+                wire = pack_wire_votes(
+                    inst, val, np.full(n, h), np.zeros(n),
+                    np.full(n, typ), np.full(n, 7), sigs_by_typ[typ][val])
+            with span("push_async"):
+                loop.push_async(wire)
+        # one build emits prevote then precommit phases (deterministic
+        # (round, class, layer) order) — step each without syncing
+        with span("build(verify+emit)"):
+            phases = loop.build_phases()
+        for phase, _ in phases:
+            with span("step_dispatch"):
+                d.step(phase=phase)
+
+    run_height(0, sign_height(0))   # warmup + compile
+    d.block_until_ready()
+    assert d.stats.decisions_total == I, d.stats.decisions_total
+    assert loop.counters["rejected_signature"] == 0
+
+    all_sigs = [sign_height(h) for h in range(1, heights + 1)]
+    t0 = time.perf_counter()
+    for h in range(1, heights + 1):
+        run_height(h, all_sigs[h - 1])
+    d.block_until_ready()
+    dt = time.perf_counter() - t0
+    assert d.stats.decisions_total == I * (heights + 1)
+    assert loop.counters["rejected_signature"] == 0
+    return 2 * n * heights / dt
+
+
 def bench_pipeline(n_instances: int = 1024, n_validators: int = 128,
                    heights: int = 6) -> float:
     """The flagship headline: end-to-end through the numpy bridge."""
@@ -360,9 +448,17 @@ def bench_pipeline(n_instances: int = 1024, n_validators: int = 128,
 
 def bench_pipeline_native(n_instances: int = 1024, n_validators: int = 128,
                           heights: int = 6) -> float:
-    """End-to-end with the C++ event loop as the feeder."""
+    """End-to-end with the C++ event loop as the feeder (synchronous
+    tick protocol — the overlap baseline)."""
     return _pipeline_harness(n_instances, n_validators, heights,
                              _native_feeder)
+
+
+def bench_pipeline_overlapped(n_instances: int = 1024,
+                              n_validators: int = 128,
+                              heights: int = 6) -> float:
+    """End-to-end, C++ worker thread + deferred collection."""
+    return _pipeline_overlapped(n_instances, n_validators, heights)
 
 
 def main() -> None:
@@ -377,6 +473,7 @@ def main() -> None:
 
     pipeline = guarded(bench_pipeline)
     pipeline_native = guarded(bench_pipeline_native)
+    pipeline_overlapped = guarded(bench_pipeline_overlapped)
     tally = guarded(bench_tally)
     verifies = guarded(bench_verify)
     msm = guarded(bench_verify_msm)
@@ -392,6 +489,7 @@ def main() -> None:
         "vs_baseline": round(pipeline / NORTH_STAR, 3) if pipeline > 0
         else -1,
         "pipeline_native_votes_per_sec": pipeline_native,
+        "pipeline_overlapped_votes_per_sec": pipeline_overlapped,
         "fused_tally_step_votes_per_sec": tally,
         "ed25519_verifies_per_sec": verifies,
         "ed25519_msm_verifies_per_sec": msm,

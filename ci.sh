@@ -1,18 +1,22 @@
 #!/bin/bash
 # CI for agnes_tpu (SURVEY.md §5 "TSAN/ASAN CI jobs" slot).
 #
-#   1. sanitizer pass — rebuild the C++ core with ASan+UBSan and run
-#      the C++-vs-Python differential suite plus the adversarial C-ABI
-#      fuzz file under it (the raw-pointer ctypes surface, capi.cpp);
-#   2. full pytest on the virtual 8-device CPU mesh;
-#   3. bench smoke (CI_BENCH=0 skips; the driver runs the real bench
-#      on TPU hardware at end of round).
+#   1.  sanitizer pass — rebuild the C++ core with ASan+UBSan and run
+#       the C++-vs-Python differential suite plus the adversarial C-ABI
+#       fuzz file under it (the raw-pointer ctypes surface, capi.cpp);
+#   1b. TSAN pass — the ingest event loop's async worker thread
+#       (core/native/ingest.cpp) under ThreadSanitizer via a dedicated
+#       fully-instrumented stress binary (tests/native/tsan_stress.cpp:
+#       3 producer threads racing the tick protocol).  A binary rather
+#       than pytest because TSAN through python drowns findings in
+#       uninstrumented jaxlib/Eigen thread-pool noise;
+#   2.  full pytest on the virtual 8-device CPU mesh;
+#   3.  bench smoke (CI_BENCH=0 skips; the driver runs the real bench
+#       on TPU hardware at end of round).
 #
 # The purity/testability argument the whole design serves (reference
 # README.md:8-14) is enforced by (2); memory safety of the native layer
-# by (1).  TSAN is not run: the C++ core is handle-per-caller with no
-# shared mutable state or threads (capi.cpp), so there is nothing for
-# a race detector to check yet — revisit when the C++ event loop lands.
+# by (1); freedom from data races in the host-driver concurrency by (1b).
 set -euo pipefail
 cd "$(dirname "$0")"
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
@@ -32,6 +36,15 @@ AGNES_NATIVE_SANITIZE="address,undefined" \
   python -m pytest tests/test_native_core.py tests/test_capi_fuzz.py \
     tests/test_native_ingest.py -q -p no:cacheprovider \
   || { cat "$SAN_LOG".* 2>/dev/null; exit 1; }
+
+echo "=== [1b/3] TSAN: ingest worker-thread stress ==="
+TSAN_BIN="$(mktemp -d)/tsan_stress"
+g++ -fsanitize=thread -O1 -g -std=c++17 -pthread -o "$TSAN_BIN" \
+  tests/native/tsan_stress.cpp \
+  agnes_tpu/core/native/ingest.cpp agnes_tpu/core/native/core.cpp \
+  agnes_tpu/core/native/sha512.cpp agnes_tpu/core/native/ed25519.cpp \
+  agnes_tpu/core/native/capi.cpp
+TSAN_OPTIONS="halt_on_error=1" "$TSAN_BIN"
 
 echo "=== [2/3] full test suite (virtual 8-device CPU mesh) ==="
 # step 1 already ran the native differential + fuzz files under ASan

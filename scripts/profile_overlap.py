@@ -1,0 +1,61 @@
+"""Measure + trace the host<->device overlap (VERDICT r3 next #4).
+
+Runs the end-to-end signed pipeline twice at the same shape:
+
+  sync        bench.bench_pipeline_native — the tick protocol with
+              synchronous push and per-step message collection;
+  overlapped  bench._pipeline_overlapped — the C++ worker thread
+              parses/screens wire records (ingest.cpp
+              ingest_worker_main) while this thread packs the next
+              batch and drives the device, and message collection is
+              deferred so JAX async dispatch actually overlaps host
+              work with the running device step.
+
+Prints one JSON line {sync, overlapped, speedup} and writes a
+chrome-trace (chrome://tracing / perfetto) of the overlapped run with
+host-side spans (pack, push_async, build, dispatch) — the gaps between
+dispatch spans are the device time the host work hides inside.
+
+Usage:  python scripts/profile_overlap.py [I V heights] [trace.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+
+import bench  # noqa: E402
+from agnes_tpu.utils.tracing import Tracer  # noqa: E402
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if not a.endswith(".json")]
+    trace = next((a for a in sys.argv[1:] if a.endswith(".json")),
+                 "/tmp/overlap_trace.json")
+    I, V, heights = (int(args[0]), int(args[1]),
+                     int(args[2])) if len(args) >= 3 else (1024, 128, 6)
+
+    sync_rate = bench._pipeline_harness(I, V, heights, bench._native_feeder)
+    tracer = Tracer()
+    over_rate = bench._pipeline_overlapped(I, V, heights, tracer=tracer)
+    tracer.write(trace)
+    print(json.dumps({
+        "metric": "overlap_speedup",
+        "sync_votes_per_sec": round(sync_rate),
+        "overlapped_votes_per_sec": round(over_rate),
+        "speedup": round(over_rate / sync_rate, 3),
+        "trace": trace,
+        "shape": {"instances": I, "validators": V, "heights": heights},
+    }))
+
+
+if __name__ == "__main__":
+    main()
