@@ -201,6 +201,18 @@ class VoteBatcher:
         self._host_tally: Dict[Tuple[int, int], RoundVotes] = {}
         self._host_events: List[Tuple[int, int, int]] = []
 
+    def set_validators(self, powers: np.ndarray) -> None:
+        """Validator-set epoch (reference validators.rs:38-46 intent,
+        SURVEY §2.6): adopt new voting powers AT A HEIGHT BOUNDARY —
+        call right after the sync_device that advanced heights (which
+        dropped the old heights' host tallies).  A power of 0 models
+        removal; the pubkey table is per-build (`build_phases(pubkeys)`)
+        so key rotation needs no call here."""
+        pw = np.asarray(powers, np.int64)
+        if pw.shape != (self.V,):
+            raise ValueError(f"powers must be [{self.V}], got {pw.shape}")
+        self.powers = pw
+
     # -- enqueue -------------------------------------------------------------
 
     def add_arrays(self, instance, validator, height, round_, typ, value,

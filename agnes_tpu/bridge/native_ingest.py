@@ -91,6 +91,9 @@ def _lib() -> ctypes.CDLL:
         L.ag_ing_flush.argtypes = [c.c_void_p]
         L.ag_ing_async_depth.restype = c.c_int64
         L.ag_ing_async_depth.argtypes = [c.c_void_p]
+        L.ag_ing_set_validators.restype = c.c_int64
+        L.ag_ing_set_validators.argtypes = [c.c_void_p, c.c_char_p,
+                                            c.c_void_p]
         _configured = True
     return L
 
@@ -218,6 +221,38 @@ class NativeIngestLoop:
     def async_depth(self) -> int:
         """Records queued or mid-parse on the worker thread."""
         return int(_lib().ag_ing_async_depth(self._h))
+
+    def set_validators(self, pubkeys: Optional[np.ndarray] = None,
+                       powers: Optional[np.ndarray] = None) -> None:
+        """Validator-set epoch (reference validators.rs:38-46 intent,
+        SURVEY §2.6 "re-uploaded on set changes"): swap the pubkey
+        table (key rotation) and/or voting powers AT A HEIGHT BOUNDARY
+        — call right after the sync_device that advanced heights.  A
+        power of 0 models removal; None leaves a table unchanged."""
+        self.flush()                     # no worker batch mid-parse
+        pk = None
+        if pubkeys is not None:
+            if not self.signed:
+                raise ValueError(
+                    "pubkey upload on an unsigned loop (verification "
+                    "policy is construction-time)")
+            pubkeys = np.ascontiguousarray(pubkeys, np.uint8)
+            if pubkeys.shape != (self.V, 32):
+                raise ValueError(
+                    f"pubkeys must be [{self.V}, 32], got {pubkeys.shape}")
+            pk = pubkeys.tobytes()
+        pw = None
+        if powers is not None:
+            pw = np.ascontiguousarray(powers, np.int64)
+            if pw.shape != (self.V,):
+                raise ValueError(
+                    f"powers must be [{self.V}], got {pw.shape}")
+            self._powers = pw
+        self._used = True
+        rc = _lib().ag_ing_set_validators(
+            self._h, pk, pw.ctypes.data if pw is not None else None)
+        if rc < 0:
+            raise ValueError("set_validators rejected by the native loop")
 
     def build_phases(self) -> List[Tuple[VotePhase, int]]:
         """Stage -> (verify on device if signed) -> emit.  Returns

@@ -364,6 +364,30 @@ int64_t ag_ing_get_held_cap(void* h) {
   return static_cast<Loop*>(h)->held_cap;
 }
 
+// validator-set epoch (reference validators.rs:38-46 intent, SURVEY
+// §2.6 "re-uploaded on set changes"): swap the pubkey table and/or
+// voting powers AT A HEIGHT BOUNDARY — call right after the sync that
+// advanced heights (which already dropped the old heights' host
+// tallies), from the tick thread, with no staged lanes in flight.
+// NULL leaves a table unchanged; a power of 0 models removal (the
+// device shape is static).  Returns 0, or -1 for a pubkey upload on a
+// loop constructed unsigned (verification policy is construction-time).
+int64_t ag_ing_set_validators(void* h, const uint8_t* pubkeys,
+                              const int64_t* powers) {
+  auto* L = static_cast<Loop*>(h);
+  if (pubkeys) {
+    if (!L->require_verify) return -1;
+    L->pubkeys.assign(pubkeys, pubkeys + L->V * 32);
+  }
+  if (powers) {
+    L->powers.assign(powers, powers + L->V);
+    L->total_power = 0;
+    for (int64_t p : L->powers)
+      L->total_power = agnes::sat_add(L->total_power, p);
+  }
+  return 0;
+}
+
 void ag_ing_free(void* h) { delete static_cast<Loop*>(h); }
 
 // adopt device window bases + heights; held votes re-enter pending
@@ -407,11 +431,15 @@ void ag_ing_sync(void* h, const int64_t* base_round,
 // parse + malformed screen; returns count accepted into pending
 // (height/window screens run at stage(); rejects are counted on the
 // handle).  Takes the async mutex: pending/arrivals/rejected_malformed
-// are shared with the worker thread when push_async is in use.
+// are shared with the worker thread when push_async is in use — and
+// DRAINS the inbox first, so a push() after push_async() stamps its
+// arrivals after the queued buffers' (first-vote-wins dedup and
+// evidence order must match the all-synchronous sequence exactly).
 int64_t ag_ing_push(void* h, const uint8_t* buf, int64_t n) {
   auto* L = static_cast<Loop*>(h);
   int64_t accepted = 0;
-  std::lock_guard<std::mutex> g(L->mu);
+  std::unique_lock<std::mutex> g(L->mu);
+  L->cv_idle.wait(g, [&] { return L->inbox.empty() && !L->worker_busy; });
   grow_reserve(L->pending, static_cast<size_t>(n));
   for (int64_t k = 0; k < n; ++k) {
     Rec r;

@@ -96,6 +96,21 @@ class DeviceDriver:
             decision_value=np.full(self.I, NIL_ID, np.int32),
             decision_round=np.full(self.I, -1, np.int32))
 
+    def set_validators(self, powers) -> None:
+        """Validator-set epoch at a height boundary (reference
+        validators.rs:38-46 intent, SURVEY §2.6 "re-uploaded on set
+        changes"): re-upload the voting-power table the quorum math
+        uses.  The device shape [V] is static — a power of 0 models a
+        removed validator, an updated row a power change; additions
+        beyond V need a re-built driver.  Call between heights (after
+        the decision, before the next entry step): mid-height changes
+        would mix quorum denominators within one tally window."""
+        pw = np.asarray(powers)
+        if pw.shape != (self.V,):
+            raise ValueError(f"powers must be [{self.V}], got {pw.shape}")
+        self.powers = jnp.asarray(pw, I32)
+        self.total = jnp.asarray(int(pw.sum()), I32)
+
     def set_proposer_table(self, flags, rotation_period: int) -> None:
         """Install a round-varying proposer table.  The device indexes
         it round % R (device/step.py stage 5), which is exact only when

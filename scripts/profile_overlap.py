@@ -40,8 +40,11 @@ def main() -> None:
     args = [a for a in sys.argv[1:] if not a.endswith(".json")]
     trace = next((a for a in sys.argv[1:] if a.endswith(".json")),
                  "/tmp/overlap_trace.json")
+    if len(args) not in (0, 3):
+        sys.exit("usage: profile_overlap.py [I V heights] [trace.json] "
+                 f"— got {len(args)} shape arg(s), need 0 or 3")
     I, V, heights = (int(args[0]), int(args[1]),
-                     int(args[2])) if len(args) >= 3 else (1024, 128, 6)
+                     int(args[2])) if args else (1024, 128, 6)
 
     sync_rate = bench._pipeline_harness(I, V, heights, bench._native_feeder)
     tracer = Tracer()

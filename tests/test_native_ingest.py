@@ -649,3 +649,19 @@ def test_overlapped_pipeline_small_shape():
 
     rate = bench._pipeline_overlapped(8, 8, heights=2)
     assert rate > 0        # asserts decisions + zero rejects internally
+
+
+def test_push_after_push_async_preserves_arrival_order():
+    """push() must drain the async inbox before stamping arrivals, so a
+    mixed push_async-then-push sequence keeps first-vote-wins dedup and
+    evidence order identical to the all-synchronous sequence."""
+    loop = NativeIngestLoop(1, 4, n_slots=4)
+    loop.sync_device(np.zeros(1, np.int64), np.zeros(1, np.int64))
+    # async: validator 2 votes 9 FIRST; then sync push: votes 11
+    loop.push_async(pack_wire_votes([0], [2], [0], [0], [PV], [9]))
+    loop.push(pack_wire_votes([0], [2], [0], [0], [PV], [11]))
+    phases = loop.build_phases()
+    # first-vote-wins: layer 0 carries 9, layer 1 the conflicting 11
+    assert len(phases) == 2
+    assert loop.decode_slot(0, int(np.asarray(phases[0][0].slots)[0, 2])) \
+        == 9

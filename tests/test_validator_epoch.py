@@ -1,0 +1,142 @@
+"""Validator-set change at a height boundary, exercised on the device
+plane (VERDICT r3 next #8; reference validators.rs:38-46 intent —
+add/update/remove, which doesn't even compile there — and SURVEY §2.6
+"re-uploaded on set changes").
+
+The device shape [V] is static: an epoch re-uploads the power table
+(0 = removed) and, on the signed native loop, the pubkey table (key
+rotation).  All three surfaces are covered: DeviceDriver quorum math,
+NativeIngestLoop verification + host-fallback quorum, VoteBatcher
+host-fallback quorum.
+"""
+
+import numpy as np
+import pytest
+
+from agnes_tpu.bridge import NativeIngestLoop, VoteBatcher, pack_wire_votes
+from agnes_tpu.core import native
+from agnes_tpu.harness.device_driver import DeviceDriver
+from agnes_tpu.types import VoteType
+
+PV, PC = int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)
+
+
+def test_device_power_epoch_changes_quorum():
+    """Height 0 decides under uniform powers; the epoch re-upload
+    [5, 1, 1, 0] then governs height 1: the old 3-of-4 uniform quorum
+    (now weight 2 of 7, validator 3 removed) must NOT decide, and
+    {0, 1, 2} (weight 7) must."""
+    I, V = 4, 4
+    d = DeviceDriver(I, V, advance_height=True)
+    d.run_honest_round(0, slot=1)
+    assert d.all_decided()
+    assert (np.asarray(d.state.height) == 1).all()
+
+    d.set_validators([5, 1, 1, 0])
+
+    # height 1, round 0: validators {1, 2, 3} vote — weight 1+1+0 = 2
+    # of total 7; under the OLD uniform set this was a +2/3 quorum
+    d.step()
+    d.step(phase=d.phase(0, VoteType.PREVOTE, 1, frac=0.75, offset=1))
+    d.step(phase=d.phase(0, VoteType.PRECOMMIT, 1, frac=0.75, offset=1))
+    d.collect()
+    assert d.stats.decisions_total == I          # nothing new decided
+
+    # validators {0, 1, 2}: weight 5+1+1 = 7 > 2/3 * 7 — decides
+    d.step(phase=d.phase(0, VoteType.PREVOTE, 1, frac=0.75, offset=0))
+    d.step(phase=d.phase(0, VoteType.PRECOMMIT, 1, frac=0.75, offset=0))
+    d.collect()
+    assert d.stats.decisions_total == 2 * I
+    assert (np.asarray(d.state.height) == 2).all()
+
+
+def _signed_wire(seeds, inst, val, h, rnd, typ, value, signer_seeds=None):
+    from agnes_tpu.bridge.ingest import vote_messages_np
+
+    h = np.asarray(h, np.int64)
+    rnd = np.asarray(rnd, np.int64)
+    typ = np.asarray(typ, np.int64)
+    value = np.asarray(value, np.int64)
+    msgs = vote_messages_np(h, rnd, typ, value)
+    signers = signer_seeds if signer_seeds is not None else \
+        [seeds[v] for v in val]
+    sigs = np.stack([np.frombuffer(
+        native.sign(signers[k], msgs[k].tobytes()), np.uint8)
+        for k in range(len(val))])
+    return pack_wire_votes(np.asarray(inst, np.int64),
+                           np.asarray(val, np.int64), h, rnd, typ,
+                           value, sigs)
+
+
+def test_native_loop_key_rotation_and_power_epoch():
+    """Epoch on the signed C++ loop: after the height boundary the
+    rotated validator's OLD key must be rejected and the NEW key
+    accepted, and the host-fallback precommit quorum must use the new
+    powers."""
+    V = 4
+    old_seeds = [bytes([i + 1]) * 32 for i in range(V)]
+    new_seed2 = bytes([77]) * 32                 # validator 2 rotates
+    old_pub = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in old_seeds])
+    loop = NativeIngestLoop(1, V, n_slots=4, pubkeys=old_pub)
+    loop.sync_device(np.zeros(1, np.int64), np.zeros(1, np.int64))
+
+    loop.push(_signed_wire(old_seeds, [0], [2], [0], [0], [PV], [7]))
+    loop.build_phases()
+    assert loop.counters["rejected_signature"] == 0
+
+    # height boundary: sync to height 1, then the epoch
+    loop.sync_device(np.zeros(1, np.int64), np.ones(1, np.int64))
+    new_seeds = list(old_seeds)
+    new_seeds[2] = new_seed2
+    new_pub = old_pub.copy()
+    new_pub[2] = np.frombuffer(native.pubkey(new_seed2), np.uint8)
+    loop.set_validators(pubkeys=new_pub, powers=[5, 1, 1, 0])
+
+    # old key for validator 2 must now fail; new key must pass
+    loop.push(_signed_wire(old_seeds, [0], [2], [1], [0], [PV], [7]))
+    loop.build_phases()
+    assert loop.counters["rejected_signature"] == 1
+    loop.push(_signed_wire(new_seeds, [0], [2], [1], [0], [PV], [7]))
+    loop.build_phases()
+    assert loop.counters["rejected_signature"] == 1
+
+    # host-fallback quorum under the NEW powers: the window moved past
+    # round 0; precommits from {1, 2} weigh 2 of 7 (no event), adding
+    # validator 0 (weight 5) crosses and fires commit-from-any-round
+    loop.sync_device(np.full(1, 3, np.int64), np.ones(1, np.int64))
+    loop.push(_signed_wire(new_seeds, [0, 0], [1, 2], [1, 1], [0, 0],
+                           [PC, PC], [9, 9]))
+    loop.build_phases()
+    assert loop.drain_host_events() == []
+    loop.push(_signed_wire(new_seeds, [0], [0], [1], [0], [PC], [9]))
+    loop.build_phases()
+    assert loop.drain_host_events() == [(0, 1, 0, 9)]
+
+
+def test_batcher_power_epoch_matches_native():
+    """VoteBatcher.set_validators drives the same host-fallback quorum
+    decision as the native loop epoch (differential on the one surface
+    the batcher owns powers for)."""
+    V = 4
+    bat = VoteBatcher(1, V, n_slots=4)
+    bat.sync_device(np.full(1, 3, np.int64), np.zeros(1, np.int64))
+    bat.set_validators([5, 1, 1, 0])
+    bat.add_arrays([0, 0], [1, 2], [0, 0], [0, 0], [PC, PC], [9, 9])
+    bat.build_phases()
+    assert bat.drain_host_events() == []         # weight 2 of 7
+    bat.add_arrays([0], [0], [0], [0], [PC], [9])
+    bat.build_phases()
+    assert bat.drain_host_events() == [(0, 0, 0, 9)]
+
+
+def test_epoch_rejections():
+    """Pubkey upload on an unsigned loop and wrong shapes fail fast."""
+    loop = NativeIngestLoop(1, 4, n_slots=4)
+    with pytest.raises(ValueError, match="unsigned"):
+        loop.set_validators(pubkeys=np.zeros((4, 32), np.uint8))
+    with pytest.raises(ValueError, match="powers"):
+        loop.set_validators(powers=np.ones(3, np.int64))
+    bat = VoteBatcher(1, 4, n_slots=4)
+    with pytest.raises(ValueError, match="powers"):
+        bat.set_validators(np.ones(5, np.int64))
