@@ -105,6 +105,11 @@ def main():
     dt = timeit(lambda: pv.verify_batch_pallas(pub, sig, blocks))
     print(f"v2 fused kernel        B={B}: {dt*1e3:8.2f} ms  {B/dt:,.0f}/s")
 
+    # v2 with signed 5-bit windows (the r3-queued optimization; pick
+    # the faster of the two on hardware)
+    dt = timeit(lambda: pv.verify_batch_pallas(pub, sig, blocks, window=5))
+    print(f"v2 signed-5 windows    B={B}: {dt*1e3:8.2f} ms  {B/dt:,.0f}/s")
+
     # v2 host/XLA preprocessing alone (sha, digits, tiling — everything
     # except the pallas_call): bound by subtracting from the full time
     f = jax.jit(lambda s_, bl: (

@@ -132,3 +132,34 @@ def test_btable_is_multiples_of_base():
         assert ypx == (y + x) % ref.P
         assert ymx == (y - x) % ref.P
         assert t2d == 2 * ref.D * x * y % ref.P
+
+
+def test_fused_kernel_signed5_matches_oracle():
+    """The signed 5-bit window variant (window=5) must agree with the
+    RFC oracle on the same packed good+adversarial batch — including
+    the torsion lane (cofactored policy) and non-canonical encodings."""
+    pubs, msgs, sigs = _cases()
+    pub, sig, blocks = E.pack_verify_inputs_host(pubs, msgs, sigs)
+    got = np.asarray(
+        pv.verify_batch_pallas(pub, sig, blocks, interpret=True, window=5))
+    want = np.asarray([ref.verify(p, m, s)
+                       for p, m, s in zip(pubs, msgs, sigs)])
+    assert (got == want).all(), (got.tolist(), want.tolist())
+    assert want[:4].all() and want[17]
+
+
+def test_digits52_signed_roundtrip_and_range():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(5)
+    vals = [int.from_bytes(rng.bytes(32), "little") % (1 << 253)
+            for _ in range(8)] + [0, 1, (1 << 253) - 1, ref.L - 1]
+    limbs = jnp.stack([jnp.asarray([(v >> (13 * i)) & 0x1FFF
+                                    for i in range(20)], jnp.int32)
+                       for v in vals])
+    digs = np.asarray(pv._digits52_signed(limbs))   # [52, B] msb-first
+    assert digs.min() >= -16 and digs.max() <= 15
+    for b, v in enumerate(vals):
+        got = 0
+        for j in range(52):
+            got = got * 32 + int(digs[j, b])
+        assert got == v, (b, v, got)
