@@ -598,6 +598,27 @@ def verify_batch_pallas(pub: jnp.ndarray, sig: jnp.ndarray,
     cost per shape)."""
     if window not in (4, 5):
         raise ValueError(f"window must be 4 or 5: {window}")
+    if interpret:
+        # NEVER persist the interpret-mode executable: XLA's cache
+        # writer segfaults intermittently serializing these ~100k-op
+        # graphs (r4: reproduced across stack limits, single-threaded
+        # codegen, and fresh cache dirs — put_executable_and_time every
+        # time; see utils/compile_cache.py for the related, genuinely
+        # fixed failure modes).  Interpret mode is tests-only; paying
+        # the recompile beats a nondeterministic CI segfault.
+        # jax LATCHES the enabled decision in module globals
+        # (compilation_cache.is_cache_used "once per task"), so the
+        # config flip only takes effect across a reset_cache().
+        from jax._src import compilation_cache as _cc
+
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        _cc.reset_cache()
+        try:
+            return _verify_jit(pub, sig, msg_blocks, True, window)
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
+            _cc.reset_cache()
     return _verify_jit(pub, sig, msg_blocks, interpret, window)
 
 

@@ -66,11 +66,20 @@ if __name__ == "__main__" and _backend_hung():
                 "no stage was run"}))
     sys.exit(0)
 
+# the XLA:CPU codegen/serialization race workaround must land in
+# XLA_FLAGS before ANY agnes/jax import can initialize a backend
+# (package __init__ side effects create device arrays) — see
+# agnes_tpu/utils/compile_cache.py
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_cpu_parallel_codegen_split_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+
 import jax
 
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               ".jax_cache"))
+from agnes_tpu.utils.compile_cache import configure as _configure_cache
+
+_configure_cache(jax)      # per-host sub-dir: cross-machine AOT entries segfault
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 

@@ -26,11 +26,20 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# the XLA:CPU codegen/serialization race workaround must land in
+# XLA_FLAGS before ANY agnes/jax import can initialize a backend
+# (package __init__ side effects create device arrays) — see
+# agnes_tpu/utils/compile_cache.py
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_cpu_parallel_codegen_split_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+
 import jax  # noqa: E402
 
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__))), ".jax_cache"))
+from agnes_tpu.utils.compile_cache import configure as _configure_cache
+
+_configure_cache(jax)
 
 import bench  # noqa: E402
 from agnes_tpu.utils.tracing import Tracer  # noqa: E402

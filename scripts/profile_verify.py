@@ -13,17 +13,24 @@ import os
 import sys
 import time
 
+# the XLA:CPU codegen/serialization race workaround must land in
+# XLA_FLAGS before ANY agnes/jax import can initialize a backend
+# (package __init__ side effects create device arrays) — see
+# agnes_tpu/utils/compile_cache.py
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_cpu_parallel_codegen_split_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__))), ".jax_cache"))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from agnes_tpu.utils.compile_cache import configure as _configure_cache
+_configure_cache(jax)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from agnes_tpu.core import native
 from agnes_tpu.crypto import ed25519_jax as E

@@ -1,9 +1,23 @@
 """TPU correctness + honest-timing test for the fused verify kernel."""
+import os
 import time
 import numpy as np
+
+# the XLA:CPU codegen/serialization race workaround must land in
+# XLA_FLAGS before ANY agnes/jax import can initialize a backend
+# (package __init__ side effects create device arrays) — see
+# agnes_tpu/utils/compile_cache.py
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_cpu_parallel_codegen_split_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+
 import jax
 
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from agnes_tpu.utils.compile_cache import configure as _configure_cache
+_configure_cache(jax)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 

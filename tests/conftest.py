@@ -18,21 +18,32 @@ import sys
 # which is after this file runs — env assignment here is early enough.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_parallel_codegen_split_count" not in flags:
+    # this jaxlib's XLA:CPU has a data race between its parallel
+    # codegen threads and executable serialization (TSAN-confirmed in
+    # ThunkEmitter::ConsumeKernels; intermittent segfaults in the
+    # persistent-cache read/write paths, r4).  Single-threaded codegen
+    # removes the racing threads; see utils/compile_cache.py.
+    flags = (flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+os.environ["XLA_FLAGS"] = flags
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 try:
     import jax
+
     jax.config.update("jax_platforms", "cpu")
     # persistent compile cache: the crypto scan bodies cost minutes to
-    # compile on this toolchain; cache them across test runs
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(os.path.dirname(os.path.dirname(
-                          os.path.abspath(__file__))), ".jax_cache"))
+    # compile on this toolchain; cache them across test runs.  The
+    # path is keyed per host CPU (utils/compile_cache.py): multiple
+    # machines share this repo across rounds, and loading an XLA:CPU
+    # AOT entry compiled on a richer-ISA host segfaults (observed r4).
+    from agnes_tpu.utils.compile_cache import configure as _configure_cache
+
+    _configure_cache(jax)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 except ImportError:  # pure-core tests don't need jax
     pass
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

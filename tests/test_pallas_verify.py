@@ -151,8 +151,12 @@ def test_fused_kernel_signed5_matches_oracle():
 def test_digits52_signed_roundtrip_and_range():
     import jax.numpy as jnp
     rng = np.random.RandomState(5)
+    # includes bit-255-set values: attacker-controlled S reaches the
+    # recoder before the canonicity screen, and the top window must
+    # absorb raw[51] <= 1 plus the incoming carry
     vals = [int.from_bytes(rng.bytes(32), "little") % (1 << 253)
-            for _ in range(8)] + [0, 1, (1 << 253) - 1, ref.L - 1]
+            for _ in range(8)] + [0, 1, (1 << 253) - 1, ref.L - 1,
+                                  1 << 255, (1 << 256) - 1]
     limbs = jnp.stack([jnp.asarray([(v >> (13 * i)) & 0x1FFF
                                     for i in range(20)], jnp.int32)
                        for v in vals])
