@@ -594,8 +594,10 @@ def verify_batch_pallas(pub: jnp.ndarray, sig: jnp.ndarray,
     hardware, scripts/profile_verify.py).
 
     Always runs jitted (the ~100k-op kernel graph is unusable under
-    eager dispatch; the persistent compile cache absorbs the one-time
-    cost per shape)."""
+    eager dispatch).  The persistent compile cache is disabled
+    framework-wide (utils/compile_cache.py post-mortem), so each
+    process pays one compile per (shape, window, interpret) combo —
+    reuse one batch shape per process."""
     if window not in (4, 5):
         raise ValueError(f"window must be 4 or 5: {window}")
     if interpret:

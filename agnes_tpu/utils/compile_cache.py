@@ -1,69 +1,46 @@
-"""Per-host persistent-compile-cache path (+ serializer stack room).
+"""JAX compilation-cache policy: DISABLED, plus XLA:CPU de-racing.
 
-The repo's .jax_cache is visited by MULTIPLE machines across rounds
-(this build VM, the bench driver's host, the axon remote-compile
-relay), whose CPUs differ in ISA features (AMX/AVX512 sets,
-prefer-no-scatter).  XLA:CPU AOT executables are feature-specific:
-loading an entry compiled on a richer host SIGILLs/segfaults here —
-observed as a segfault inside compilation_cache.get_executable_and_time
-during the round-4 full-suite run.  Keying the cache directory by a
-host fingerprint keeps every machine's entries separate while still
-persisting across processes and rounds on the same machine.
+Round-4 evidence forced this policy.  The persistent compile cache
+(.jax_cache) produced four distinct segfault modes in this
+environment before being abandoned:
 
-Separately, SERIALIZING the very largest executables (the ~100k-op
-interpret-mode fused verify kernels) segfaults XLA's cache writer
-intermittently (put_executable_and_time) — r4 reproduced the crash
-across stack limits (8 MiB and `ulimit -s 65536`), across
-single-threaded codegen, and across fresh cache dirs.  Those graphs
-are therefore NEVER persisted: crypto/pallas_verify.py disables the
-compilation cache around interpret-mode compiles (tests-only path; a
-deterministic recompile beats a nondeterministic CI segfault).
-Normal-size executables — everything the production TPU/CPU paths
-compile — serialize fine and stay cached."""
+  * loading entries written by a different-ISA machine (the repo is
+    visited by several hosts across rounds) SIGILLs — XLA:CPU AOT
+    executables are CPU-feature-specific;
+  * a data race between XLA:CPU's parallel codegen threads and
+    executable serialization (TSAN-confirmed in
+    ThunkEmitter::ConsumeKernels) crashed cache WRITES intermittently;
+  * the ~100k-op interpret-mode Pallas kernels crashed the serializer
+    across every mitigation tried (stack ulimits, single-threaded
+    codegen, fresh cache dirs);
+  * and each mid-write crash can leave a torn entry that then crashes
+    subsequent READS — cascading corruption (observed: a same-host
+    entry segfaulting get_executable_and_time after earlier write
+    crashes).
+
+Per-host cache keying (a /proc/cpuinfo fingerprint sub-directory)
+fixed only the first mode.  Correctness wins: no code path sets a
+cache directory any more — every process pays its own compiles — and
+entry points apply `serialize_cpu_codegen`'s de-race flag in the
+environment before any agnes/jax import (package __init__ side
+effects initialize the backend early).  Revisit if jaxlib updates.
+"""
 
 from __future__ import annotations
 
-import hashlib
 import os
-import platform
-
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-
-
-def cache_dir(root: str = os.path.join(_REPO_ROOT, ".jax_cache")) -> str:
-    try:
-        with open("/proc/cpuinfo") as f:
-            # "flags" on x86, "Features" on aarch64 — both must fold
-            # into the tag or same-arch hosts with different ISA
-            # extensions would share AOT entries (the exact segfault
-            # this module prevents)
-            flags = next((ln for ln in f
-                          if ln.startswith(("flags", "Features"))), "")
-    except OSError:
-        flags = ""
-    tag = hashlib.sha256(
-        (platform.machine() + flags).encode()).hexdigest()[:12]
-    return os.path.join(root, tag)
 
 
 def serialize_cpu_codegen() -> None:
     """Work around a data race in this jaxlib's XLA:CPU between its
     parallel codegen threads and executable serialization
-    (TSAN-confirmed in ThunkEmitter::ConsumeKernels; intermittent
-    segfaults inside compilation_cache get/put, r4): single-threaded
+    (TSAN-confirmed in ThunkEmitter::ConsumeKernels): single-threaded
     codegen removes the racing threads.  Must run before the first
-    backend use — XLA_FLAGS is read at client creation."""
+    backend use — XLA_FLAGS is read at client creation, and importing
+    most agnes modules initializes a backend, so entry points also set
+    this in the environment before any agnes/jax import."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_cpu_parallel_codegen_split_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
 
-
-def configure(jax_module) -> str:
-    """Point jax's persistent cache at this host's sub-directory and
-    de-race XLA:CPU codegen."""
-    serialize_cpu_codegen()
-    d = cache_dir()
-    jax_module.config.update("jax_compilation_cache_dir", d)
-    return d

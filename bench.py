@@ -77,12 +77,6 @@ if "xla_cpu_parallel_codegen_split_count" not in _flags:
 
 import jax
 
-from agnes_tpu.utils.compile_cache import configure as _configure_cache
-
-_configure_cache(jax)      # per-host sub-dir: cross-machine AOT entries segfault
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-
 import jax.numpy as jnp
 import numpy as np
 

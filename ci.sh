@@ -20,11 +20,6 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-# generous stack for XLA's compile-cache serializer (the giant
-# interpret-mode kernels additionally never persist at all —
-# utils/compile_cache.py has the full failure-mode story)
-ulimit -s 65536 2>/dev/null || true
-
 echo "=== [1/3] ASan+UBSan: native differential + C-ABI fuzz ==="
 ASAN_SO="$(g++ -print-file-name=libasan.so)"
 UBSAN_SO="$(g++ -print-file-name=libubsan.so)"

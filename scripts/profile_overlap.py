@@ -37,10 +37,6 @@ if "xla_cpu_parallel_codegen_split_count" not in _flags:
 
 import jax  # noqa: E402
 
-from agnes_tpu.utils.compile_cache import configure as _configure_cache
-
-_configure_cache(jax)
-
 import bench  # noqa: E402
 from agnes_tpu.utils.tracing import Tracer  # noqa: E402
 

@@ -14,12 +14,8 @@ if "xla_cpu_parallel_codegen_split_count" not in _flags:
 
 import jax
 
-import sys, os
+import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from agnes_tpu.utils.compile_cache import configure as _configure_cache
-_configure_cache(jax)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 from agnes_tpu.core import native
 from agnes_tpu.crypto import ed25519_jax as E

@@ -35,15 +35,8 @@ try:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    # persistent compile cache: the crypto scan bodies cost minutes to
-    # compile on this toolchain; cache them across test runs.  The
-    # path is keyed per host CPU (utils/compile_cache.py): multiple
-    # machines share this repo across rounds, and loading an XLA:CPU
-    # AOT entry compiled on a richer-ISA host segfaults (observed r4).
-    from agnes_tpu.utils.compile_cache import configure as _configure_cache
-
-    _configure_cache(jax)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # NO persistent compile cache: it segfaulted four different ways
+    # in this environment (utils/compile_cache.py module docstring has
+    # the post-mortem); every run pays its own compiles.
 except ImportError:  # pure-core tests don't need jax
     pass
