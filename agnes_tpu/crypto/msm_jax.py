@@ -39,6 +39,19 @@ when it fails.
 The reference engine has no crypto at all (votes are unsigned,
 SURVEY.md §2.1; signing stubbed at reference consensus_executor.rs:
 35-41); this module is part of the added TPU data plane.
+
+MEASURED ROLE (r4, TPU v5e): the log-depth formulation does NOT win
+on real hardware — the segmented scan costs O(N log N) lane
+point-adds (log₂N levels per window × 33 windows ≈ 460 full-lane
+adds at N=16k, about the same add count as per-lane Straus' ~390)
+plus 33 argsort+gather rounds, which the TPU memory system hates:
+15.4k verifies/s vs the fused per-lane kernel's 1.41M/s
+(scripts/profile_verify.py).  The per-lane Pallas kernel
+(pallas_verify.py) is therefore the production path on TPU;
+this module remains the amortized-soundness ALTERNATIVE (one
+combined equation certifying a whole batch — a property the
+per-lane path cannot offer) and the cross-check oracle in
+tests/test_cofactored.py.
 """
 
 from __future__ import annotations

@@ -21,9 +21,21 @@ environment before being abandoned:
 Per-host cache keying (a /proc/cpuinfo fingerprint sub-directory)
 fixed only the first mode.  Correctness wins: no code path sets a
 cache directory any more — every process pays its own compiles — and
-entry points apply `serialize_cpu_codegen`'s de-race flag in the
-environment before any agnes/jax import (package __init__ side
-effects initialize the backend early).  Revisit if jaxlib updates.
+every entry point (conftest, bench, scripts) inlines the de-race
+XLA_FLAGS snippet below in the environment before any agnes/jax
+import (package __init__ side effects initialize the backend early,
+so calling into this module would already be too late — which is why
+the snippet is inlined rather than imported).  `disable_persistent_
+cache()` additionally pins the cache OFF in-process so a leftover
+JAX_COMPILATION_CACHE_DIR in the environment cannot re-enable the
+segfault modes above.  Revisit if jaxlib updates.
+
+The canonical de-race snippet (keep entry-point copies in sync):
+
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_parallel_codegen_split_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
 """
 
 from __future__ import annotations
@@ -31,16 +43,16 @@ from __future__ import annotations
 import os
 
 
-def serialize_cpu_codegen() -> None:
-    """Work around a data race in this jaxlib's XLA:CPU between its
-    parallel codegen threads and executable serialization
-    (TSAN-confirmed in ThunkEmitter::ConsumeKernels): single-threaded
-    codegen removes the racing threads.  Must run before the first
-    backend use — XLA_FLAGS is read at client creation, and importing
-    most agnes modules initializes a backend, so entry points also set
-    this in the environment before any agnes/jax import."""
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_cpu_parallel_codegen_split_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+def disable_persistent_cache() -> None:
+    """Pin the persistent compile cache OFF for this process even if
+    the environment sets JAX_COMPILATION_CACHE_DIR (the pre-r4
+    documented workflow): jax reads that env var at config init, so
+    omission alone does not guarantee the disabled policy."""
+    os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_enable_compilation_cache", False)
+    except AttributeError:      # config name drift across jax versions
+        pass
 

@@ -11,8 +11,6 @@ import os
 import sys
 import time
 
-import os
-
 # de-race XLA:CPU codegen before any backend init (compile_cache.py)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_cpu_parallel_codegen_split_count" not in _flags:

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Wait for the axon TPU claim to clear, then run bench.py, saving the
+# JSON + stage log.  Run inside tmux so an interactive-shell timeout
+# can never kill the TPU claim mid-flight (a killed claim wedges the
+# relay for a long time — .claude/skills/verify/SKILL.md gotchas).
+set -u
+OUT=${1:-/tmp/bench_r04.json}
+LOG=${2:-/tmp/bench_r04.log}
+cd /root/repo
+echo "[runner] probing for TPU..." >> "$LOG"
+while true; do
+    if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "[runner] TPU alive at $(date)" >> "$LOG"
+        break
+    fi
+    echo "[runner] still wedged at $(date); sleeping 120s" >> "$LOG"
+    sleep 120
+done
+python bench.py > "$OUT" 2>> "$LOG"
+echo "[runner] bench rc=$? at $(date)" >> "$LOG"

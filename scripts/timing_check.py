@@ -1,0 +1,67 @@
+"""Resolve the jit-vs-eager timing discrepancy for the v2 verify kernel.
+
+Times each candidate path two ways: pipelined (queue all iters, block at
+the end — throughput) and serial (block every iter — latency), at two
+batch sizes.
+"""
+import os
+import sys
+import time
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_cpu_parallel_codegen_split_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from agnes_tpu.core import native
+from agnes_tpu.crypto import ed25519_jax as E
+from agnes_tpu.crypto import pallas_verify as pv
+from agnes_tpu.crypto.encoding import vote_signing_bytes
+
+
+def fixtures(B):
+    seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(B)]
+    msgs = [vote_signing_bytes(1, 0, 0, i % 7) for i in range(B)]
+    pks = [native.pubkey(s) for s in seeds]
+    sigs = [native.sign(s, m) for s, m in zip(seeds, msgs)]
+    return E.pack_verify_inputs_host(pks, msgs, sigs)
+
+
+def bench(name, fn, args, iters=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # pipelined
+    t0 = time.perf_counter()
+    outs = [fn(*args) for _ in range(iters)]
+    for o in outs:
+        jax.block_until_ready(o)
+    piped = (time.perf_counter() - t0) / iters
+    # serial
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fn(*args))
+    serial = (time.perf_counter() - t0) / iters
+    B = args[0].shape[0]
+    print(f"{name:28s} B={B:6d}  piped {piped*1e3:8.2f} ms {B/piped:>11,.0f}/s"
+          f"   serial {serial*1e3:8.2f} ms {B/serial:>11,.0f}/s", flush=True)
+
+
+def main():
+    for B in (16384, 65536):
+        pub, sig, blocks = fixtures(B)
+        jit_v2 = jax.jit(pv.verify_batch_pallas)
+        jit_v2_w5 = jax.jit(lambda p, s, b: pv.verify_batch_pallas(
+            p, s, b, window=5))
+        bench("eager v2", pv.verify_batch_pallas, (pub, sig, blocks))
+        bench("jit v2", jit_v2, (pub, sig, blocks))
+        bench("jit v2 window=5", jit_v2_w5, (pub, sig, blocks))
+        bench("jit v1 verify_batch", E.verify_batch_jit, (pub, sig, blocks))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,10 @@ try:
     jax.config.update("jax_platforms", "cpu")
     # NO persistent compile cache: it segfaulted four different ways
     # in this environment (utils/compile_cache.py module docstring has
-    # the post-mortem); every run pays its own compiles.
+    # the post-mortem); every run pays its own compiles.  Enforced, not
+    # just omitted — a leftover JAX_COMPILATION_CACHE_DIR env var from
+    # the pre-r4 workflow must not silently re-enable it.
+    from agnes_tpu.utils.compile_cache import disable_persistent_cache
+    disable_persistent_cache()
 except ImportError:  # pure-core tests don't need jax
     pass
