@@ -255,3 +255,103 @@ consensus_step_jit = jax.jit(consensus_step,
                              static_argnames=("axis_name", "advance_height"))
 
 N_STAGES = 7
+
+
+def consensus_step_seq(state: DeviceState,
+                       tally: TallyState,
+                       exts: ExtEvent,      # [P, I] leaves
+                       phases: VotePhase,   # [P, I(, V)] leaves
+                       powers: jnp.ndarray,
+                       total_power: jnp.ndarray,
+                       proposer_flag: jnp.ndarray,
+                       propose_value: jnp.ndarray,
+                       axis_name: str | None = None,
+                       advance_height: bool = False,
+                       ) -> StepOutputs:
+    """P sequential fused steps in ONE traced computation: `lax.scan`
+    over the leading axis of `exts`/`phases`, so a whole delivery
+    sequence (e.g. the dedup layers of one vote class, or a height's
+    entry + prevote + precommit) is a single device dispatch.
+
+    Why this exists: each dispatch on the axon-tunneled TPU costs
+    ~60-70ms in fixed host/tunnel overhead regardless of the work in
+    it (scripts/timing_check.py, r4) — phase-at-a-time stepping is
+    overhead-bound long before the chip is busy.  Keeping the loop on
+    device is also the XLA-idiomatic shape: the scanned body compiles
+    once, and no host round-trip separates the phases.
+
+    msgs leaves come back stacked [P, n_stages, I]."""
+
+    def body(carry, xs):
+        st, ta = carry
+        ext, phase = xs
+        out = consensus_step(st, ta, ext, phase, powers, total_power,
+                             proposer_flag, propose_value,
+                             axis_name=axis_name,
+                             advance_height=advance_height)
+        return (out.state, out.tally), out.msgs
+
+    (state, tally), msgs = jax.lax.scan(body, (state, tally),
+                                        (exts, phases))
+    return StepOutputs(state=state, tally=tally, msgs=msgs)
+
+
+consensus_step_seq_jit = jax.jit(
+    consensus_step_seq, static_argnames=("axis_name", "advance_height"))
+
+
+def honest_heights(state: DeviceState,
+                   tally: TallyState,
+                   slots: jnp.ndarray,      # [I, V] value slot votes
+                   mask: jnp.ndarray,       # [I, V] voter mask
+                   powers: jnp.ndarray,
+                   total_power: jnp.ndarray,
+                   proposer_flag: jnp.ndarray,
+                   propose_value: jnp.ndarray,
+                   heights: int,
+                   axis_name: str | None = None,
+                   ) -> StepOutputs:
+    """`heights` consecutive honest heights — entry step, full prevote
+    phase, full precommit phase, decision, stage-8 height advance — in
+    ONE device dispatch (`lax.scan` over heights; the phases take their
+    round/height from the carried state, so nothing round-trips the
+    host).  This is the reference's intended top-level loop
+    (consensus_executor.rs:24-49) run entirely on device, H heights at
+    a time.
+
+    msgs leaves come back stacked [H, 3, n_stages, I]."""
+    n = state.round.shape[0]
+
+    def phase_of(st, typ_code, sl, mk):
+        return VotePhase(round=st.round,
+                         typ=jnp.full_like(st.round, typ_code),
+                         slots=sl, mask=mk, height=st.height)
+
+    def one(st, ta, phase):
+        return consensus_step(st, ta, ExtEvent.none(n), phase,
+                              powers, total_power, proposer_flag,
+                              propose_value, axis_name=axis_name,
+                              advance_height=True)
+
+    def height_body(carry, _):
+        st, ta = carry
+        out0 = one(st, ta, phase_of(st, 0, jnp.full_like(slots, -1),
+                                    jnp.zeros_like(mask)))
+        out1 = one(out0.state, out0.tally,
+                   phase_of(out0.state, int(VoteType.PREVOTE), slots, mask))
+        out2 = one(out1.state, out1.tally,
+                   phase_of(out1.state, int(VoteType.PRECOMMIT), slots,
+                            mask))
+        msgs = DeviceMessage(*[
+            jnp.stack([getattr(m, f) for m in
+                       (out0.msgs, out1.msgs, out2.msgs)])
+            for f in DeviceMessage._fields])
+        return (out2.state, out2.tally), msgs
+
+    (state, tally), msgs = jax.lax.scan(height_body, (state, tally),
+                                        None, length=heights)
+    return StepOutputs(state=state, tally=tally, msgs=msgs)
+
+
+honest_heights_jit = jax.jit(
+    honest_heights, static_argnames=("heights", "axis_name"))

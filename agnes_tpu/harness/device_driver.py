@@ -34,6 +34,8 @@ from agnes_tpu.device.step import (
     NULL_EVENT,
     VotePhase,
     consensus_step_jit,
+    consensus_step_seq_jit,
+    honest_heights_jit,
 )
 from agnes_tpu.device.tally import TallyConfig, TallyState
 from agnes_tpu.types import NIL_ID, VoteType
@@ -186,15 +188,47 @@ class DeviceDriver:
             self._collect(out.msgs)
         return out.msgs
 
+    def step_seq(self, phases, exts=None) -> "jnp.ndarray":
+        """P fused steps in ONE device dispatch (consensus_step_seq):
+        `phases` is a list of VotePhase (e.g. every dedup layer of a
+        built vote class), `exts` an optional matching list.  Identical
+        semantics to P step() calls — tests/test_step_seq.py holds the
+        two paths equal leaf-for-leaf — at 1/P the dispatch overhead."""
+        assert self.mesh is None, "step_seq is single-device for now"
+        P = len(phases)
+        exts = exts if exts is not None else [self.ext()] * P
+        phases_st = jax.tree.map(lambda *xs: jnp.stack(xs), *phases)
+        exts_st = jax.tree.map(lambda *xs: jnp.stack(xs), *exts)
+        out = consensus_step_seq_jit(self.state, self.tally, exts_st,
+                                     phases_st, self.powers, self.total,
+                                     self.proposer_flag, self.propose_value,
+                                     advance_height=self.advance_height)
+        self.state, self.tally = out.state, out.tally
+        self.stats.steps += P
+        self.stats.votes_ingested += int(
+            sum(int(np.asarray(p.mask).sum()) for p in phases))
+        if self.defer_collect:
+            self._deferred_msgs.append(out.msgs)
+        else:
+            self._collect(out.msgs)
+        return out.msgs
+
     def _collect(self, msgs) -> None:
-        tags = np.asarray(msgs.tag)            # [stages, I]
-        decided_now = (tags == int(MsgTag.DECISION)).any(axis=0)
-        self.stats.decisions_total += int(decided_now.sum())
-        if decided_now.any():
-            stage = (np.asarray(msgs.tag) == int(MsgTag.DECISION)).argmax(0)
+        """Fold one message batch into the stats.  Leaves are
+        [stages, I] from step(), or [P, ..., stages, I] from step_seq/
+        run_heights_fused — the leading sequence axes flatten into the
+        stage axis (step order is preserved, so first-decision latching
+        is unchanged); decisions_total counts every DECISION message,
+        which with height advance is one per (instance, height)."""
+        tags = np.asarray(msgs.tag).reshape(-1, self.I)
+        dec = tags == int(MsgTag.DECISION)
+        self.stats.decisions_total += int(dec.sum())
+        if dec.any():
+            decided_now = dec.any(axis=0)
+            stage = dec.argmax(0)
             rows = np.arange(self.I)
-            val = np.asarray(msgs.value)[stage, rows]
-            rnd = np.asarray(msgs.round)[stage, rows]
+            val = np.asarray(msgs.value).reshape(-1, self.I)[stage, rows]
+            rnd = np.asarray(msgs.round).reshape(-1, self.I)[stage, rows]
             new = decided_now & ~self.stats.decided
             self.stats.decision_value[new] = val[new]
             self.stats.decision_round[new] = rnd[new]
@@ -240,6 +274,33 @@ class DeviceDriver:
         assert self.advance_height, "construct with advance_height=True"
         for _ in range(n_heights):
             self.run_honest_round(0, slot)
+
+    def run_heights_fused(self, n_heights: int, slot: int = 1,
+                          frac: float = 1.0) -> None:
+        """run_heights in ONE device dispatch (honest_heights_jit: a
+        lax.scan over heights whose phases take round/height from the
+        carried state).  Equivalent to run_heights — held equal by
+        tests/test_step_seq.py — with 1/(3H) the dispatch overhead;
+        this is what lets config-4-shape multi-height throughput run
+        at device speed on the tunneled TPU."""
+        assert self.advance_height, "construct with advance_height=True"
+        assert self.mesh is None, "fused heights are single-device for now"
+        voters = jnp.arange(self.V) < round_half_up(frac * self.V)
+        slots = jnp.where(voters[None, :], slot, -1).astype(I32) \
+            * jnp.ones((self.I, 1), I32)
+        mask = jnp.broadcast_to(voters[None, :], (self.I, self.V))
+        out = honest_heights_jit(self.state, self.tally, slots, mask,
+                                 self.powers, self.total,
+                                 self.proposer_flag, self.propose_value,
+                                 heights=n_heights)
+        self.state, self.tally = out.state, out.tally
+        self.stats.steps += 3 * n_heights
+        self.stats.votes_ingested += 2 * n_heights * int(
+            np.asarray(mask).sum())
+        if self.defer_collect:
+            self._deferred_msgs.append(out.msgs)
+        else:
+            self._collect(out.msgs)
 
     def run_equivocation_phase(self, round: int, typ: VoteType,
                                slot_a: int, slot_b: int,

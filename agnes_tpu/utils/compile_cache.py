@@ -21,11 +21,13 @@ environment before being abandoned:
 Per-host cache keying (a /proc/cpuinfo fingerprint sub-directory)
 fixed only the first mode.  Correctness wins: no code path sets a
 cache directory any more — every process pays its own compiles — and
-every entry point (conftest, bench, scripts) inlines the de-race
-XLA_FLAGS snippet below in the environment before any agnes/jax
-import (package __init__ side effects initialize the backend early,
-so calling into this module would already be too late — which is why
-the snippet is inlined rather than imported).  `disable_persistent_
+every entry point inlines the de-race XLA_FLAGS snippet below in the
+environment before any agnes/jax import (package __init__ side
+effects initialize the backend early, so calling into this module
+would already be too late — which is why the snippet is inlined
+rather than imported).  `python -m agnes_tpu.harness.configs` cannot
+even inline it (the package import precedes the module body under
+-m); its wrapper scripts/run_hw_suite.sh exports the policy instead.  `disable_persistent_
 cache()` additionally pins the cache OFF in-process so a leftover
 JAX_COMPILATION_CACHE_DIR in the environment cannot re-enable the
 segfault modes above.  Revisit if jaxlib updates.

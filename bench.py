@@ -84,7 +84,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from agnes_tpu.device.encoding import DeviceState
-from agnes_tpu.device.step import ExtEvent, VotePhase, consensus_step_jit
 from agnes_tpu.device.tally import TallyConfig, TallyState
 from agnes_tpu.types import VoteType
 
@@ -99,62 +98,39 @@ def _sync(x) -> None:
     np.asarray(leaf).ravel()[:1]
 
 
-def _empty_phase(I, V, state):
-    return VotePhase(
-        round=jnp.zeros(I, jnp.int32), typ=jnp.zeros(I, jnp.int32),
-        slots=jnp.full((I, V), -1, jnp.int32),
-        mask=jnp.zeros((I, V), bool), height=state.height)
-
-
 def bench_tally(n_instances: int = 4096, n_validators: int = 1024,
                 heights: int = 8) -> float:
     """Device-plane ingestion rate with FRESH votes: each iteration is
     one honest height (entry + prevote phase + precommit phase); the
     height-advance stage resets for the next — no vote is ever a dedup
-    replay (VERDICT r2 weak #3)."""
+    replay (VERDICT r2 weak #3).  All `heights` heights run in ONE
+    dispatch (device/step.py honest_heights: lax.scan over heights) —
+    phase-at-a-time stepping was ~60-70ms/dispatch tunnel-overhead
+    bound, not device bound (scripts/timing_check.py r4)."""
+    from agnes_tpu.device.step import honest_heights_jit
+
     I, V = n_instances, n_validators
     cfg = TallyConfig(n_validators=V, n_rounds=4, n_slots=4)
-
     state = DeviceState.new((I,))
     tally = TallyState.new(I, cfg)
-    ext = ExtEvent.none(I)
     powers = jnp.ones((V,), jnp.int32)
     total = jnp.asarray(V, jnp.int32)
     proposer_flag = jnp.ones((I, cfg.n_rounds), bool)
     propose_value = jnp.full(I, 1, jnp.int32)
-    voters = jnp.ones((V,), bool)
+    slots = jnp.ones((I, V), jnp.int32)
+    mask = jnp.ones((I, V), bool)
 
-    def phase(state, typ):
-        return VotePhase(
-            round=jnp.zeros(I, jnp.int32),
-            typ=jnp.full(I, int(typ), jnp.int32),
-            slots=jnp.ones((I, V), jnp.int32),
-            mask=jnp.broadcast_to(voters[None, :], (I, V)),
-            height=state.height)
-
-    def height_loop(state, tally):
-        out = consensus_step_jit(state, tally, ext,
-                                 _empty_phase(I, V, state),
-                                 powers, total, proposer_flag, propose_value,
-                                 advance_height=True)
-        state, tally = out.state, out.tally
-        out = consensus_step_jit(state, tally, ext,
-                                 phase(state, VoteType.PREVOTE),
-                                 powers, total, proposer_flag, propose_value,
-                                 advance_height=True)
-        state, tally = out.state, out.tally
-        out = consensus_step_jit(state, tally, ext,
-                                 phase(state, VoteType.PRECOMMIT),
-                                 powers, total, proposer_flag, propose_value,
-                                 advance_height=True)
+    def run(state, tally):
+        out = honest_heights_jit(state, tally, slots, mask, powers, total,
+                                 proposer_flag, propose_value,
+                                 heights=heights)
         return out.state, out.tally
 
-    state, tally = height_loop(state, tally)     # warmup + compile
+    state, tally = run(state, tally)             # warmup + compile
     _sync(state)
     h0 = int(np.asarray(state.height)[0])
     t0 = time.perf_counter()
-    for _ in range(heights):
-        state, tally = height_loop(state, tally)
+    state, tally = run(state, tally)
     _sync(state)
     dt = time.perf_counter() - t0
     assert int(np.asarray(state.height)[0]) == h0 + heights
@@ -223,11 +199,11 @@ def bench_decisions(n_instances: int = 10000, n_validators: int = 1024,
     from agnes_tpu.harness.device_driver import DeviceDriver
 
     d = DeviceDriver(n_instances, n_validators, advance_height=True)
-    d.run_heights(1)       # warmup + compile all step shapes
+    d.run_heights_fused(heights)   # warmup + compile (same static H)
     _sync(d.state)
     base = d.stats.decisions_total
     t0 = time.perf_counter()
-    d.run_heights(heights)
+    d.run_heights_fused(heights)   # ONE dispatch for all H heights
     _sync(d.state)
     dt = time.perf_counter() - t0
     assert d.stats.decisions_total - base == n_instances * heights

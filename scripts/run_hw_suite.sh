@@ -1,0 +1,44 @@
+#!/bin/bash
+# Claim-safe hardware measurement suite: wait for the axon TPU to be
+# reachable, then run, in one sequence (never concurrently — one TPU
+# process at a time):
+#   1. bench.py                 -> $OUTDIR/bench.json
+#   2. harness configs 4 and 2  -> $OUTDIR/config4.json / config2.json
+#   3. profile_verify.py        -> $OUTDIR/profile_verify.txt
+# Run detached (setsid nohup) so an interactive-shell timeout can never
+# kill a TPU claim mid-flight (.claude/skills/verify/SKILL.md gotchas).
+set -u
+OUTDIR=${1:-/tmp/hw_r04}
+mkdir -p "$OUTDIR"
+LOG="$OUTDIR/runner.log"
+cd /root/repo
+# Framework-wide compile-cache/codegen policy for every python below
+# (incl. `-m agnes_tpu.harness.configs`, whose package import inits the
+# backend before any in-module guard could run — compile_cache.py):
+unset JAX_COMPILATION_CACHE_DIR
+case "${XLA_FLAGS:-}" in
+    *xla_cpu_parallel_codegen_split_count*) ;;
+    *) export XLA_FLAGS="${XLA_FLAGS:-} --xla_cpu_parallel_codegen_split_count=1" ;;
+esac
+echo "[runner] probing for TPU from $(date)" >> "$LOG"
+while true; do
+    if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "[runner] TPU alive at $(date)" >> "$LOG"
+        break
+    fi
+    echo "[runner] unreachable at $(date); sleeping 180s" >> "$LOG"
+    sleep 180
+done
+echo "[runner] bench.py start $(date)" >> "$LOG"
+python bench.py > "$OUTDIR/bench.json" 2>> "$LOG"
+echo "[runner] bench rc=$? end $(date)" >> "$LOG"
+echo "[runner] config4 start $(date)" >> "$LOG"
+python -m agnes_tpu.harness.configs 4 > "$OUTDIR/config4.json" 2>> "$LOG"
+echo "[runner] config4 rc=$? end $(date)" >> "$LOG"
+echo "[runner] config2 start $(date)" >> "$LOG"
+python -m agnes_tpu.harness.configs 2 > "$OUTDIR/config2.json" 2>> "$LOG"
+echo "[runner] config2 rc=$? end $(date)" >> "$LOG"
+echo "[runner] profile_verify start $(date)" >> "$LOG"
+python scripts/profile_verify.py > "$OUTDIR/profile_verify.txt" 2>> "$LOG"
+echo "[runner] profile_verify rc=$? end $(date)" >> "$LOG"
+echo "[runner] ALL DONE $(date)" >> "$LOG"

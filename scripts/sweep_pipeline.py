@@ -1,0 +1,50 @@
+"""Sweep the end-to-end pipeline over (I, V) shapes on the real chip.
+
+The headline `pipeline_votes_per_sec` is fixed-cost-dominated on the
+axon tunnel (~60-70ms per dispatch; scripts/timing_check.py), so the
+votes-per-height 2*I*V against the dispatches-per-height (~8) sets the
+ceiling.  This sweep measures the synchronous numpy-bridge path and the
+overlapped native path at several shapes so bench.py's defaults can be
+pinned to measured numbers, not guesses.
+
+Usage: python scripts/sweep_pipeline.py [heights]
+"""
+import os
+import sys
+import time
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_cpu_parallel_codegen_split_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def main():
+    heights = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    shapes = [(1024, 128), (2048, 128), (4096, 128), (2048, 256)]
+    for I, V in shapes:
+        t0 = time.perf_counter()
+        try:
+            r = bench._pipeline_harness(I, V, heights, bench._numpy_feeder)
+            print(f"numpy   I={I:5d} V={V:4d}: {r:>12,.0f} votes/s "
+                  f"({time.perf_counter()-t0:.0f}s incl compile)", flush=True)
+        except Exception as e:
+            print(f"numpy   I={I:5d} V={V:4d}: FAIL {type(e).__name__}: {e}",
+                  flush=True)
+    for I, V in shapes:
+        t0 = time.perf_counter()
+        try:
+            r = bench._pipeline_overlapped(I, V, heights)
+            print(f"overlap I={I:5d} V={V:4d}: {r:>12,.0f} votes/s "
+                  f"({time.perf_counter()-t0:.0f}s incl compile)", flush=True)
+        except Exception as e:
+            print(f"overlap I={I:5d} V={V:4d}: FAIL {type(e).__name__}: {e}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -58,9 +58,12 @@ def main():
         jit_v2_w5 = jax.jit(lambda p, s, b: pv.verify_batch_pallas(
             p, s, b, window=5))
         bench("eager v2", pv.verify_batch_pallas, (pub, sig, blocks))
-        bench("jit v2", jit_v2, (pub, sig, blocks))
+        bench("jit v2 (window=4)", jit_v2, (pub, sig, blocks))
         bench("jit v2 window=5", jit_v2_w5, (pub, sig, blocks))
-        bench("jit v1 verify_batch", E.verify_batch_jit, (pub, sig, blocks))
+        # the production default route (ed25519_jax.verify_batch): on
+        # TPU this is the v2 kernel at window=5, so it should track the
+        # row above — a gap between them means the route is stale
+        bench("jit default route", E.verify_batch_jit, (pub, sig, blocks))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,92 @@
+"""Fused-sequence stepping (consensus_step_seq / honest_heights) must
+be bit-identical to phase-at-a-time stepping — the seq paths exist to
+cut per-dispatch overhead (one dispatch per sequence instead of one per
+phase), never to change semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agnes_tpu.device.encoding import I32
+from agnes_tpu.harness.device_driver import DeviceDriver
+from agnes_tpu.types import VoteType
+
+
+def _tree_equal(a, b):
+    ok = jax.tree.map(lambda x, y: bool((np.asarray(x) == np.asarray(y))
+                                        .all()), a, b)
+    return all(jax.tree.leaves(ok))
+
+
+def _random_phases(d, rng, n):
+    phases = []
+    for _ in range(n):
+        typ = int(rng.choice([int(VoteType.PREVOTE),
+                              int(VoteType.PRECOMMIT)]))
+        slot = int(rng.integers(-1, d.cfg.n_slots))
+        frac = float(rng.uniform(0.3, 1.0))
+        phases.append(d.phase(int(rng.integers(0, 2)), typ, slot, frac))
+    return phases
+
+
+@pytest.mark.parametrize("advance", [False, True])
+def test_step_seq_matches_sequential(advance):
+    rng = np.random.default_rng(7)
+    I, V = 5, 8
+    d_seq = DeviceDriver(I, V, advance_height=advance)
+    d_one = DeviceDriver(I, V, advance_height=advance)
+    phases = _random_phases(d_seq, rng, 6)
+
+    msgs_seq = d_seq.step_seq(phases)
+    outs = [d_one.step(phase=p) for p in phases]
+
+    assert _tree_equal(d_seq.state, d_one.state)
+    assert _tree_equal(d_seq.tally, d_one.tally)
+    # stacked messages equal the per-step messages, in order
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
+    assert _tree_equal(msgs_seq, stacked)
+    # stats agree (decisions_total, latched values)
+    assert d_seq.stats.decisions_total == d_one.stats.decisions_total
+    assert (d_seq.stats.decided == d_one.stats.decided).all()
+    assert (d_seq.stats.decision_value == d_one.stats.decision_value).all()
+    assert d_seq.stats.votes_ingested == d_one.stats.votes_ingested
+
+
+def test_honest_heights_fused_matches_loop():
+    I, V, H = 4, 8, 3
+    d_f = DeviceDriver(I, V, advance_height=True)
+    d_l = DeviceDriver(I, V, advance_height=True)
+    d_f.run_heights_fused(H)
+    d_l.run_heights(H)
+    assert _tree_equal(d_f.state, d_l.state)
+    assert _tree_equal(d_f.tally, d_l.tally)
+    assert d_f.stats.decisions_total == I * H
+    assert d_l.stats.decisions_total == I * H
+    assert (d_f.stats.decided == d_l.stats.decided).all()
+    assert (d_f.stats.decision_value == d_l.stats.decision_value).all()
+    assert int(np.asarray(d_f.state.height)[0]) == H
+    assert d_f.stats.votes_ingested == d_l.stats.votes_ingested
+
+
+def test_honest_heights_fused_partial_quorum():
+    # 3/4 of validators voting still crosses 2/3+: decisions proceed
+    I, V, H = 3, 8, 2
+    d = DeviceDriver(I, V, advance_height=True)
+    d.run_heights_fused(H, frac=0.75)
+    assert d.stats.decisions_total == I * H
+    # under 2/3: no decisions, heights never advance
+    d2 = DeviceDriver(I, V, advance_height=True)
+    d2.run_heights_fused(H, frac=0.5)
+    assert d2.stats.decisions_total == 0
+    assert int(np.asarray(d2.state.height)[0]) == 0
+
+
+def test_step_seq_defer_collect():
+    I, V = 4, 8
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    d.step_seq([d.phase(0, VoteType.PREVOTE, 1),
+                d.phase(0, VoteType.PRECOMMIT, 1)])
+    assert d.stats.decisions_total == 0          # not yet collected
+    d.collect()
+    assert d.stats.decisions_total == I
