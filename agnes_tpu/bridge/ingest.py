@@ -407,19 +407,33 @@ class VoteBatcher:
             if len(b) == 0:
                 return []
 
-        # --- fast path: one (round, class), every (instance, validator)
-        # cell occupied at most once — the common shape (a gossip tick
-        # of one phase's honest votes).  O(n) bincount check; no sorts.
-        same_rt = (b.round[0] == b.round).all() and (b.typ[0] == b.typ).all()
-        if same_rt:
-            cell_id = b.instance * self.V + b.validator
-            counts = np.bincount(cell_id, minlength=self.I * self.V)
-            if (counts <= 1).all():
-                b, slot = self._intern_and_spill(b)
-                if len(b) == 0:
-                    return []
-                return self._emit([(b, slot, int(b.round[0]),
-                                    int(b.typ[0]))])
+        # --- fast path: one round, each class's (instance, validator)
+        # cells occupied at most once — the common shapes (a gossip
+        # tick of one phase's honest votes, or both classes of a round
+        # batched into one build for a single 2n-lane verify).  O(n)
+        # bincount checks, no sorts; classes emit in (prevote,
+        # precommit) order, matching the general path's sort order.
+        if (b.round[0] == b.round).all():
+            parts = []
+            for t in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
+                m = b.typ == t
+                if not m.any():
+                    continue
+                sub = b.take(np.nonzero(m)[0])
+                cell_id = sub.instance * self.V + sub.validator
+                counts = np.bincount(cell_id, minlength=self.I * self.V)
+                if (counts > 1).any():
+                    parts = None
+                    break
+                parts.append(sub)
+            if parts is not None:
+                groups = []
+                for sub in parts:
+                    sub, slot = self._intern_and_spill(sub)
+                    if len(sub):
+                        groups.append((sub, slot, int(sub.round[0]),
+                                       int(sub.typ[0])))
+                return self._emit(groups) if groups else []
 
         # --- general path: ONE lexsort orders everything; duplicates,
         # layers and phase groups all fall out of adjacency scans.

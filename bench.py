@@ -137,6 +137,16 @@ def bench_tally(n_instances: int = 4096, n_validators: int = 1024,
     return 2 * I * V * heights / dt
 
 
+def _dispatch_phases(d, phases) -> None:
+    """Run built phases on the driver: one step for a single phase, one
+    fused step_seq dispatch for several (shared by both pipeline
+    variants so they cannot diverge)."""
+    if len(phases) == 1:
+        d.step(phase=phases[0])
+    elif phases:
+        d.step_seq(phases)
+
+
 def _signed_fixture(batch):
     from agnes_tpu.core import native
     from agnes_tpu.crypto import ed25519_jax as ejax
@@ -293,9 +303,14 @@ def _pipeline_harness(n_instances: int, n_validators: int, heights: int,
 
     `make_feeder(I, V, pubkeys) -> (sync, feed, rejected)`:
       sync(base_round, heights)     adopt the device window/heights
-      feed(h, typ, sigs[V, 64])     ingest one phase; -> [(phase, n)]
+      feed(h, sigs_by_typ)          ingest BOTH vote classes of height
+                                    h; -> [(phase, n)] in deterministic
+                                    (round, class, layer) order
       rejected()                    running bad-signature count
-    """
+
+    Both classes go through ONE batch verify (2·I·V lanes — the larger
+    batch amortizes the fixed per-dispatch tunnel cost, timing_check
+    r4) and the resulting phases run as ONE fused step_seq dispatch."""
     from agnes_tpu.bridge.ingest import vote_messages_np
     from agnes_tpu.core import native
     from agnes_tpu.harness.device_driver import DeviceDriver
@@ -323,9 +338,7 @@ def _pipeline_harness(n_instances: int, n_validators: int, heights: int,
     def run_height(h, sigs_by_typ):
         d.step()                       # entry + self proposal
         sync(np.asarray(d.tally.base_round), np.asarray(d.state.height))
-        for typ in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
-            for phase, _ in feed(h, typ, sigs_by_typ[typ]):
-                d.step(phase=phase)
+        _dispatch_phases(d, [p for p, _ in feed(h, sigs_by_typ)])
 
     run_height(0, sign_height(0))      # warmup + compile
     _sync(d.state)
@@ -353,10 +366,11 @@ def _numpy_feeder(I, V, pubkeys):
     val = np.tile(np.arange(V), I)
     n = I * V
 
-    def feed(h, typ, sigs):
-        bat.add_arrays(inst, val, np.full(n, h), np.zeros(n),
-                       np.full(n, typ), np.full(n, 7), sigs[val])
-        return bat.build_phases(pubkeys)
+    def feed(h, sigs_by_typ):
+        for typ, sigs in sigs_by_typ.items():
+            bat.add_arrays(inst, val, np.full(n, h), np.zeros(n),
+                           np.full(n, typ), np.full(n, 7), sigs[val])
+        return bat.build_phases(pubkeys)   # ONE 2n-lane batch verify
 
     return bat.sync_device, feed, lambda: bat.rejected_signature
 
@@ -376,11 +390,12 @@ def _native_feeder(I, V, pubkeys):
     val = np.tile(np.arange(V), I)
     n = I * V
 
-    def feed(h, typ, sigs):
-        loop.push(pack_wire_votes(inst, val, np.full(n, h), np.zeros(n),
-                                  np.full(n, typ), np.full(n, 7),
-                                  sigs[val]))
-        return loop.build_phases()
+    def feed(h, sigs_by_typ):
+        for typ, sigs in sigs_by_typ.items():
+            loop.push(pack_wire_votes(inst, val, np.full(n, h),
+                                      np.zeros(n), np.full(n, typ),
+                                      np.full(n, 7), sigs[val]))
+        return loop.build_phases()         # ONE 2n-lane batch verify
 
     return (loop.sync_device, feed,
             lambda: loop.counters["rejected_signature"])
@@ -451,12 +466,12 @@ def _pipeline_overlapped(n_instances: int, n_validators: int,
             with span("push_async"):
                 loop.push_async(wire)
         # one build emits prevote then precommit phases (deterministic
-        # (round, class, layer) order) — step each without syncing
+        # (round, class, layer) order) — ONE fused dispatch for all of
+        # them (device/step.py consensus_step_seq)
         with span("build(verify+emit)"):
-            phases = loop.build_phases()
-        for phase, _ in phases:
-            with span("step_dispatch"):
-                d.step(phase=phase)
+            phases = [p for p, _ in loop.build_phases()]
+        with span("step_dispatch"):
+            _dispatch_phases(d, phases)
 
     run_height(0, sign_height(0))   # warmup + compile
     d.block_until_ready()

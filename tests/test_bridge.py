@@ -307,6 +307,45 @@ def test_batcher_add_arrays_bulk_path():
     assert np.array_equal(np.asarray(ph1.mask), np.asarray(ph2.mask))
 
 
+def test_batcher_two_class_build_matches_per_class_builds():
+    """Both vote classes of a round batched into ONE build (the r4
+    pipeline shape: a single 2n-lane verify) must emit the same phases,
+    in the same (prevote, precommit) order, as two per-class builds —
+    whether the combined batch takes the no-sort fast path (honest
+    cells) or the general lexsort path (duplicates present)."""
+    I, V = 2, 4
+    for dup in (False, True):
+        b1 = VoteBatcher(I, V, n_slots=4)
+        b2 = VoteBatcher(I, V, n_slots=4)
+        for typ in (VoteType.PREVOTE, VoteType.PRECOMMIT):
+            for inst in range(I):
+                for v in range(V):
+                    for b in (b1, b2):
+                        b.add(WireVote(inst, v, 0, 0, typ, value=7))
+        if dup:   # a replayed lane forces the general path
+            for b in (b1, b2):
+                b.add(WireVote(0, 0, 0, 0, VoteType.PREVOTE, value=7))
+        combined = b1.build_phases()
+        split = b2.build_phases()  # drains everything too — same batch;
+        # the reference point is per-class adds built separately:
+        b3 = VoteBatcher(I, V, n_slots=4)
+        per_class = []
+        for typ in (VoteType.PREVOTE, VoteType.PRECOMMIT):
+            for inst in range(I):
+                for v in range(V):
+                    b3.add(WireVote(inst, v, 0, 0, typ, value=7))
+            if dup and typ == VoteType.PREVOTE:
+                b3.add(WireVote(0, 0, 0, 0, VoteType.PREVOTE, value=7))
+            per_class += b3.build_phases()
+        assert len(combined) == len(split) == len(per_class) == 2
+        for (pa, na), (pb, nb) in zip(combined, per_class):
+            assert na == nb
+            assert np.array_equal(np.asarray(pa.typ), np.asarray(pb.typ))
+            assert np.array_equal(np.asarray(pa.slots),
+                                  np.asarray(pb.slots))
+            assert np.array_equal(np.asarray(pa.mask), np.asarray(pb.mask))
+
+
 def test_vote_messages_np_matches_scalar_encoding():
     from agnes_tpu.bridge.ingest import vote_messages_np
     cases = [(0, 0, 0, 7), (3, 9, 1, None), (2**40, 2**20, 1, 2**30)]
