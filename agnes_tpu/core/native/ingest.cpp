@@ -669,23 +669,30 @@ int64_t ag_ing_emit(void* h) {
   std::vector<Loop::Block> blocks;
   blocks.swap(L->ready);
 
-  // --- fast path: one (round, class), every cell occupied at most
-  // once — the honest gossip tick.  One epoch-stamped scan, no sort.
-  if (L->cell_epoch.empty())
-    L->cell_epoch.assign(static_cast<size_t>(L->I * L->V), 0);
+  // --- fast path: one round, each class's cells occupied at most
+  // once — the honest gossip ticks (one phase, or both classes of a
+  // round pushed into one build for a single 2n-lane verify; mirrors
+  // VoteBatcher.build_phases).  Epoch-stamped scans, no sort; the
+  // stamp array is per (class, cell) so the classes don't collide.
+  if (L->cell_epoch.size() <
+      static_cast<size_t>(2 * L->I * L->V))
+    L->cell_epoch.assign(static_cast<size_t>(2 * L->I * L->V), 0);
   ++L->epoch;
   bool fast = true;
+  bool have_typ[2] = {false, false};
   const Rec& first = (*blocks[0])[0];
   std::vector<std::pair<int64_t, int64_t>> pairs;
   for (const auto& blk : blocks) {
     for (const Rec& r : *blk) {
-      if (r.round != first.round || r.typ != first.typ) {
+      if (r.round != first.round || r.typ < 0 || r.typ > 1) {
         fast = false;
         break;
       }
-      size_t cell = static_cast<size_t>(r.instance * L->V + r.validator);
+      size_t cell = static_cast<size_t>(
+          (r.typ * L->I + r.instance) * L->V + r.validator);
       if (L->cell_epoch[cell] == L->epoch) { fast = false; break; }
       L->cell_epoch[cell] = L->epoch;
+      have_typ[r.typ] = true;
       if (r.value != kNil &&
           slot_lookup(L, r.instance, r.value) == kVotedNil)
         pairs.emplace_back(r.instance, r.value);
@@ -694,12 +701,18 @@ int64_t ag_ing_emit(void* h) {
   }
   if (fast) {
     intern_ascending(L, pairs);
-    Phase& ph = set.acquire(L->I * L->V);
-    ph.round = static_cast<int32_t>(first.round);
-    ph.typ = static_cast<int32_t>(first.typ);
-    for (const auto& blk : blocks)
-      for (const Rec& r : *blk) scatter_vote(L, ph, r);
-    if (ph.n_votes == 0) set.used = 0;
+    // classes emit in (prevote, precommit) order — the general path's
+    // sort order, and the order consensus expects to make progress
+    for (int t = 0; t <= 1; ++t) {
+      if (!have_typ[t]) continue;
+      Phase& ph = set.acquire(L->I * L->V);
+      ph.round = static_cast<int32_t>(first.round);
+      ph.typ = static_cast<int32_t>(t);
+      for (const auto& blk : blocks)
+        for (const Rec& r : *blk)
+          if (r.typ == t) scatter_vote(L, ph, r);
+      if (ph.n_votes == 0) --set.used;   // all lanes spilled: drop it
+    }
     return static_cast<int64_t>(set.used);
   }
 

@@ -79,9 +79,15 @@ class DeviceDriver:
         self._deferred_msgs: list = []
         self.mesh = mesh
         if mesh is not None:
-            from agnes_tpu.parallel import make_sharded_step
+            from agnes_tpu.parallel import (
+                make_sharded_step,
+                make_sharded_step_seq,
+            )
             self._sharded_step = make_sharded_step(
                 mesh, advance_height=advance_height)
+            self._sharded_step_seq = make_sharded_step_seq(
+                mesh, advance_height=advance_height)
+            self._sharded_honest: dict = {}   # heights -> jitted fn
         self.cfg = TallyConfig(n_validators=n_validators, n_rounds=n_rounds,
                                n_slots=n_slots)
         self.state = DeviceState.new((self.I,))
@@ -194,15 +200,20 @@ class DeviceDriver:
         built vote class), `exts` an optional matching list.  Identical
         semantics to P step() calls — tests/test_step_seq.py holds the
         two paths equal leaf-for-leaf — at 1/P the dispatch overhead."""
-        assert self.mesh is None, "step_seq is single-device for now"
         P = len(phases)
         exts = exts if exts is not None else [self.ext()] * P
         phases_st = jax.tree.map(lambda *xs: jnp.stack(xs), *phases)
         exts_st = jax.tree.map(lambda *xs: jnp.stack(xs), *exts)
-        out = consensus_step_seq_jit(self.state, self.tally, exts_st,
-                                     phases_st, self.powers, self.total,
-                                     self.proposer_flag, self.propose_value,
-                                     advance_height=self.advance_height)
+        if self.mesh is not None:
+            out = self._sharded_step_seq(self.state, self.tally, exts_st,
+                                         phases_st, self.powers,
+                                         self.total, self.proposer_flag,
+                                         self.propose_value)
+        else:
+            out = consensus_step_seq_jit(
+                self.state, self.tally, exts_st, phases_st, self.powers,
+                self.total, self.proposer_flag, self.propose_value,
+                advance_height=self.advance_height)
         self.state, self.tally = out.state, out.tally
         self.stats.steps += P
         self.stats.votes_ingested += int(
@@ -284,15 +295,24 @@ class DeviceDriver:
         this is what lets config-4-shape multi-height throughput run
         at device speed on the tunneled TPU."""
         assert self.advance_height, "construct with advance_height=True"
-        assert self.mesh is None, "fused heights are single-device for now"
         voters = jnp.arange(self.V) < round_half_up(frac * self.V)
         slots = jnp.where(voters[None, :], slot, -1).astype(I32) \
             * jnp.ones((self.I, 1), I32)
         mask = jnp.broadcast_to(voters[None, :], (self.I, self.V))
-        out = honest_heights_jit(self.state, self.tally, slots, mask,
-                                 self.powers, self.total,
-                                 self.proposer_flag, self.propose_value,
-                                 heights=n_heights)
+        if self.mesh is not None:
+            if n_heights not in self._sharded_honest:
+                from agnes_tpu.parallel import make_sharded_honest_heights
+                self._sharded_honest[n_heights] = \
+                    make_sharded_honest_heights(self.mesh, n_heights)
+            out = self._sharded_honest[n_heights](
+                self.state, self.tally, slots, mask, self.powers,
+                self.total, self.proposer_flag, self.propose_value)
+        else:
+            out = honest_heights_jit(self.state, self.tally, slots, mask,
+                                     self.powers, self.total,
+                                     self.proposer_flag,
+                                     self.propose_value,
+                                     heights=n_heights)
         self.state, self.tally = out.state, out.tally
         self.stats.steps += 3 * n_heights
         self.stats.votes_ingested += 2 * n_heights * int(

@@ -24,6 +24,8 @@ from agnes_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
 )
 from agnes_tpu.parallel.sharded import (  # noqa: F401
+    make_sharded_honest_heights,
     make_sharded_step,
+    make_sharded_step_seq,
     shard_step_args,
 )

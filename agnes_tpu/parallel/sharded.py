@@ -48,6 +48,8 @@ from agnes_tpu.device.step import (
     StepOutputs,
     VotePhase,
     consensus_step,
+    consensus_step_seq,
+    honest_heights,
 )
 from agnes_tpu.device.tally import TallyState
 from agnes_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS, VAL_AXIS
@@ -113,6 +115,49 @@ def make_sharded_step(mesh: Mesh, advance_height: bool = False):
         partial(consensus_step, axis_name=VAL_AXIS,
                 advance_height=advance_height),
         mesh=mesh, in_specs=specs, out_specs=out_specs,
+        check_vma=True)
+    return jax.jit(fn)
+
+
+def _prepend_none(spec_tree):
+    """Widen every PartitionSpec in a tree with a leading replicated
+    axis — the sequence axis of stacked exts/phases ([P, ...] leaves)."""
+    return jax.tree.map(lambda s: P(*((None,) + tuple(s))), spec_tree,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_sharded_step_seq(mesh: Mesh, advance_height: bool = False):
+    """consensus_step_seq sharded over `mesh`: P phases in ONE sharded
+    dispatch (the same fused-sequence rationale as the single-device
+    path — device/step.py — with the quorum psums riding the val axis
+    inside the scanned body).  exts/phases carry a leading replicated
+    sequence axis; msgs come back [P, n_stages, I] sharded on I."""
+    da = _data_axes(mesh)
+    s = _in_specs(da)
+    in_specs = (s[0], s[1], _prepend_none(s[2]), _prepend_none(s[3]),
+                s[4], s[5], s[6], s[7])
+    out_specs = StepOutputs(state=_state_spec(da), tally=s[1],
+                            msgs=P(None, None, da))
+    fn = jax.shard_map(
+        partial(consensus_step_seq, axis_name=VAL_AXIS,
+                advance_height=advance_height),
+        mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=True)
+    return jax.jit(fn)
+
+
+def make_sharded_honest_heights(mesh: Mesh, heights: int):
+    """honest_heights sharded over `mesh`: H full honest heights in ONE
+    sharded dispatch; msgs come back [H, 3, n_stages, I] sharded on I."""
+    da = _data_axes(mesh)
+    s = _in_specs(da)
+    iv = P(da, VAL_AXIS)
+    in_specs = (s[0], s[1], iv, iv, s[4], s[5], s[6], s[7])
+    out_specs = StepOutputs(state=_state_spec(da), tally=s[1],
+                            msgs=P(None, None, None, da))
+    fn = jax.shard_map(
+        partial(honest_heights, heights=heights, axis_name=VAL_AXIS),
+        mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=True)
     return jax.jit(fn)
 

@@ -263,6 +263,39 @@ def test_hierarchical_mesh_equivocation_and_skip():
     assert (np.asarray(s.round) == 2).all()
 
 
+def test_sharded_fused_seq_and_heights_match_unsharded():
+    """The fused-sequence paths under shard_map (r4): step_seq and
+    run_heights_fused on the flat 2x4 and hierarchical 2x2x2 meshes
+    must match the single-device fused driver bitwise — the sequence
+    scan and the per-phase quorum psums must commute."""
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.parallel import make_hierarchical_mesh
+
+    def drive_seq(mesh):
+        d = DeviceDriver(8, 8, mesh=mesh)
+        d.step_seq([d.phase(0, VoteType.PREVOTE, 1),
+                    d.phase(0, VoteType.PREVOTE, 2),
+                    d.phase(0, VoteType.PRECOMMIT, 1)])
+        d.block_until_ready()
+        return d
+
+    def drive_heights(mesh):
+        d = DeviceDriver(8, 8, advance_height=True, mesh=mesh)
+        d.run_heights_fused(3)
+        d.block_until_ready()
+        return d
+
+    for drive in (drive_seq, drive_heights):
+        ref = drive(None)
+        for mesh in (make_mesh(2, 4), make_hierarchical_mesh(2, 2, 2)):
+            dm = drive(mesh)
+            _assert_trees_equal(ref.state, dm.state)
+            _assert_trees_equal(ref.tally, dm.tally)
+            assert dm.stats.decisions_total == ref.stats.decisions_total
+            np.testing.assert_array_equal(dm.stats.decision_value,
+                                          ref.stats.decision_value)
+
+
 def test_sharded_closed_loop_config3_shape():
     """VERDICT r3 weak #5: a full DRIVER loop (not a one-step smoke)
     under sharding, at the config-3 small shape (8 x 64): nil round
