@@ -44,3 +44,52 @@ try:
     disable_persistent_cache()
 except ImportError:  # pure-core tests don't need jax
     pass
+
+
+# Files whose interpret-mode Pallas kernels compile ~100k-op XLA:CPU
+# graphs.  A big compile segfaults inside backend_compile_and_load once
+# the process has already done a few hundred compiles (r4: full-suite
+# runs died twice — first at test_sharded's shard_map compile after the
+# heavy files, then, reordered, inside test_pallas_verify's own compile
+# after ~340 small ones; every file passes standalone in a fresh
+# process.  Same XLA:CPU family as the compile-cache post-mortem,
+# utils/compile_cache.py).  The only reliable mitigation found is
+# process isolation: in a full-suite run these files are skipped
+# in-process and re-run each in a FRESH child interpreter by
+# tests/test_zz_heavy_isolated.py (ordered last).  Set
+# AGNES_HEAVY_DIRECT=1 to run them inline (what the child does).
+_ISOLATED = (
+    "test_ed25519_jax.py",
+    "test_cofactored.py",
+    "test_pallas_ed25519.py",
+    "test_pallas_verify.py",
+)
+_WRAPPER = "test_zz_heavy_isolated.py"
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    def group(item):
+        name = item.fspath.basename
+        if name == _WRAPPER:
+            return (2, 0)
+        try:
+            return (1, _ISOLATED.index(name))
+        except ValueError:
+            return (0, 0)
+
+    items.sort(key=group)   # stable: original order within each group
+    wrapper_collected = any(it.fspath.basename == _WRAPPER
+                            for it in items)
+    # Only swap inline runs for child runs when the wrapper is actually
+    # in this run — a targeted `pytest tests/test_pallas_verify.py`
+    # (fresh process, no prior compiles) runs inline and stays covered.
+    if wrapper_collected and not os.environ.get("AGNES_HEAVY_DIRECT"):
+        skip = pytest.mark.skip(
+            reason="interpret-heavy: re-run in a fresh child process by "
+                   "test_zz_heavy_isolated.py (AGNES_HEAVY_DIRECT=1 "
+                   "runs it inline)")
+        for it in items:
+            if it.fspath.basename in _ISOLATED:
+                it.add_marker(skip)
