@@ -54,6 +54,23 @@ from agnes_tpu.types import NIL_ID, Vote, VoteType
 
 _NIL = -1                 # array encoding of a nil vote's value
 
+# packed (instance, value) pair keys: value ids are 31-bit
+# (value_table.MAX_VALUE_ID), so ascending int64 order over packed
+# keys == lexicographic (instance, value) order — the framework-wide
+# slot interning order (C++ twin: ingest.cpp intern_ascending)
+_PAIR_SHIFT = 31
+
+
+def _pack_pairs(b: "_Batch") -> np.ndarray:
+    """Non-nil lanes of a batch -> sorted-comparable packed keys."""
+    nn = b.value >= 0
+    return (b.instance[nn].astype(np.int64) << _PAIR_SHIFT) \
+        | b.value[nn].astype(np.int64)
+
+
+def _unpack_pair(pk: np.int64) -> Tuple[int, int]:
+    return int(pk >> _PAIR_SHIFT), int(pk & ((1 << _PAIR_SHIFT) - 1))
+
 
 @dataclass(frozen=True)
 class WireVote:
@@ -427,6 +444,32 @@ class VoteBatcher:
                     break
                 parts.append(sub)
             if parts is not None:
+                # with BOTH classes present AND carrying different
+                # values, intern new (instance, value) pairs in one
+                # combined ascending pass first — matching the general
+                # path's np.unique order and the C++ fast path's
+                # intern_ascending — so slot numbering never depends on
+                # class processing order (mixed-value two-class builds
+                # diverged before: prevote values grabbed slots ahead
+                # of smaller precommit values).  Slot maps are
+                # per-instance, so order can only diverge when one
+                # instance sees >= 2 distinct new values in the build —
+                # impossible single-class (np.unique order inside
+                # _intern_slots) or when both classes carry the same
+                # single value (the steady-state honest tick, gated
+                # O(n) by min==max so it pays no sort here).
+                if len(parts) > 1:
+                    monos = []
+                    for sub in parts:
+                        nn = sub.value[sub.value >= 0]
+                        if len(nn):
+                            lo, hi = nn.min(), nn.max()
+                            monos.append(int(lo) if lo == hi else -1)
+                    if -1 in monos or len(set(monos)) > 1:
+                        packed = [_pack_pairs(sub) for sub in parts]
+                        packed = [p for p in packed if len(p)]
+                        for pk in np.unique(np.concatenate(packed)):
+                            self.slots.prealloc(*_unpack_pair(pk))
                 groups = []
                 for sub in parts:
                     sub, slot = self._intern_and_spill(sub)
@@ -515,13 +558,11 @@ class VoteBatcher:
                     lut[inst] = VOTED_NIL - 1 if s is None else s
                 slot[nn] = lut[b.instance[nn]]
             else:
-                pair = (b.instance[nn].astype(np.int64) << 31) \
-                    | b.value[nn].astype(np.int64)
+                pair = _pack_pairs(b)
                 upairs, inv = np.unique(pair, return_inverse=True)
                 uslots = np.empty(len(upairs), np.int64)
                 for j, pk in enumerate(upairs):
-                    s = self.slots.slot_for(int(pk >> 31),
-                                            int(pk & (2**31 - 1)))
+                    s = self.slots.slot_for(*_unpack_pair(pk))
                     uslots[j] = VOTED_NIL - 1 if s is None else s
                 slot[nn] = uslots[inv]
         ovf = int((slot == VOTED_NIL - 1).sum())

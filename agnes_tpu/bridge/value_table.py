@@ -72,6 +72,16 @@ class SlotMap:
         m[value_id] = slot
         return slot
 
+    def prealloc(self, instance: int, value_id: int) -> None:
+        """Allocate a slot if there is room; unlike slot_for, a full
+        map is NOT counted as an overflow attempt.  Used by batch
+        pre-passes that fix allocation ORDER (combined ascending across
+        vote classes) before the per-class interning that does the real
+        per-vote accounting."""
+        m = self._maps[instance]
+        if value_id not in m and len(m) < self.n_slots:
+            m[value_id] = len(m)
+
     def value_for(self, instance: int, slot: int) -> Optional[int]:
         for vid, s in self._maps[instance].items():
             if s == slot:

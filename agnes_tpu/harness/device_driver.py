@@ -231,8 +231,16 @@ class DeviceDriver:
         stage axis (step order is preserved, so first-decision latching
         is unchanged); decisions_total counts every DECISION message,
         which with height advance is one per (instance, height)."""
-        tags = np.asarray(msgs.tag).reshape(-1, self.I)
+        tags_nd = np.asarray(msgs.tag)
+        tags = tags_nd.reshape(-1, self.I)
         dec = tags == int(MsgTag.DECISION)
+        # one-decision-per-step-per-instance is an invariant (an
+        # instance commits at most once per step; with height advance
+        # the reset happens between steps) — assert it so dec.sum()
+        # counting can never silently inflate (ADVICE r4)
+        assert (dec.reshape(-1, tags_nd.shape[-2], self.I)
+                .sum(axis=1) <= 1).all(), \
+            "multiple DECISION stages for one instance in one step"
         self.stats.decisions_total += int(dec.sum())
         if dec.any():
             decided_now = dec.any(axis=0)

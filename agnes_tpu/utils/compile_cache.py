@@ -27,10 +27,11 @@ effects initialize the backend early, so calling into this module
 would already be too late — which is why the snippet is inlined
 rather than imported).  `python -m agnes_tpu.harness.configs` cannot
 even inline it (the package import precedes the module body under
--m); its wrapper scripts/run_hw_suite.sh exports the policy instead.  `disable_persistent_
-cache()` additionally pins the cache OFF in-process so a leftover
-JAX_COMPILATION_CACHE_DIR in the environment cannot re-enable the
-segfault modes above.  Revisit if jaxlib updates.
+-m); its wrapper scripts/run_hw_suite.sh exports the policy instead.
+`disable_persistent_cache()` additionally pins the cache OFF
+in-process so a leftover JAX_COMPILATION_CACHE_DIR in the environment
+cannot re-enable the segfault modes above.  Revisit if jaxlib
+updates.
 
 The canonical de-race snippet (keep entry-point copies in sync):
 

@@ -32,39 +32,171 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 
-def _backend_hung(timeout_s: int = 240) -> bool:
+def _backend_hung_once(timeout_s: int) -> bool:
     """True iff backend init HANGS (wedged axon relay after a client
     died mid-claim): probed in a SUBPROCESS because jax.devices()
     blocks forever in-process — and some agnes module imports below
     create device arrays, so even importing this file would hang.
     A fast nonzero exit (broken jax install, etc.) is NOT a hang —
-    the caller proceeds and the real import error surfaces loudly."""
+    the caller proceeds and the real import error surfaces loudly.
+
+    A hung child is shut down GENTLY (SIGINT, grace, then escalate):
+    a SIGKILLed probe dies mid-claim, which is itself one of the
+    observed causes of hours-long relay wedges."""
+    # DEVNULL, not PIPE: a killed child's helper processes can hold
+    # a captured pipe open and block the post-kill drain forever
+    p = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     try:
-        # DEVNULL, not PIPE: a killed child's helper processes can hold
-        # a captured pipe open and block the post-kill drain forever
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL)
+        p.wait(timeout=timeout_s)
         return False
     except subprocess.TimeoutExpired:
+        for sig, grace in ((signal.SIGINT, 15), (signal.SIGTERM, 5)):
+            try:
+                p.send_signal(sig)
+                p.wait(timeout=grace)
+                return True
+            except subprocess.TimeoutExpired:
+                continue
+            except OSError:
+                return True
+        p.kill()
+        p.wait()
         return True
+
+
+def _tpu_holders() -> list:
+    """Other processes that (may) hold the single-process TPU claim:
+    the detached hardware-suite stages and any sibling bench.  While
+    one is alive, a hanging jax.devices() in a fresh interpreter is
+    EXPECTED (second-client behavior on this platform), so probing —
+    and above all killing hung probes — must wait.
+
+    Screens against false positives: only python/bash INVOCATIONS of
+    the known TPU entry points count (an editor or tail with bench.py
+    on its command line does not), and a SIBLING bench.py counts only
+    when it started earlier (ps etimes; pid breaks ties) — the elder
+    bench probes, the younger waits, so two driver-launched benches
+    can never busy-wait on each other to mutual -1s."""
+    pats = ("bench.py", "agnes_tpu.harness.configs", "profile_verify",
+            "run_hw_suite", "sweep_pipeline", "timing_check")
+    try:
+        out = subprocess.run(["ps", "-eo", "pid,ppid,etimes,args"],
+                             capture_output=True, text=True,
+                             timeout=30).stdout
+    except Exception:
+        return []
+    procs = {}
+    for ln in out.splitlines():
+        parts = ln.strip().split(None, 3)
+        if (len(parts) >= 4 and parts[0].isdigit()
+                and parts[1].isdigit() and parts[2].isdigit()):
+            procs[int(parts[0])] = (int(parts[1]), int(parts[2]),
+                                    parts[3])
+    # exclude self AND every ancestor: when the detached suite runner
+    # invokes `python bench.py`, the parent shell's own command line
+    # matches "run_hw_suite" — it is the caller, not a rival claim
+    skip, pid = set(), os.getpid()
+    while pid in procs and pid not in skip:
+        skip.add(pid)
+        pid = procs[pid][0]
+    my_age = procs.get(os.getpid(), (0, 0, ""))[1]
+    holders = []
+    for p, (pp, age, args) in sorted(procs.items()):
+        if p in skip or not any(pat in args for pat in pats):
+            continue
+        interp = args.split(None, 1)[0].rsplit("/", 1)[-1]
+        if not (interp.startswith("python") or interp in ("bash", "sh")
+                or interp == "timeout"):
+            continue                      # editor/tail/grep, not a run
+        if "bench.py" in args and "agnes_tpu" not in args:
+            # sibling bench: defer only to an ELDER one
+            if age < my_age or (age == my_age and p > os.getpid()):
+                continue
+        holders.append(f"{p} {args}")
+    return holders
+
+
+def _backend_hung():
+    """Bounded probe-RETRY loop (VERDICT r4 weak #1: a single probe
+    emitted -1 twice in a row when the driver happened to run bench at
+    a transiently-wedged moment).  Axon wedges observed in r3/r4 often
+    clear within tens of minutes, so keep probing — every
+    AGNES_BENCH_PROBE_INTERVAL_S (default 180s) for up to
+    AGNES_BENCH_PROBE_BUDGET_S (default 2700s = 45 min) of actual hung
+    probes — and only report a hang after the whole budget is spent.
+    While another agnes TPU process is alive (ps screen above) this
+    loop WAITS instead of probing, up to AGNES_BENCH_BUSY_BUDGET_S
+    (default 7200s): a second client hangs by design on this platform,
+    and killing such a probe mid-claim can wedge the relay for real.
+
+    Returns None when the backend is reachable, else a short reason
+    string ("busy": another process held the TPU for the whole busy
+    budget and no probe ever ran; "wedged": probes themselves hung for
+    the whole probe budget) so the emitted -1 record states the actual
+    cause."""
+    probe_s = int(os.environ.get("AGNES_BENCH_PROBE_TIMEOUT_S", "240"))
+    interval = int(os.environ.get("AGNES_BENCH_PROBE_INTERVAL_S", "180"))
+    budget = float(os.environ.get("AGNES_BENCH_PROBE_BUDGET_S", "2700"))
+    busy_budget = float(os.environ.get("AGNES_BENCH_BUSY_BUDGET_S",
+                                       "7200"))
+    busy_deadline = time.monotonic() + busy_budget
+    spent = 0.0
+    attempt = 0
+    while True:
+        holders = _tpu_holders()
+        if holders:
+            if time.monotonic() >= busy_deadline:
+                print("[bench] TPU still held by another process after "
+                      f"{busy_budget:.0f}s; giving up:\n  "
+                      + "\n  ".join(holders), file=sys.stderr, flush=True)
+                return "busy"
+            print(f"[bench] TPU busy ({len(holders)} holder(s)); "
+                  f"waiting {interval}s", file=sys.stderr, flush=True)
+            time.sleep(interval)
+            continue
+        attempt += 1
+        t0 = time.monotonic()
+        if not _backend_hung_once(probe_s):
+            return None
+        spent += time.monotonic() - t0 + interval
+        if spent >= budget:
+            print(f"[bench] backend probe hung {attempt}x over "
+                  f"{budget:.0f}s budget; giving up", file=sys.stderr,
+                  flush=True)
+            return "wedged"
+        print(f"[bench] backend probe {attempt} hung; retrying in "
+              f"{interval}s", file=sys.stderr, flush=True)
+        time.sleep(interval)
 
 
 # the guard must run BEFORE the jax/agnes imports below (they trigger
 # backend init at import time)
-if __name__ == "__main__" and _backend_hung():
-    print(json.dumps({
-        "metric": "pipeline_votes_per_sec", "value": -1,
-        "unit": "votes/sec/chip", "vs_baseline": -1,
-        "note": "backend init timed out (wedged accelerator tunnel); "
-                "no stage was run"}))
-    sys.exit(0)
+if __name__ == "__main__":
+    _reason = _backend_hung()
+    if _reason == "busy":
+        print(json.dumps({
+            "metric": "pipeline_votes_per_sec", "value": -1,
+            "unit": "votes/sec/chip", "vs_baseline": -1,
+            "note": "TPU held by another process for the full busy "
+                    "budget (scheduling conflict, NOT a tunnel wedge); "
+                    "no probe or stage was run"}))
+        sys.exit(0)
+    if _reason == "wedged":
+        print(json.dumps({
+            "metric": "pipeline_votes_per_sec", "value": -1,
+            "unit": "votes/sec/chip", "vs_baseline": -1,
+            "note": "backend init timed out (wedged accelerator "
+                    "tunnel) for the full probe-retry budget; no "
+                    "stage was run"}))
+        sys.exit(0)
 
 # the XLA:CPU codegen/serialization race workaround must land in
 # XLA_FLAGS before ANY agnes/jax import can initialize a backend

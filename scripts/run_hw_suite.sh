@@ -2,13 +2,13 @@
 # Claim-safe hardware measurement suite: wait for the axon TPU to be
 # reachable, then run, in one sequence (never concurrently — one TPU
 # process at a time):
-#   1. bench.py                 -> $OUTDIR/bench.json
-#   2. harness configs 4 and 2  -> $OUTDIR/config4.json / config2.json
-#   3. profile_verify.py        -> $OUTDIR/profile_verify.txt
+#   1. bench.py                   -> $OUTDIR/bench.json
+#   2. harness configs 4, 2 and 5 -> $OUTDIR/config{4,2,5}.json
+#   3. profile_verify.py          -> $OUTDIR/profile_verify.txt
 # Run detached (setsid nohup) so an interactive-shell timeout can never
 # kill a TPU claim mid-flight (.claude/skills/verify/SKILL.md gotchas).
 set -u
-OUTDIR=${1:-/tmp/hw_r04}
+OUTDIR=${1:-/tmp/hw_r05}
 mkdir -p "$OUTDIR"
 LOG="$OUTDIR/runner.log"
 cd /root/repo
@@ -38,6 +38,9 @@ echo "[runner] config4 rc=$? end $(date)" >> "$LOG"
 echo "[runner] config2 start $(date)" >> "$LOG"
 python -m agnes_tpu.harness.configs 2 > "$OUTDIR/config2.json" 2>> "$LOG"
 echo "[runner] config2 rc=$? end $(date)" >> "$LOG"
+echo "[runner] config5 start $(date)" >> "$LOG"
+python -m agnes_tpu.harness.configs 5 > "$OUTDIR/config5.json" 2>> "$LOG"
+echo "[runner] config5 rc=$? end $(date)" >> "$LOG"
 echo "[runner] profile_verify start $(date)" >> "$LOG"
 python scripts/profile_verify.py > "$OUTDIR/profile_verify.txt" 2>> "$LOG"
 echo "[runner] profile_verify rc=$? end $(date)" >> "$LOG"

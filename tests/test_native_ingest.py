@@ -81,6 +81,66 @@ def test_layering_and_dedup_parity():
     assert len(a) >= 2
 
 
+def test_mixed_value_two_class_intern_order_parity():
+    """ADVICE r4 (medium): a same-round build carrying DIFFERENT new
+    values in the two classes must intern slots combined-ascending by
+    (instance, value) — the C++ intern_ascending / numpy general-path
+    order — not in class processing order.  Before the fix the numpy
+    fast path gave prevote value 9 slot 0 and precommit value 3 slot 1,
+    breaking native parity (and its own general-path consistency)."""
+    I, V = 4, 4
+    loop, bat = _pair(I, V)
+    # prevote (inst0, value 9) + precommit (inst0, value 3): ascending
+    # order is 3 then 9 even though the prevote class emits first;
+    # cross-instance: prevote (inst1, value 5) vs precommit (inst1,
+    # value 2) exercises the same inversion on a second instance
+    inst = np.array([0, 0, 1, 1])
+    val = np.array([0, 1, 2, 3])
+    h = np.zeros(4)
+    rnd = np.zeros(4)
+    typ = np.array([PV, PC, PV, PC])
+    value = np.array([9, 3, 5, 2])
+    a, b = _feed(loop, bat, (inst, val, h, rnd, typ, value))
+    _assert_same(a, b)
+    # slot numbering is ascending-by-value per instance ...
+    assert bat.slots.slot_for(0, 3) == 0 and bat.slots.slot_for(0, 9) == 1
+    assert bat.slots.slot_for(1, 2) == 0 and bat.slots.slot_for(1, 5) == 1
+    # ... so the (earlier-emitted) prevote phase carries the HIGHER slot
+    phases = _phases_np(b)
+    assert [p[1] for p in phases] == [PV, PC]
+    assert phases[0][3][0, 0] == 1 and phases[0][3][1, 2] == 1
+    assert phases[1][3][0, 1] == 0 and phases[1][3][1, 3] == 0
+
+
+def test_mixed_value_two_class_matches_general_path():
+    """The numpy fast path must agree with the numpy GENERAL path on
+    slot numbering for the same same-round mixed-value two-class
+    traffic (the general path is forced by appending one extra
+    round-1 vote, which cannot disturb round-0 interning order)."""
+    I, V = 4, 4
+    fast = VoteBatcher(I, V, n_slots=4, n_rounds=4)
+    gen = VoteBatcher(I, V, n_slots=4, n_rounds=4)
+    inst = np.array([0, 0])
+    val = np.array([0, 1])
+    typ = np.array([PV, PC])
+    value = np.array([9, 3])
+    fast.add_arrays(inst, val, np.zeros(2), np.zeros(2), typ, value)
+    fast_phases = _phases_np(fast.build_phases())
+    gen.add_arrays(np.array([0, 0, 1]), np.array([0, 1, 2]),
+                   np.zeros(3), np.array([0, 0, 1]),
+                   np.array([PV, PC, PV]), np.array([9, 3, 8]))
+    gen_phases = _phases_np(gen.build_phases())
+    for i in range(2):       # compare the two round-0 phases
+        assert fast_phases[i][0] == gen_phases[i][0] == 0
+        assert fast_phases[i][1] == gen_phases[i][1]
+        np.testing.assert_array_equal(fast_phases[i][3][0],
+                                      gen_phases[i][3][0])
+        np.testing.assert_array_equal(fast_phases[i][4][0],
+                                      gen_phases[i][4][0])
+    assert fast.slots.slot_for(0, 3) == gen.slots.slot_for(0, 3) == 0
+    assert fast.slots.slot_for(0, 9) == gen.slots.slot_for(0, 9) == 1
+
+
 def test_malformed_and_stale_screen_parity():
     I, V = 4, 4
     loop, bat = _pair(I, V)
