@@ -20,7 +20,7 @@ Layout (mirrors SURVEY.md §7):
   utils/     tracing, checkpoint/resume, metrics
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from agnes_tpu.types import (  # noqa: F401
     NIL,
