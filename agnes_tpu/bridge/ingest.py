@@ -45,12 +45,12 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from agnes_tpu.bridge.value_table import SlotMap
+from agnes_tpu.bridge.value_table import MAX_VALUE_ID, SlotMap
 from agnes_tpu.core.round_votes import RoundVotes, ThreshKind
 from agnes_tpu.crypto.encoding import VOTE_MSG_LEN
 from agnes_tpu.device.step import VotePhase
 from agnes_tpu.device.tally import VOTED_NIL
-from agnes_tpu.types import NIL_ID, Vote, VoteType
+from agnes_tpu.types import MAX_ROUND, NIL_ID, Vote, VoteType
 
 _NIL = -1                 # array encoding of a nil vote's value
 
@@ -365,9 +365,9 @@ class VoteBatcher:
         # alias into the wrong (round, class) group downstream)
         ok = ((b.instance >= 0) & (b.instance < self.I)
               & (b.validator >= 0) & (b.validator < self.V)
-              & (b.round >= 0) & (b.round < 2**31)
+              & (b.round >= 0) & (b.round <= MAX_ROUND)
               & (b.typ >= 0) & (b.typ <= 1)
-              & (b.value < 2**31))
+              & (b.value <= MAX_VALUE_ID))
         self.rejected_malformed += int(n0 - ok.sum())
         # height gate: votes for other heights than the instance's are
         # stale (or early); counted separately from malformed

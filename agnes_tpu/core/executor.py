@@ -41,15 +41,16 @@ from agnes_tpu.core import state_machine as sm
 from agnes_tpu.core.validators import ProposerRotation, ValidatorSet
 from agnes_tpu.core.vote_executor import VoteExecutor
 from agnes_tpu.crypto import encoding
-from agnes_tpu.types import Proposal, Vote
+from agnes_tpu.types import MAX_ROUND, Proposal, Vote
 
 from agnes_tpu.crypto import host_sign as _sign, host_verify as _verify
 
 
-# wire field bounds: value ids are 31-bit (types.py), rounds fit the
-# signed 32-bit signing encoding
+# wire field bounds: value ids are 31-bit (types.py), rounds live in
+# the shared framework domain [-1, types.MAX_ROUND] every plane
+# saturates at — the screen and the saturation MUST move together
 _MAX_VALUE = 2**31 - 1
-_MAX_ROUND = 2**31 - 1
+_MAX_ROUND = MAX_ROUND
 
 
 def _valid_value(v: Optional[int]) -> bool:

@@ -155,7 +155,8 @@ void apply(const State& s, int64_t round, const Event& e, State* os,
   if (tag == EventTag::PrecommitAny && eqr)
     return schedule_timeout_precommit(s, os, om);                // 47
   if (tag == EventTag::TimeoutPrecommit && eqr)
-    return round_skip(s, sat_add(round, 1), os, om);             // 65
+    return round_skip(s, std::min(sat_add(round, 1), kMaxRound),
+                      os, om);                                   // 65
   if (tag == EventTag::RoundSkip && s.round < round)
     return round_skip(s, round, os, om);                         // 55
   if (tag == EventTag::PrecommitValue)                           // no guard!

@@ -96,6 +96,11 @@ inline bool is_one_third(int64_t v, int64_t total) {
   return static_cast<__int128>(3) * v > static_cast<__int128>(total);
 }
 
+// framework rounds domain top (types.py MAX_ROUND): round arithmetic
+// saturates here on every plane so the int64 host cores and the int32
+// device plane stay bit-for-bit at the representable edge
+constexpr int64_t kMaxRound = 2147483647;  // 2^31 - 1
+
 // saturating accumulate for weight tallies: hostile extreme weights
 // clamp instead of wrapping (wrap could un-cross a crossed quorum)
 inline int64_t sat_add(int64_t a, int64_t b) {

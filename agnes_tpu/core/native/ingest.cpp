@@ -55,6 +55,10 @@ namespace {
 constexpr int64_t kNil = -1;          // value encoding of a nil vote
 constexpr int32_t kVotedNil = -1;     // device slot encoding (tally.py)
 constexpr int64_t kMaxValue = (int64_t{1} << 31);  // value ids are 31-bit
+// rounds domain top (types.py MAX_ROUND / core.hpp kMaxRound): the
+// screen must bound rounds exactly like the numpy bridge or the two
+// ingest paths diverge on hostile wide rounds
+constexpr int64_t kMaxRound = (int64_t{1} << 31) - 1;
 constexpr int kRecSize = 96;
 
 // reserve that preserves geometric growth (an exact-size reserve on
@@ -305,7 +309,7 @@ void parse_rec(const uint8_t* p, Rec* r) {
 // — a corrupted snapshot must not inject records push would reject)
 inline bool rec_malformed(const Loop* L, const Rec& r) {
   return r.instance >= L->I || r.validator >= L->V || r.round < 0 ||
-         r.typ > 1 || r.value >= kMaxValue;
+         r.round > kMaxRound || r.typ > 1 || r.value >= kMaxValue;
 }
 
 }  // namespace

@@ -38,7 +38,7 @@ import enum
 from dataclasses import dataclass, replace
 from typing import Optional, Tuple
 
-from agnes_tpu.types import Proposal, Vote
+from agnes_tpu.types import MAX_ROUND, Proposal, Vote
 
 # ---------------------------------------------------------------------------
 # Enums — the integer codes here are THE canonical encoding, shared verbatim
@@ -341,10 +341,12 @@ def apply(s: State, round: int, event: Event) -> Tuple[State, Optional[Message]]
     if tag == E.PRECOMMIT_ANY and eqr:
         return _schedule_timeout_precommit(s)                # 47
     if tag == E.TIMEOUT_PRECOMMIT and eqr:
-        # rounds live in int64 everywhere (wire, device, C++); saturate
-        # at the edge so the oracle and the native core stay bit-for-bit
-        # even for hostile round = INT64_MAX inputs
-        return _round_skip(s, min(round + 1, 2**63 - 1))     # 65
+        # the framework rounds domain is [-1, MAX_ROUND] (types.py):
+        # saturate the skip target there so the int64 oracle/C++ and
+        # the int32 device plane stay bit-for-bit at the edge — a
+        # screened-in round of MAX_ROUND must not widen to 2**31 here
+        # while wrapping negative on device
+        return _round_skip(s, min(round + 1, MAX_ROUND))     # 65
     if tag == E.ROUND_SKIP and s.round < round:
         return _round_skip(s, round)                         # 55
     if tag == E.PRECOMMIT_VALUE:                             # no round guard!

@@ -26,7 +26,7 @@ from jax import lax
 
 from agnes_tpu.core.state_machine import EventTag, MsgTag, Step, TimeoutStep
 from agnes_tpu.device.encoding import I32, DeviceEvent, DeviceMessage, DeviceState
-from agnes_tpu.types import NIL_ID, VoteType
+from agnes_tpu.types import MAX_ROUND, NIL_ID, VoteType
 
 _S = Step
 _E = EventTag
@@ -127,7 +127,11 @@ def apply_scalar(s: DeviceState, ev: DeviceEvent
         return (s._replace(round=r, step=jnp.asarray(int(_S.NEW_ROUND), I32)),
                 _msg(_M.NEW_ROUND, r))
 
-    c12 = skip(ev.round + 1)
+    # clamp BEFORE the +1: at ev.round == MAX_ROUND (the top of the
+    # framework rounds domain, types.py) a bare int32 +1 would wrap
+    # negative here while the int64 oracle/C++ saturate — clamping the
+    # operand keeps all three planes bit-for-bit at the edge
+    c12 = skip(jnp.minimum(ev.round, jnp.asarray(MAX_ROUND - 1, I32)) + 1)
     c13 = skip(ev.round)
 
     # 14: commit: step only; Decision carries the EVENT round

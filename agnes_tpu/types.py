@@ -35,6 +35,15 @@ NIL = None
 # Device-side encoding of NIL; value ids must be in [0, 2**31 - 1).
 NIL_ID = -1
 
+# Framework-wide rounds domain: [-1, MAX_ROUND], shared by every plane
+# (wire screen core/executor.py, int32 device encoding, int64 oracle
+# and C++ core).  Round arithmetic SATURATES at MAX_ROUND on all
+# planes so they stay bit-for-bit even at the representable edge: a
+# round-skip chain parks at MAX_ROUND (and the instance can still
+# commit there — PrecommitValue has no round guard, spec line 49)
+# instead of wrapping in int32 while widening in int64.
+MAX_ROUND = 2**31 - 1
+
 
 class VoteType(enum.IntEnum):
     """Reference parity: src/lib.rs:16-19."""

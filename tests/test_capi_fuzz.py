@@ -24,6 +24,7 @@ import pytest
 from agnes_tpu.core import native
 from agnes_tpu.core.native import _AgEvent, _AgMessage, _AgState
 from agnes_tpu.core import state_machine as sm
+from agnes_tpu.types import MAX_ROUND
 
 I64_MAX = 2**63 - 1
 I64_MIN = -(2**63)
@@ -58,12 +59,15 @@ def test_apply_hostile_tags_and_extremes(L):
     for big in (I64_MAX, I64_MIN, I64_MAX - 1):
         out_s, out_m = _apply_raw(L, big, big, 0, 0, big, big, big)
         assert out_s.height == big     # height is never touched by apply
-    # TimeoutPrecommit at round I64_MAX: round+1 saturates, never wraps
-    # negative (a wrapped round would reset the instance to the past)
+    # TimeoutPrecommit at round I64_MAX: the skip target saturates at
+    # the framework rounds domain top MAX_ROUND (types.py) — never
+    # wraps negative, never widens past what the int32 device plane
+    # can represent (a wrapped round would reset the instance to the
+    # past; a widened one would fork the planes)
     out_s, _ = _apply_raw(L, 1, I64_MAX, 2,
                           int(sm.EventTag.TIMEOUT_PRECOMMIT), I64_MAX,
                           -1, -1)
-    assert out_s.round == I64_MAX
+    assert out_s.round == MAX_ROUND
 
 
 def test_apply_differential_random_storm(L):
@@ -97,14 +101,16 @@ def test_apply_differential_random_storm(L):
 
 def test_apply_parity_at_int64_edge(L):
     """Oracle and native both saturate TimeoutPrecommit's round+1 at
-    INT64_MAX (both sides clamp; divergence here would break the
-    bit-for-bit parity contract)."""
+    the framework domain top MAX_ROUND even for hostile INT64_MAX
+    inputs (both sides clamp identically; divergence here would break
+    the bit-for-bit parity contract — and the int32 device plane pins
+    the same edge in tests/test_cross_plane.py)."""
     st = sm.State(height=1, round=I64_MAX, step=sm.Step.PRECOMMIT,
                   locked=None, valid=None)
     ev = sm.Event(sm.EventTag.TIMEOUT_PRECOMMIT)
     want_s, want_m = sm.apply(st, I64_MAX, ev)
     got_s, got_m = native.native_apply(st, I64_MAX, ev)
-    assert want_s.round == I64_MAX
+    assert want_s.round == MAX_ROUND
     assert got_s == want_s and got_m == want_m
 
 

@@ -167,3 +167,76 @@ def test_cross_plane_commit_from_any_round_via_host_fallback():
     assert rep.host_fallback_decisions == 1, (
         "decision must have come through the host-fallback path "
         "(round 0 is outside the rotated device window)")
+
+
+def test_rounds_width_boundary_all_planes_agree():
+    """VERDICT r4 next #7: device rounds are int32 while the oracle and
+    the C++ core are int64 — prove no plane disagrees on screened-in
+    inputs at the 2^31 boundary.  The framework rounds domain is
+    [-1, MAX_ROUND] (types.py) and the skip target saturates there on
+    every plane: at round == MAX_ROUND a TIMEOUT_PRECOMMIT must PARK
+    the instance at MAX_ROUND (int32 +1 would wrap negative, int64
+    would widen to 2^31 — either divergence is a consensus fork), and
+    commit-from-any-round must still fire at the edge."""
+    from agnes_tpu.core import native
+    from agnes_tpu.core import state_machine as sm
+    from agnes_tpu.core.state_machine import Event, EventTag, Step
+    from agnes_tpu.device.encoding import (
+        decode_message,
+        decode_state,
+        encode_event,
+        encode_state,
+        stack_pytree,
+    )
+    from agnes_tpu.device.state_machine import apply_batch
+    from agnes_tpu.types import MAX_ROUND
+
+    VAL = 7
+    cases = []
+    for s_round in (MAX_ROUND - 2, MAX_ROUND - 1, MAX_ROUND):
+        for step in (Step.PREVOTE, Step.PRECOMMIT):
+            state = sm.State(height=1, round=s_round, step=step,
+                             locked=None, valid=None)
+            # the +1 site: skip target saturates at MAX_ROUND
+            cases.append((state, s_round, Event(EventTag.TIMEOUT_PRECOMMIT)))
+            # explicit jump straight to the edge
+            cases.append((state, MAX_ROUND, Event(EventTag.ROUND_SKIP)))
+            # spec line 49 at the edge: decision carries the event round
+            cases.append((state, MAX_ROUND,
+                          Event(EventTag.PRECOMMIT_VALUE, value=VAL)))
+            # lock at the edge round (PolkaValue at Prevote step, eqr)
+            cases.append((state, s_round,
+                          Event(EventTag.POLKA_VALUE, value=VAL)))
+
+    oracle = [sm.apply(s, r, ev) for (s, r, ev) in cases]
+    cpp = [native.native_apply(s, r, ev) for (s, r, ev) in cases]
+
+    batch_state = stack_pytree([encode_state(s) for (s, _, _) in cases])
+    batch_event = stack_pytree([encode_event(r, ev) for (_, r, ev) in cases])
+    out_state, out_msg = apply_batch(batch_state, batch_event)
+    os_ = [np.asarray(x) for x in out_state]
+    om_ = [np.asarray(x) for x in out_msg]
+
+    for i, ((s0, r, ev), (exp_s, exp_m)) in enumerate(zip(cases, oracle)):
+        assert cpp[i] == (exp_s, exp_m), (
+            f"C++ diverges at case {i}: {s0.round=} {ev.tag=}: "
+            f"{cpp[i]} != {(exp_s, exp_m)}")
+        dev_s = decode_state(
+            type(out_state)(*[leaf[i] for leaf in os_]), height=1)
+        dev_m = decode_message(type(out_msg)(*[leaf[i] for leaf in om_]))
+        exp_cmp = sm.State(height=1, round=exp_s.round, step=exp_s.step,
+                           locked=exp_s.locked, valid=exp_s.valid)
+        assert dev_s == exp_cmp and dev_m == exp_m, (
+            f"device diverges at case {i}: {s0.round=} {ev.tag=}: "
+            f"{(dev_s, dev_m)} != {(exp_cmp, exp_m)}")
+        # domain invariant: no plane ever leaves [-1, MAX_ROUND]
+        assert -1 <= exp_s.round <= MAX_ROUND
+        assert -1 <= dev_s.round <= MAX_ROUND
+
+    # the defining case, spelled out: parked at the edge, not wrapped
+    edge = sm.State(height=1, round=MAX_ROUND, step=Step.PRECOMMIT,
+                    locked=None, valid=None)
+    for plane in (sm.apply, native.native_apply):
+        s1, m1 = plane(edge, MAX_ROUND, Event(EventTag.TIMEOUT_PRECOMMIT))
+        assert s1.round == MAX_ROUND and s1.step == Step.NEW_ROUND
+        assert m1 == sm.Message.new_round(MAX_ROUND)
