@@ -209,6 +209,13 @@ class VoteBatcher:
         self.held_cap = (int(held_cap) if held_cap is not None
                          else max(65536, 2 * self.I * self.V))
         self._log: List[_Batch] = []           # verified votes (evidence)
+        # device-verify build state (build_phases_device): pubkeys for
+        # the fallback-subset host checks + lane batches aligned with
+        # the emitted phases.  NOTE in device-verify mode _log entries
+        # are pre-verdict — evidence consumers verify the signatures
+        # they extract (they carry them; slashing must anyway).
+        self._dv_pubkeys: Optional[np.ndarray] = None
+        self._emitted_lane_groups: List[_Batch] = []
         self.rejected_signature = 0
         self.rejected_malformed = 0
         self.overflow_votes = 0
@@ -293,18 +300,25 @@ class VoteBatcher:
 
     # -- signature verification ----------------------------------------------
 
+    def _pack_verify_inputs(self, b: _Batch, pubkeys: np.ndarray):
+        """(pub, sig, blocks) Ed25519 verify-kernel inputs for a batch
+        — the ONE packing recipe, shared by the host-side _verify and
+        the device-fused lane packer so the two paths cannot desync."""
+        msg = vote_messages_np(b.height, b.round, b.typ, b.value)
+        a_bytes = np.asarray(pubkeys)[b.validator]        # [N, 32]
+        sig = (b.signature if b.signature is not None
+               else np.zeros((len(b), 64), np.uint8))
+        blocks = jnp.asarray(_sha_blocks_np(sig[:, :32], a_bytes, msg))
+        return (jnp.asarray(a_bytes.astype(np.int32)),
+                jnp.asarray(sig.astype(np.int32)), blocks)
+
     def _verify(self, b: _Batch, pubkeys: np.ndarray) -> np.ndarray:
         """Batch-verify on the JAX plane; pubkeys [V, 32] uint8 is the
         device-resident validator table (ValidatorSet.device_arrays).
         Returns [N] bool."""
         from agnes_tpu.crypto import ed25519_jax as ejax
 
-        msg = vote_messages_np(b.height, b.round, b.typ, b.value)
-        a_bytes = pubkeys[b.validator]                    # [N, 32]
-        r_bytes = b.signature[:, :32]
-        blocks = jnp.asarray(_sha_blocks_np(r_bytes, a_bytes, msg))
-        pub = jnp.asarray(a_bytes.astype(np.int32))
-        sig = jnp.asarray(b.signature.astype(np.int32))
+        pub, sig, blocks = self._pack_verify_inputs(b, pubkeys)
         if self.verify_mode == "msm":
             from agnes_tpu.crypto import msm_jax
             return msm_jax.verify_batch_adaptive(pub, sig, blocks,
@@ -312,6 +326,19 @@ class VoteBatcher:
         return np.asarray(ejax.verify_batch_jit(pub, sig, blocks))
 
     # -- host fallback for past rounds ---------------------------------------
+
+    def _host_tally_screened(self, b: _Batch) -> None:
+        """Route votes to the host tally.  In device-verify mode they
+        must be verified HERE first: the bulk verdicts are computed
+        fused on device (consensus_step_seq_signed) and never reach
+        the host buckets, so an unscreened spill would let forged
+        votes into the fallback tally."""
+        if self._dv_pubkeys is not None and len(b):
+            good = self._verify(b, self._dv_pubkeys)
+            self.rejected_signature += int(len(b) - good.sum())
+            b = b.take(np.nonzero(good)[0])
+        if len(b):
+            self._host_tally_past(b)
 
     def _host_tally_past(self, b: _Batch) -> None:
         """Tally rotated-out rounds with the host RoundVotes (exact
@@ -347,13 +374,19 @@ class VoteBatcher:
 
     # -- densification -------------------------------------------------------
 
-    def build_phases(self, pubkeys: Optional[np.ndarray] = None
+    def build_phases(self, pubkeys: Optional[np.ndarray] = None,
+                     _device_verify: bool = False
                      ) -> List[Tuple[VotePhase, int]]:
         """Drain pending votes into dense phases.
 
         Returns [(phase, n_votes)], one per (round, class, layer),
         deterministic order.  With `pubkeys` given, signatures are
-        batch-verified first and failures dropped (and counted)."""
+        batch-verified first and failures dropped (and counted).
+        `_device_verify` (internal; use build_phases_device) defers the
+        bulk verification to the device-fused step — only the
+        host-fallback subsets (past rounds, slot spill) verify here,
+        because their tallies happen host-side where device verdicts
+        never arrive."""
         if not self._pending:
             return []
         b, self._pending = _concat(self._pending), []
@@ -401,17 +434,22 @@ class VoteBatcher:
 
         # --- signature verification (batched, one kernel call).  When
         # pubkeys are supplied, unsigned votes must FAIL, not bypass:
-        # missing signature columns verify as zero signatures.
+        # missing signature columns verify as zero signatures.  In
+        # device-verify mode the bulk check runs fused inside the step
+        # dispatch instead (consensus_step_seq_signed) — only the
+        # host-tallied subsets below verify here.
+        self._dv_pubkeys = pubkeys if _device_verify else None
         if pubkeys is not None:
             if b.signature is None:
                 b = _Batch(b.instance, b.validator, b.height, b.round,
                            b.typ, b.value,
                            np.zeros((len(b), 64), np.uint8))
-            good = self._verify(b, pubkeys)
-            self.rejected_signature += int(len(b) - good.sum())
-            b = b.take(np.nonzero(good)[0])
-            if len(b) == 0:
-                return []
+            if not _device_verify:
+                good = self._verify(b, pubkeys)
+                self.rejected_signature += int(len(b) - good.sum())
+                b = b.take(np.nonzero(good)[0])
+                if len(b) == 0:
+                    return []
 
         # --- retain verified votes for slashable evidence
         self._log.append(b)
@@ -419,7 +457,7 @@ class VoteBatcher:
         # --- past (rotated-out) rounds go to the host tally
         past = (b.round - self.base_round[b.instance]) < 0
         if past.any():
-            self._host_tally_past(b.take(np.nonzero(past)[0]))
+            self._host_tally_screened(b.take(np.nonzero(past)[0]))
             b = b.take(np.nonzero(~past)[0])
             if len(b) == 0:
                 return []
@@ -524,6 +562,114 @@ class VoteBatcher:
                            int(k >> 22), int((k >> 21) & 1)))
         return self._emit(groups)
 
+    def _device_verify_eligible(self) -> bool:
+        """Gate for the device-fused build: the pending traffic must be
+        the honest dense shape — ONE round, each (class, instance,
+        validator) cell at most once, and at most ONE distinct non-nil
+        value per instance.  Anything else (multi-value builds, dedup
+        layers) is where unauthenticated traffic could pollute
+        host-side state BEFORE device verdicts exist — slot interning
+        and layer densification happen on the host — so those builds
+        take the host-verified path instead (forged votes are then
+        dropped before they can touch slots or mint phases).
+
+        Residual exposure, accepted + documented: an attacker pacing
+        forged single-value builds can still intern one value per
+        build; exhausting an instance's S slots that way degrades
+        honest traffic to the (verified, benchmarked) host-fallback
+        tally — the same cliff as the value-flood attack — and never
+        affects safety, since forged votes are masked before tallying
+        on every path.  Under active flood, run the host-verified
+        mode (RunConfig verify_mode/path selection)."""
+        if not self._pending:
+            return False
+        b = _concat(self._pending)
+        self._pending = [b]            # keep the concat for the build
+        if len(b) == 0 or (b.round != b.round[0]).any():
+            return False
+        # unique (class, instance, validator) cells, hostile-index safe
+        if ((b.typ < 0) | (b.typ > 1) | (b.instance < 0)
+                | (b.instance >= self.I) | (b.validator < 0)
+                | (b.validator >= self.V)).any():
+            return False
+        cell = ((b.typ * self.I + b.instance) * self.V + b.validator)
+        if (np.bincount(cell, minlength=2 * self.I * self.V) > 1).any():
+            return False
+        # <= 1 distinct non-nil value per instance
+        nn = b.value >= 0
+        if nn.any():
+            lo = np.full(self.I, np.iinfo(np.int64).max, np.int64)
+            hi = np.full(self.I, -1, np.int64)
+            np.minimum.at(lo, b.instance[nn], b.value[nn])
+            np.maximum.at(hi, b.instance[nn], b.value[nn])
+            if ((hi >= 0) & (lo != hi)).any():
+                return False
+        return True
+
+    def build_phases_device(self, pubkeys: np.ndarray,
+                            phase_offset: int = 0):
+        """Drain pending votes into dense phases with verification
+        deferred to the DEVICE: returns (phases, SignedLanes) where the
+        lanes carry every emitted vote's packed Ed25519 inputs, keyed
+        to its phase index (+ `phase_offset`, for callers that prepend
+        e.g. an entry phase to the step sequence).  Feed both to
+        DeviceDriver.step_seq_signed — verification runs FUSED in the
+        step dispatch and its verdicts mask the phases on device, so
+        no device->host verdict sync separates densify from tally
+        (SURVEY §3.2's single fused kernel; the host-verified
+        build_phases path remains for mesh drivers and as the
+        measured-overhead baseline).
+
+        Falls back to the HOST-verified build — returning (phases,
+        None); drive those with step()/step_seq — whenever the traffic
+        is not the honest dense shape (_device_verify_eligible) or the
+        batcher is in MSM mode (the fused kernel is per-lane).
+        Host-fallback subsets (past rounds, slot spill) are always
+        verified host-side — their tallies live in host buckets where
+        device verdicts never arrive.  rejected_signature counts those
+        host checks; device rejections surface via the driver's
+        rejected_signature_device.
+
+        Lanes are padded up to the next power of two with copies of
+        lane 0 aimed at an out-of-range phase (scatter-dropped on
+        device; a copy of a valid lane cannot inflate n_rejected) so
+        variable per-tick vote counts reuse a logarithmic number of
+        compiled (P, N) shapes instead of recompiling the fused step
+        per tick."""
+        if self.verify_mode != "lanes" or not self._device_verify_eligible():
+            return self.build_phases(pubkeys), None
+        self._emitted_lane_groups = []
+        self._evidence_pubkeys = np.asarray(pubkeys)
+        phases = self.build_phases(pubkeys, _device_verify=True)
+        groups, self._emitted_lane_groups = self._emitted_lane_groups, []
+        self._dv_pubkeys = None
+        if not phases:
+            return [], None
+        assert len(groups) == len(phases)
+        cat = _concat(groups)
+        phase_idx = np.concatenate(
+            [np.full(len(g), phase_offset + i, np.int64)
+             for i, g in enumerate(groups)])
+        n = len(cat)
+        n_pad = 1 << (n - 1).bit_length()
+        real = np.ones(n_pad, bool)
+        if n_pad > n:
+            real[n:] = False
+            fill = np.zeros(n_pad - n, np.intp)      # copies of lane 0
+            cat = _concat([cat, cat.take(fill)])
+            phase_idx = np.concatenate(
+                [phase_idx,
+                 np.full(n_pad - n, phase_offset + len(phases), np.int64)])
+        pub, sig, blocks = self._pack_verify_inputs(cat, pubkeys)
+        from agnes_tpu.device.step import SignedLanes
+        lanes = SignedLanes(
+            pub=pub, sig=sig, blocks=blocks,
+            phase_idx=jnp.asarray(phase_idx, jnp.int32),
+            inst=jnp.asarray(cat.instance, jnp.int32),
+            val=jnp.asarray(cat.validator, jnp.int32),
+            real=jnp.asarray(real))
+        return phases, lanes
+
     def _intern_and_spill(self, b: _Batch, layer: Optional[np.ndarray] = None):
         """Intern slots; votes whose value overflows the instance's
         slot budget spill to the HOST tally (SlotMap's documented
@@ -533,7 +679,7 @@ class VoteBatcher:
         slot = self._intern_slots(b)
         ovf = slot == VOTED_NIL - 1
         if ovf.any():
-            self._host_tally_past(b.take(np.nonzero(ovf)[0]))
+            self._host_tally_screened(b.take(np.nonzero(ovf)[0]))
             keep = np.nonzero(~ovf)[0]
             b, slot = b.take(keep), slot[~ovf]
             if layer is not None:
@@ -571,7 +717,9 @@ class VoteBatcher:
 
     def _emit(self, groups) -> List[Tuple[VotePhase, int]]:
         """[(batch, slot, round, typ)] -> dense VotePhases (fancy-index
-        scatter; no per-vote python)."""
+        scatter; no per-vote python).  In device-verify mode the
+        per-phase lane batches are retained (aligned with the emitted
+        phase order) for build_phases_device to pack."""
         hts = jnp.asarray(self.heights.astype(np.int32))
         phases: List[Tuple[VotePhase, int]] = []
         for bg, sg, rnd, typ in groups:
@@ -581,6 +729,8 @@ class VoteBatcher:
                 bg, sg = bg.take(idx), sg[idx]
             if len(bg) == 0:
                 continue
+            if self._dv_pubkeys is not None:
+                self._emitted_lane_groups.append(bg)
             slots = np.full((self.I, self.V), VOTED_NIL, np.int32)
             mask = np.zeros((self.I, self.V), bool)
             slots[bg.instance, bg.validator] = sg
@@ -598,11 +748,28 @@ class VoteBatcher:
     def signed_evidence(self, instance: int, validator: int
                         ) -> Optional[Tuple[WireVote, WireVote]]:
         """Join a device equivocation flag back to the two conflicting
-        *signed* votes: scans the retained verified batches for two
-        votes by `validator` in `instance` with the same (height,
-        round, class) and different values.  Returns (first, second)
-        WireVotes whose signatures prove the double-sign to any third
-        party, or None."""
+        *signed* votes: scans the retained batches for two votes by
+        `validator` in `instance` with the same (height, round, class)
+        and different values.  Returns (first, second) WireVotes whose
+        signatures prove the double-sign to any third party, or None.
+
+        When device-verify builds were used, the log is PRE-verdict —
+        a forged vote could otherwise shadow a real provable pair (or
+        fabricate an unprovable one), so every candidate vote is then
+        re-verified host-side here and unverifiable votes are skipped;
+        only a pair that proves to a third party is ever returned.
+        (Host-verified builds log post-filter, so the screen is a
+        no-op there and is skipped.)"""
+        pk = getattr(self, "_evidence_pubkeys", None)
+
+        def provable(k, batch) -> bool:
+            if pk is None:
+                return True
+            if batch.signature is None:
+                return False
+            sub = batch.take(np.array([k]))
+            return bool(np.asarray(self._verify(sub, pk))[0])
+
         seen: Dict[Tuple[int, int, int], Tuple[int, Optional[bytes]]] = {}
         for batch in self._log:
             hit = np.nonzero((batch.instance == instance)
@@ -614,8 +781,11 @@ class VoteBatcher:
                 sig = (batch.signature[k].tobytes()
                        if batch.signature is not None else None)
                 if key not in seen:
-                    seen[key] = (val, sig)
+                    if provable(k, batch):
+                        seen[key] = (val, sig)
                 elif seen[key][0] != val:
+                    if not provable(k, batch):
+                        continue
                     h, r, t = key
                     fv, fsig = seen[key]
 

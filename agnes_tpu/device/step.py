@@ -300,6 +300,87 @@ consensus_step_seq_jit = jax.jit(
     consensus_step_seq, static_argnames=("axis_name", "advance_height"))
 
 
+class SignedLanes(NamedTuple):
+    """Packed per-lane Ed25519 verify inputs for DEVICE-FUSED
+    verification: lane j is one wire vote destined for phase
+    `phase_idx[j]` of a step sequence, cell (inst[j], val[j]).
+    pub/sig/blocks are `ed25519_jax.verify_batch` inputs (the bridge
+    packs them with its existing vectorized packers)."""
+
+    pub: jnp.ndarray        # [N, 32] int32
+    sig: jnp.ndarray        # [N, 64] int32
+    blocks: jnp.ndarray     # [N, nb, 32] uint32
+    phase_idx: jnp.ndarray  # [N] int32; out-of-range = padding lane
+    inst: jnp.ndarray       # [N] int32
+    val: jnp.ndarray        # [N] int32
+    real: jnp.ndarray       # [N] bool; False = shape-bucketing pad
+
+
+class SignedStepOutputs(NamedTuple):
+    state: DeviceState
+    tally: TallyState
+    msgs: DeviceMessage      # [P, n_stages, I] leaves
+    n_rejected: jnp.ndarray  # scalar: lanes that failed verification
+
+
+def consensus_step_seq_signed(state: DeviceState,
+                              tally: TallyState,
+                              exts: ExtEvent,      # [P, I] leaves
+                              phases: VotePhase,   # [P, I(, V)] leaves
+                              lanes: SignedLanes,  # [N, ...] leaves
+                              powers: jnp.ndarray,
+                              total_power: jnp.ndarray,
+                              proposer_flag: jnp.ndarray,
+                              propose_value: jnp.ndarray,
+                              advance_height: bool = False,
+                              ) -> SignedStepOutputs:
+    """`consensus_step_seq` with signature verification FUSED into the
+    same dispatch — the SURVEY §3.2 north-star shape ("this whole
+    stack plus signature verification is the single fused TPU
+    kernel"): ONE batched Ed25519 verify (the Pallas kernel on TPU)
+    runs over every lane of every phase in the sequence, its verdicts
+    are scattered to [P, I, V] and ANDed into the phase masks ON
+    DEVICE, and only then does the scanned step sequence run.
+
+    Why it exists: the host-verified path must fetch verdicts to
+    densify (a device->host sync per build), which serializes the
+    ~60-70ms/dispatch tunnel latency between heights.  Here no
+    roundtrip separates verification from tallying, so consecutive
+    heights queue back-to-back through JAX async dispatch and the
+    latency amortizes (the same property `honest_heights` exploits
+    for unsigned traffic).
+
+    Caller contract (VoteBatcher device_verify / DeviceDriver
+    step_seq_signed enforce it): at most one lane per (phase, cell);
+    host-fallback tallies (past rounds, slot spill) must be verified
+    host-side by the builder because verdicts never reach the host
+    here.  A forged lane is masked out before it can tally; the count
+    returns in `n_rejected` — fetch it lazily, it does not gate the
+    pipeline.  (Reference anchor: the verify responsibility stubbed at
+    consensus_executor.rs:38-41, resolved on device instead of in the
+    consumer.)"""
+    from agnes_tpu.crypto import ed25519_jax as ejax
+
+    ok = ejax.verify_batch(lanes.pub, lanes.sig, lanes.blocks)   # [N]
+    P, I, V = phases.mask.shape
+    # padding lanes carry an out-of-range phase_idx: mode="drop" makes
+    # their scatter a no-op, and `real` keeps them out of the count
+    vmask = jnp.zeros((P, I, V), bool).at[
+        lanes.phase_idx, lanes.inst, lanes.val].set(ok, mode="drop")
+    phases = phases._replace(mask=phases.mask & vmask)
+    out = consensus_step_seq(state, tally, exts, phases, powers,
+                             total_power, proposer_flag, propose_value,
+                             advance_height=advance_height)
+    return SignedStepOutputs(state=out.state, tally=out.tally,
+                             msgs=out.msgs,
+                             n_rejected=(lanes.real & ~ok).sum()
+                             .astype(I32))
+
+
+consensus_step_seq_signed_jit = jax.jit(
+    consensus_step_seq_signed, static_argnames=("advance_height",))
+
+
 def honest_heights(state: DeviceState,
                    tally: TallyState,
                    slots: jnp.ndarray,      # [I, V] value slot votes

@@ -35,6 +35,7 @@ from agnes_tpu.device.step import (
     VotePhase,
     consensus_step_jit,
     consensus_step_seq_jit,
+    consensus_step_seq_signed_jit,
     honest_heights_jit,
 )
 from agnes_tpu.device.tally import TallyConfig, TallyState
@@ -77,6 +78,8 @@ class DeviceDriver:
         self.advance_height = advance_height
         self.defer_collect = defer_collect
         self._deferred_msgs: list = []
+        self._pending_rejects: list = []       # device-verify rejects
+        self.rejected_signature_device = 0
         self.mesh = mesh
         if mesh is not None:
             from agnes_tpu.parallel import (
@@ -224,6 +227,42 @@ class DeviceDriver:
             self._collect(out.msgs)
         return out.msgs
 
+    def step_seq_signed(self, phases, lanes, exts=None) -> "jnp.ndarray":
+        """step_seq with signature verification FUSED into the same
+        dispatch (device/step.py consensus_step_seq_signed): `lanes`
+        (SignedLanes, from VoteBatcher.build_phases_device) carries the
+        packed Ed25519 inputs whose verdicts mask the phases ON
+        DEVICE.  Nothing here fetches from the device, so consecutive
+        signed sequences queue back-to-back under defer_collect — the
+        pipelined flagship path.  Rejected-lane counts accumulate
+        lazily; `rejected_signature_device` after collect()/
+        block_until_ready() has the total.  Single-device (the mesh
+        drivers verify host-side)."""
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "device-verified stepping is single-device; mesh "
+                "drivers verify on the host path")
+        P = len(phases)
+        exts = exts if exts is not None else [self.ext()] * P
+        phases_st = jax.tree.map(lambda *xs: jnp.stack(xs), *phases)
+        exts_st = jax.tree.map(lambda *xs: jnp.stack(xs), *exts)
+        out = consensus_step_seq_signed_jit(
+            self.state, self.tally, exts_st, phases_st, lanes,
+            self.powers, self.total, self.proposer_flag,
+            self.propose_value, advance_height=self.advance_height)
+        self.state, self.tally = out.state, out.tally
+        self.stats.steps += P
+        self.stats.votes_ingested += int(lanes.phase_idx.shape[0])
+        self._pending_rejects.append(out.n_rejected)
+        if self.defer_collect:
+            self._deferred_msgs.append(out.msgs)
+        else:
+            self._collect(out.msgs)
+            rejects, self._pending_rejects = self._pending_rejects, []
+            for r in rejects:
+                self.rejected_signature_device += int(np.asarray(r))
+        return out.msgs
+
     def _collect(self, msgs) -> None:
         """Fold one message batch into the stats.  Leaves are
         [stages, I] from step(), or [P, ..., stages, I] from step_seq/
@@ -354,10 +393,14 @@ class DeviceDriver:
 
     def collect(self) -> None:
         """Drain deferred message batches into the stats (in step
-        order — decision latching is order-sensitive)."""
+        order — decision latching is order-sensitive), and settle any
+        device-verify rejected-lane counts."""
         msgs, self._deferred_msgs = self._deferred_msgs, []
         for m in msgs:
             self._collect(m)
+        rejects, self._pending_rejects = self._pending_rejects, []
+        for r in rejects:
+            self.rejected_signature_device += int(np.asarray(r))
 
     def block_until_ready(self):
         self.collect()

@@ -11,6 +11,9 @@ BASELINE.json (the reference publishes no numbers — SURVEY.md §6).
 Extras in the same JSON line:
   pipeline_native_votes_per_sec   same end-to-end path fed by the C++
                                   ingestion event loop (ingest.cpp)
+  pipeline_fused_votes_per_sec    device-fused verification: ONE
+                                  dispatch per height, verdicts mask
+                                  on device, zero fetches in the loop
   fused_tally_step_votes_per_sec  device-plane-only ingestion rate,
                                   fresh votes (height-advancing loop)
   ed25519_verifies_per_sec        the fused Pallas verify kernel alone
@@ -621,6 +624,70 @@ def _pipeline_overlapped(n_instances: int, n_validators: int,
     return 2 * n * heights / dt
 
 
+def _pipeline_fused(n_instances: int, n_validators: int,
+                    heights: int) -> float:
+    """END-TO-END with DEVICE-FUSED verification (device/step.py
+    consensus_step_seq_signed): per height ONE dispatch — entry +
+    prevote + precommit, with the batched Ed25519 verdicts masking the
+    phases ON device — and ZERO device fetches inside the loop (the
+    batcher window state is predicted: honest pipeline -> round 0,
+    height h).  Heights queue back-to-back through JAX async dispatch,
+    so the ~60-70ms/dispatch tunnel latency amortizes across the queue
+    instead of serializing per height — the removal of the per-height
+    verdict sync the host-verified paths must pay.  Differential-held
+    to the host path by tests/test_step_signed.py."""
+    from agnes_tpu.bridge.ingest import vote_messages_np
+    from agnes_tpu.core import native
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.utils.config import RunConfig
+
+    I, V = n_instances, n_validators
+    seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(V)]
+    pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in seeds])
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bat = RunConfig(n_validators=V, n_instances=I,
+                    n_slots=4).validate().make_batcher()
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+
+    def sign_height(h):
+        out = {}
+        for typ in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
+            msgs = vote_messages_np(
+                np.full(V, h), np.zeros(V, np.int64),
+                np.full(V, typ), np.full(V, 7))
+            out[typ] = np.stack([
+                np.frombuffer(native.sign(seeds[v], msgs[v].tobytes()),
+                              np.uint8) for v in range(V)])
+        return out
+
+    def run_height(h, sigs_by_typ):
+        bat.sync_device(np.zeros(I, np.int64), np.full(I, h, np.int64))
+        for typ, sigs in sigs_by_typ.items():
+            bat.add_arrays(inst, val, np.full(n, h), np.zeros(n),
+                           np.full(n, typ), np.full(n, 7), sigs[val])
+        phases, lanes = bat.build_phases_device(pubkeys, phase_offset=1)
+        d.step_seq_signed([d.empty_phase()] + [p for p, _ in phases],
+                          lanes)
+
+    run_height(0, sign_height(0))      # warmup + compile
+    d.block_until_ready()
+    assert d.stats.decisions_total == I, d.stats.decisions_total
+    assert d.rejected_signature_device == 0
+
+    all_sigs = [sign_height(h) for h in range(1, heights + 1)]
+    t0 = time.perf_counter()
+    for h in range(1, heights + 1):
+        run_height(h, all_sigs[h - 1])
+    d.block_until_ready()
+    dt = time.perf_counter() - t0
+    assert d.stats.decisions_total == I * (heights + 1)
+    assert d.rejected_signature_device == 0
+    return 2 * n * heights / dt
+
+
 def bench_pipeline(n_instances: int = 1024, n_validators: int = 128,
                    heights: int = 6) -> float:
     """The flagship headline: end-to-end through the numpy bridge."""
@@ -643,6 +710,12 @@ def bench_pipeline_overlapped(n_instances: int = 1024,
     return _pipeline_overlapped(n_instances, n_validators, heights)
 
 
+def bench_pipeline_fused(n_instances: int = 1024, n_validators: int = 128,
+                         heights: int = 6) -> float:
+    """End-to-end, device-fused verification (one dispatch/height)."""
+    return _pipeline_fused(n_instances, n_validators, heights)
+
+
 def main() -> None:
     import traceback
 
@@ -662,6 +735,7 @@ def main() -> None:
     pipeline = guarded(bench_pipeline)
     pipeline_native = guarded(bench_pipeline_native)
     pipeline_overlapped = guarded(bench_pipeline_overlapped)
+    pipeline_fused = guarded(bench_pipeline_fused)
     tally = guarded(bench_tally)
     verifies = guarded(bench_verify)
     msm = guarded(bench_verify_msm)
@@ -679,6 +753,7 @@ def main() -> None:
         else -1,
         "pipeline_native_votes_per_sec": pipeline_native,
         "pipeline_overlapped_votes_per_sec": pipeline_overlapped,
+        "pipeline_fused_votes_per_sec": pipeline_fused,
         "fused_tally_step_votes_per_sec": tally,
         "ed25519_verifies_per_sec": verifies,
         "ed25519_msm_verifies_per_sec": msm,

@@ -1,0 +1,209 @@
+"""Device-fused signature verification (consensus_step_seq_signed)
+vs the host-verified build: same wire traffic, bit-identical outcomes.
+
+The fused path moves the bulk Ed25519 check inside the step dispatch
+(device/step.py) so no device->host verdict sync separates densify
+from tally; these tests hold it to the host path's exact semantics —
+the same decisions, the same tally state, and the same treatment of
+forged lanes and host-fallback subsets.  (Reference anchor: the
+verify responsibility stubbed at consensus_executor.rs:38-41.)
+"""
+
+import numpy as np
+import pytest
+
+from agnes_tpu.bridge import VoteBatcher
+from agnes_tpu.bridge.ingest import vote_messages_np
+from agnes_tpu.core import native
+from agnes_tpu.harness.device_driver import DeviceDriver
+from agnes_tpu.types import VoteType
+
+PV, PC = int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)
+
+I, V = 3, 4
+SEEDS = [bytes([v + 1]) + bytes(31) for v in range(V)]
+PUBKEYS = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                    for s in SEEDS])
+
+
+def _signed_cols(h, typ, value, forge_validator=None):
+    """Full-mesh (every instance x validator) columns + signatures."""
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+    msgs = vote_messages_np(np.full(V, h), np.zeros(V, np.int64),
+                            np.full(V, typ), np.full(V, value))
+    sigs = np.stack([np.frombuffer(
+        native.sign(SEEDS[v], msgs[v].tobytes()), np.uint8)
+        for v in range(V)])
+    if forge_validator is not None:
+        wrong = (forge_validator + 1) % V
+        sigs[forge_validator] = np.frombuffer(
+            native.sign(SEEDS[wrong],
+                        msgs[forge_validator].tobytes()), np.uint8)
+    return (inst, val, np.full(n, h), np.zeros(n), np.full(n, typ),
+            np.full(n, value), sigs[val])
+
+
+def _drive(device_verify: bool, forge_validator=None):
+    d = DeviceDriver(I, V)
+    bat = VoteBatcher(I, V, n_slots=4)
+    d.step()                     # entry + self proposal
+    bat.sync_device(np.asarray(d.tally.base_round),
+                    np.asarray(d.state.height))
+    for typ in (PV, PC):
+        bat.add_arrays(*_signed_cols(0, typ, 7,
+                                     forge_validator=forge_validator))
+    if device_verify:
+        phases, lanes = bat.build_phases_device(PUBKEYS)
+        d.step_seq_signed([p for p, _ in phases], lanes)
+        d.collect()
+    else:
+        phases = bat.build_phases(PUBKEYS)
+        for p, _ in phases:
+            d.step(phase=p)
+    return d, bat
+
+
+def test_fused_matches_host_honest():
+    dh, bh = _drive(False)
+    df, bf = _drive(True)
+    assert dh.all_decided() and df.all_decided()
+    np.testing.assert_array_equal(np.asarray(dh.stats.decision_value),
+                                  np.asarray(df.stats.decision_value))
+    for leaf_h, leaf_f in zip(dh.tally, df.tally):
+        np.testing.assert_array_equal(np.asarray(leaf_h),
+                                      np.asarray(leaf_f))
+    for leaf_h, leaf_f in zip(dh.state, df.state):
+        np.testing.assert_array_equal(np.asarray(leaf_h),
+                                      np.asarray(leaf_f))
+    assert bh.rejected_signature == 0 and bf.rejected_signature == 0
+    assert df.rejected_signature_device == 0
+
+
+def test_fused_matches_host_forged_lane():
+    """Validator 0's signatures are forged in both classes: the host
+    path filters at build, the fused path masks on device — identical
+    post-step state, and the quorum of the 3 honest validators still
+    decides (3*3 > 2*4)."""
+    dh, bh = _drive(False, forge_validator=0)
+    df, bf = _drive(True, forge_validator=0)
+    assert dh.all_decided() and df.all_decided()
+    for leaf_h, leaf_f in zip(dh.tally, df.tally):
+        np.testing.assert_array_equal(np.asarray(leaf_h),
+                                      np.asarray(leaf_f))
+    for leaf_h, leaf_f in zip(dh.state, df.state):
+        np.testing.assert_array_equal(np.asarray(leaf_h),
+                                      np.asarray(leaf_f))
+    # host path counts at the batcher; fused path at the driver
+    assert bh.rejected_signature == 2 * I
+    assert bf.rejected_signature == 0
+    assert df.rejected_signature_device == 2 * I
+
+
+def test_fused_entry_offset_and_queued_heights():
+    """The pipelined flagship shape: entry phase prepended
+    (phase_offset=1), heights advanced on device, predicted sync —
+    nothing fetches from the device inside the loop."""
+    heights = 3
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bat = VoteBatcher(I, V, n_slots=4)
+    for h in range(heights):
+        bat.sync_device(np.zeros(I, np.int64), np.full(I, h, np.int64))
+        for typ in (PV, PC):
+            bat.add_arrays(*_signed_cols(h, typ, 7))
+        phases, lanes = bat.build_phases_device(PUBKEYS, phase_offset=1)
+        assert len(phases) == 2
+        d.step_seq_signed([d.empty_phase()] + [p for p, _ in phases],
+                          lanes)
+    d.block_until_ready()
+    assert d.stats.decisions_total == I * heights
+    assert d.rejected_signature_device == 0
+    assert int(np.asarray(d.state.height)[0]) == heights
+
+
+def test_fused_past_round_spill_is_host_verified():
+    """A rotated-out past-round vote in device-verify mode must be
+    verified HOST-side before it can reach the fallback buckets: a
+    forged past vote is rejected (and counted at the batcher), an
+    honest one tallies."""
+    d = DeviceDriver(I, V)
+    bat = VoteBatcher(I, V, n_slots=4)
+    d.step()
+    # pretend the window rotated: base_round 2, so round-0 votes are past
+    bat.sync_device(np.full(I, 2, np.int64), np.asarray(d.state.height))
+    cols = _signed_cols(0, PC, 7, forge_validator=1)
+    bat.add_arrays(*cols)
+    phases, lanes = bat.build_phases_device(PUBKEYS)
+    assert phases == [] and lanes is None
+    # V-1 honest precommits per instance reached the host buckets; the
+    # forged validator-1 lane was screened out and counted
+    assert bat.rejected_signature == I
+    events = bat.drain_host_events()
+    assert len(events) == I          # +2/3 of 4 = 3 honest precommits
+    for inst, hgt, rnd, vid in events:
+        assert (hgt, rnd, vid) == (0, 0, 7)
+
+
+def test_device_build_falls_back_on_mixed_values():
+    """A build carrying two distinct values for one instance is NOT
+    device-verify eligible (forged traffic could otherwise intern
+    slots before verdicts exist): build_phases_device host-verifies
+    instead — lanes is None and the forged value never touches the
+    slot map."""
+    bat = VoteBatcher(I, V, n_slots=4)
+    d = DeviceDriver(I, V)
+    d.step()
+    bat.sync_device(np.asarray(d.tally.base_round),
+                    np.asarray(d.state.height))
+    bat.add_arrays(*_signed_cols(0, PV, 7))         # honest, value 7
+    # forged extra vote: validator 0 "votes" value 3 on instance 0
+    # with a garbage signature — the mixed-value gate must trip
+    bat.add_arrays(np.array([0]), np.array([0]), np.zeros(1),
+                   np.zeros(1), np.array([PV]), np.array([3]),
+                   np.arange(64, dtype=np.uint8)[None, :])
+    phases, lanes = bat.build_phases_device(PUBKEYS)
+    assert lanes is None                 # host-verified fallback
+    assert bat.rejected_signature >= 1   # the forged lane died here
+    # value 3 was never interned for instance 0
+    assert bat.slots.value_for(0, 0) == 7
+    assert bat.slots.value_for(0, 1) is None
+    for p, _ in phases:
+        d.step(phase=p)
+
+
+def test_evidence_screens_forged_votes_in_device_mode():
+    """Device-verify builds log votes PRE-verdict; signed_evidence
+    must not let a forged vote shadow or fabricate equivocation
+    evidence — it re-verifies candidates host-side and skips
+    unprovable ones."""
+    bat = VoteBatcher(I, V, n_slots=4)
+    d = DeviceDriver(I, V)
+    d.step()
+    bat.sync_device(np.asarray(d.tally.base_round),
+                    np.asarray(d.state.height))
+    # build 1: everyone votes 7, but validator 1's signature is FORGED
+    bat.add_arrays(*_signed_cols(0, PV, 7, forge_validator=1))
+    phases, lanes = bat.build_phases_device(PUBKEYS)
+    assert lanes is not None
+    d.step_seq_signed([p for p, _ in phases], lanes)
+    d.collect()
+    assert d.rejected_signature_device == I   # v1 forged in each instance
+    # build 2: everyone REALLY signs value 9 (a second eligible build)
+    bat.add_arrays(*_signed_cols(0, PV, 9))
+    bat.build_phases_device(PUBKEYS)
+    # v1's only provable votes are for 9: the forged 7 must neither
+    # fabricate a (7, 9) pair nor shadow anything
+    assert bat.signed_evidence(0, 1) is None
+    # build 3: v1 (everyone) really signs 7 too -> provable double-sign
+    bat.add_arrays(*_signed_cols(0, PV, 7))
+    bat.build_phases_device(PUBKEYS)
+    ev = bat.signed_evidence(0, 1)
+    assert ev is not None
+    first, second = ev
+    assert {first.value, second.value} == {9, 7}
+    # both returned votes verify to a third party
+    from agnes_tpu.crypto.encoding import vote_signing_bytes
+    for w in (first, second):
+        msg = vote_signing_bytes(w.height, w.round, int(w.typ), w.value)
+        assert native.verify(bytes(PUBKEYS[1]), msg, w.signature)
