@@ -108,6 +108,12 @@ class _Batch:
 
 
 def _concat(batches: List[_Batch]) -> _Batch:
+    if len(batches) == 1:
+        # no copy for the single-batch build (the hot fused path
+        # concatenates once in the eligibility gate and would
+        # otherwise memcpy every column again); callers never mutate
+        # batch columns in place (the nil normalization rebuilds)
+        return batches[0]
     sig = None
     if any(b.signature is not None for b in batches):
         sig = np.concatenate([
@@ -216,6 +222,11 @@ class VoteBatcher:
         # they extract (they carry them; slashing must anyway).
         self._dv_pubkeys: Optional[np.ndarray] = None
         self._emitted_lane_groups: List[_Batch] = []
+        # per-_log-entry pubkey table: None = logged post-screen
+        # (host-verified/unsigned build, nothing to re-check); an
+        # array = the device-verify build's epoch table to re-verify
+        # evidence candidates against
+        self._log_pk: List[Optional[np.ndarray]] = []
         self.rejected_signature = 0
         self.rejected_malformed = 0
         self.overflow_votes = 0
@@ -410,9 +421,13 @@ class VoteBatcher:
         b = b.take(np.nonzero(ok & h_ok)[0])
         if len(b) == 0:
             return []
-        # normalize the nil encoding (contract: any value < 0 is nil)
+        # normalize the nil encoding (contract: any value < 0 is nil).
+        # Rebuild rather than mutate: batch columns can alias caller
+        # arrays (add_arrays is zero-copy) via _concat's 1-batch path.
         if (b.value < _NIL).any():
-            b.value[b.value < 0] = _NIL
+            b = _Batch(b.instance, b.validator, b.height, b.round,
+                       b.typ, np.where(b.value < 0, _NIL, b.value),
+                       b.signature)
 
         # --- hold back future rounds BEFORE verification: they are
         # verified (and logged) once, when the window reaches them —
@@ -451,8 +466,13 @@ class VoteBatcher:
                 if len(b) == 0:
                     return []
 
-        # --- retain verified votes for slashable evidence
+        # --- retain votes for slashable evidence.  Host-verified and
+        # unsigned builds log post-screen; device-verify builds log
+        # PRE-verdict, so the build's pubkey table rides along
+        # (_log_pk) and signed_evidence re-verifies against exactly
+        # that epoch (key-rotation safe) before trusting an entry.
         self._log.append(b)
+        self._log_pk.append(self._dv_pubkeys)
 
         # --- past (rotated-out) rounds go to the host tally
         past = (b.round - self.base_round[b.instance]) < 0
@@ -639,7 +659,6 @@ class VoteBatcher:
         if self.verify_mode != "lanes" or not self._device_verify_eligible():
             return self.build_phases(pubkeys), None
         self._emitted_lane_groups = []
-        self._evidence_pubkeys = np.asarray(pubkeys)
         phases = self.build_phases(pubkeys, _device_verify=True)
         groups, self._emitted_lane_groups = self._emitted_lane_groups, []
         self._dv_pubkeys = None
@@ -753,27 +772,26 @@ class VoteBatcher:
         and different values.  Returns (first, second) WireVotes whose
         signatures prove the double-sign to any third party, or None.
 
-        When device-verify builds were used, the log is PRE-verdict —
-        a forged vote could otherwise shadow a real provable pair (or
-        fabricate an unprovable one), so every candidate vote is then
-        re-verified host-side here and unverifiable votes are skipped;
-        only a pair that proves to a third party is ever returned.
-        (Host-verified builds log post-filter, so the screen is a
-        no-op there and is skipped.)"""
-        pk = getattr(self, "_evidence_pubkeys", None)
-
-        def provable(k, batch) -> bool:
-            if pk is None:
-                return True
-            if batch.signature is None:
-                return False
-            sub = batch.take(np.array([k]))
-            return bool(np.asarray(self._verify(sub, pk))[0])
-
+        Batches logged by device-verify builds are PRE-verdict — a
+        forged vote could otherwise shadow a real provable pair (or
+        fabricate an unprovable one) — so their candidate votes are
+        re-verified here against the pubkey table OF THAT BUILD
+        (key-rotation safe; _log_pk) in one batched call per logged
+        build, and unverifiable votes are skipped.  Host-verified and
+        unsigned builds logged post-screen and are trusted as
+        before."""
         seen: Dict[Tuple[int, int, int], Tuple[int, Optional[bytes]]] = {}
-        for batch in self._log:
+        for bi, batch in enumerate(self._log):
             hit = np.nonzero((batch.instance == instance)
                              & (batch.validator == validator))[0]
+            if len(hit) == 0:
+                continue
+            pk = self._log_pk[bi] if bi < len(self._log_pk) else None
+            if pk is not None:
+                if batch.signature is None:
+                    continue
+                good = np.asarray(self._verify(batch.take(hit), pk))
+                hit = hit[good.astype(bool)]
             for k in hit:
                 key = (int(batch.height[k]), int(batch.round[k]),
                        int(batch.typ[k]))
@@ -781,11 +799,8 @@ class VoteBatcher:
                 sig = (batch.signature[k].tobytes()
                        if batch.signature is not None else None)
                 if key not in seen:
-                    if provable(k, batch):
-                        seen[key] = (val, sig)
+                    seen[key] = (val, sig)
                 elif seen[key][0] != val:
-                    if not provable(k, batch):
-                        continue
                     h, r, t = key
                     fv, fsig = seen[key]
 

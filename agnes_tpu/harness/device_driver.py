@@ -252,16 +252,28 @@ class DeviceDriver:
             self.propose_value, advance_height=self.advance_height)
         self.state, self.tally = out.state, out.tally
         self.stats.steps += P
-        self.stats.votes_ingested += int(lanes.phase_idx.shape[0])
+        # real lanes only (padding excluded); device rejects are
+        # subtracted at settle time so the counter converges to
+        # ACCEPTED votes — the same meaning the host-verified paths
+        # give it (their phases are post-filter)
+        self.stats.votes_ingested += int(np.asarray(lanes.real).sum())
         self._pending_rejects.append(out.n_rejected)
         if self.defer_collect:
             self._deferred_msgs.append(out.msgs)
         else:
             self._collect(out.msgs)
-            rejects, self._pending_rejects = self._pending_rejects, []
-            for r in rejects:
-                self.rejected_signature_device += int(np.asarray(r))
+            self._settle_rejects()
         return out.msgs
+
+    def _settle_rejects(self) -> None:
+        """Fold deferred device-verify reject counts into the stats
+        (forces a device fetch per pending count — call from collect/
+        block_until_ready, never mid-pipeline)."""
+        rejects, self._pending_rejects = self._pending_rejects, []
+        for r in rejects:
+            n = int(np.asarray(r))
+            self.rejected_signature_device += n
+            self.stats.votes_ingested -= n
 
     def _collect(self, msgs) -> None:
         """Fold one message batch into the stats.  Leaves are
@@ -398,9 +410,7 @@ class DeviceDriver:
         msgs, self._deferred_msgs = self._deferred_msgs, []
         for m in msgs:
             self._collect(m)
-        rejects, self._pending_rejects = self._pending_rejects, []
-        for r in rejects:
-            self.rejected_signature_device += int(np.asarray(r))
+        self._settle_rejects()
 
     def block_until_ready(self):
         self.collect()

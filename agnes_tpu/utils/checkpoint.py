@@ -202,18 +202,41 @@ def save_batcher(bat, path: str) -> None:
             smap[i, s] = vid
     leaves["slot_values"] = smap
     if bat._log:
-        log = _concat(bat._log)
+        log = _concat(bat._log)    # zero-fills sig-less batches (>1);
         for f in ("instance", "validator", "height", "round", "typ",
                   "value"):
             leaves["log." + f] = getattr(log, f)
         if log.signature is not None:
             leaves["log.signature"] = log.signature
-            # _concat zero-fills batches logged WITHOUT signatures; a
-            # per-row mask keeps those None after restore (all-zero
-            # bytes must never surface as 'signed' evidence)
+            # per-row mask keeps zero-filled rows None after restore
+            # (all-zero bytes must never surface as 'signed' evidence)
             leaves["log.has_sig"] = np.concatenate(
                 [np.full(len(b), b.signature is not None)
                  for b in bat._log])
+        # device-verify evidence epochs (_log_pk): per-row index into a
+        # stacked table set, -1 = logged post-screen (trusted) — so a
+        # restore re-verifies pre-verdict rows against the SAME pubkey
+        # epoch the live batcher would have used
+        log_pk = list(bat._log_pk) + [None] * (len(bat._log)
+                                               - len(bat._log_pk))
+        tables: list = []
+        row_ep = []
+        for b, pk in zip(bat._log, log_pk):
+            if pk is None:
+                row_ep.append(np.full(len(b), -1, np.int64))
+                continue
+            pk = np.asarray(pk)
+            for j, t in enumerate(tables):
+                if np.array_equal(t, pk):
+                    idx = j
+                    break
+            else:
+                tables.append(pk)
+                idx = len(tables) - 1
+            row_ep.append(np.full(len(b), idx, np.int64))
+        if tables:
+            leaves["log.pk_epoch"] = np.concatenate(row_ep)
+            leaves["log.pk_tables"] = np.stack(tables)
     _atomic_savez(path, leaves)
 
 
@@ -245,24 +268,36 @@ def load_batcher(path: str):
             cols = tuple(z["log." + f] for f in
                          ("instance", "validator", "height", "round",
                           "typ", "value"))
+            n_rows = len(cols[0])
+            ep = (z["log.pk_epoch"] if "log.pk_epoch" in z.files
+                  else np.full(n_rows, -1, np.int64))
+            tables = (z["log.pk_tables"] if "log.pk_tables" in z.files
+                      else None)
             if "log.signature" not in z.files:
                 bat._log = [_Batch(*cols, None)]
+                bat._log_pk = [None]
             else:
                 # Rebuild preserving the ARRIVAL interleaving: split the
                 # concatenated rows into maximal runs of constant
-                # signedness (the original batch boundaries are gone, but
-                # run order == arrival order), so signed_evidence() scans
-                # rows in the same order before and after a restore and
-                # extracts the same conflicting pair.
+                # (signedness, evidence-epoch) — the original batch
+                # boundaries are gone, but run order == arrival order —
+                # so signed_evidence() scans rows in the same order and
+                # re-verifies pre-verdict rows against the same pubkey
+                # epoch before and after a restore.
                 has = z["log.has_sig"]
                 sig = z["log.signature"]
-                cuts = np.flatnonzero(np.diff(has.astype(np.int8)))
-                bounds = np.concatenate(([0], cuts + 1, [len(has)]))
-                bat._log = [
-                    _Batch(*(c[lo:hi] for c in cols),
-                           sig[lo:hi] if has[lo] else None)
-                    for lo, hi in zip(bounds[:-1], bounds[1:])
-                    if hi > lo]
+                key = has.astype(np.int64) * (int(ep.max()) + 2) + ep
+                cuts = np.flatnonzero(np.diff(key))
+                bounds = np.concatenate(([0], cuts + 1, [n_rows]))
+                bat._log, bat._log_pk = [], []
+                for lo, hi in zip(bounds[:-1], bounds[1:]):
+                    if hi <= lo:
+                        continue
+                    bat._log.append(_Batch(
+                        *(c[lo:hi] for c in cols),
+                        sig[lo:hi] if has[lo] else None))
+                    bat._log_pk.append(
+                        tables[ep[lo]] if ep[lo] >= 0 else None)
     return bat
 
 

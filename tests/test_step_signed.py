@@ -207,3 +207,47 @@ def test_evidence_screens_forged_votes_in_device_mode():
     for w in (first, second):
         msg = vote_signing_bytes(w.height, w.round, int(w.typ), w.value)
         assert native.verify(bytes(PUBKEYS[1]), msg, w.signature)
+
+
+def test_evidence_survives_key_rotation_epochs(tmp_path):
+    """A double-sign whose two votes were logged under DIFFERENT
+    device-verify pubkey epochs must still prove: each candidate
+    re-verifies against ITS build's table (_log_pk), not the latest
+    one — and the epoch association survives a checkpoint roundtrip."""
+    from agnes_tpu.utils.checkpoint import load_batcher, save_batcher
+
+    bat = VoteBatcher(I, V, n_slots=4)
+    d = DeviceDriver(I, V)
+    d.step()
+    bat.sync_device(np.asarray(d.tally.base_round),
+                    np.asarray(d.state.height))
+    bat.add_arrays(*_signed_cols(0, PV, 7))        # epoch-1 keys, value 7
+    phases, lanes = bat.build_phases_device(PUBKEYS)
+    assert lanes is not None
+    # rotate validator 2's key for the next build (epoch 2)
+    new_seeds = list(SEEDS)
+    new_seeds[2] = bytes([99]) + bytes(31)
+    new_pub = PUBKEYS.copy()
+    new_pub[2] = np.frombuffer(native.pubkey(new_seeds[2]), np.uint8)
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+    msgs = vote_messages_np(np.zeros(V), np.zeros(V, np.int64),
+                            np.full(V, PV), np.full(V, 9))
+    sigs = np.stack([np.frombuffer(
+        native.sign(new_seeds[v], msgs[v].tobytes()), np.uint8)
+        for v in range(V)])
+    bat.add_arrays(inst, val, np.zeros(n), np.zeros(n), np.full(n, PV),
+                   np.full(n, 9), sigs[val])
+    phases2, lanes2 = bat.build_phases_device(new_pub)
+    assert lanes2 is not None
+    # validator 2 double-signed: 7 under the old key, 9 under the new —
+    # both provable only against their own epoch tables
+    ev = bat.signed_evidence(0, 2)
+    assert ev is not None and {ev[0].value, ev[1].value} == {7, 9}
+    # and the pairing survives persistence
+    p = str(tmp_path / "bat.npz")
+    save_batcher(bat, p)
+    bat2 = load_batcher(p)
+    ev2 = bat2.signed_evidence(0, 2)
+    assert ev2 is not None and {ev2[0].value, ev2[1].value} == {7, 9}
