@@ -52,6 +52,13 @@ from agnes_tpu.device.tally import (
 )
 from agnes_tpu.types import NIL_ID, VoteType
 
+# module-scope, NOT lazy: ed25519_jax builds module-level limb-constant
+# arrays at import; importing it for the first time INSIDE a jit trace
+# (consensus_step_seq_signed) would create those constants as tracers
+# and leak them into module globals (UnexpectedTracerError on the next
+# independent trace that touches them)
+from agnes_tpu.crypto import ed25519_jax as _ejax
+
 # "no event" tag: matches no transition arm -> guaranteed no-op
 NULL_EVENT = NO_EVENT
 
@@ -359,9 +366,7 @@ def consensus_step_seq_signed(state: DeviceState,
     pipeline.  (Reference anchor: the verify responsibility stubbed at
     consensus_executor.rs:38-41, resolved on device instead of in the
     consumer.)"""
-    from agnes_tpu.crypto import ed25519_jax as ejax
-
-    ok = ejax.verify_batch(lanes.pub, lanes.sig, lanes.blocks)   # [N]
+    ok = _ejax.verify_batch(lanes.pub, lanes.sig, lanes.blocks)  # [N]
     P, I, V = phases.mask.shape
     # padding lanes carry an out-of-range phase_idx: mode="drop" makes
     # their scatter a no-op, and `real` keeps them out of the count

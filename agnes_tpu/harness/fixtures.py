@@ -1,0 +1,73 @@
+"""Shared signed-traffic fixtures for benches, tests and the driver
+compile check.
+
+One canonical builder for "every validator signs its vote for one
+(height, class, value)" traffic — the entry compile check
+(__graft_entry__), the fused pipeline bench (bench.py) and the
+differential suite (tests/test_step_signed.py) all consume THIS, so a
+change to the canonical signing-message layout (vote_messages_np) or
+the seed convention cannot silently diverge between the path that is
+compile-checked, the path that is benched, and the path that is
+tested."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from agnes_tpu.bridge.ingest import vote_messages_np
+from agnes_tpu.core import native
+
+
+def deterministic_seeds(n_validators: int) -> List[bytes]:
+    """The fixture keyspace: 32-byte seeds derived from the validator
+    index (little-endian in the first 4 bytes)."""
+    return [v.to_bytes(4, "little") + bytes(28)
+            for v in range(n_validators)]
+
+
+def validator_pubkeys(seeds: List[bytes]) -> np.ndarray:
+    """[V, 32] uint8 table for the given seeds."""
+    return np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                     for s in seeds])
+
+
+def sign_class(seeds: List[bytes], height: int, typ: int, value: int,
+               round_: int = 0,
+               forge_validator: Optional[int] = None) -> np.ndarray:
+    """[V, 64] uint8 signatures, one per validator, over the canonical
+    vote message for (height, round, typ, value).  `forge_validator`
+    signs with its neighbor's key instead (a forged lane that fails
+    verification against the validator's own pubkey)."""
+    V = len(seeds)
+    msgs = vote_messages_np(np.full(V, height, np.int64),
+                            np.full(V, round_, np.int64),
+                            np.full(V, typ, np.int64),
+                            np.full(V, value, np.int64))
+    sigs = np.stack([np.frombuffer(
+        native.sign(seeds[v], msgs[v].tobytes()), np.uint8)
+        for v in range(V)])
+    if forge_validator is not None:
+        wrong = (forge_validator + 1) % V
+        sigs[forge_validator] = np.frombuffer(
+            native.sign(seeds[wrong],
+                        msgs[forge_validator].tobytes()), np.uint8)
+    return sigs
+
+
+def full_mesh_cols(n_instances: int, n_validators: int, seeds: List[bytes],
+                   height: int, typ: int, value: int, round_: int = 0,
+                   forge_validator: Optional[int] = None) -> Tuple:
+    """add_arrays/push column set for "every validator votes `value`
+    in every instance", with real signatures: (instance, validator,
+    height, round, typ, value, signatures[N, 64])."""
+    I, V = n_instances, n_validators
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+    sigs = sign_class(seeds, height, typ, value, round_=round_,
+                      forge_validator=forge_validator)
+    return (inst, val, np.full(n, height, np.int64),
+            np.full(n, round_, np.int64), np.full(n, typ, np.int64),
+            np.full(n, value, np.int64), sigs[val])

@@ -282,6 +282,16 @@ def _dispatch_phases(d, phases) -> None:
         d.step_seq(phases)
 
 
+def _sign_height_sigs(seeds, h):
+    """{vote class -> [V, 64] signatures} for one honest height — the
+    shared fixture (harness/fixtures.py) so the benched signing layout
+    is the one the compile check and the differential tests use."""
+    from agnes_tpu.harness.fixtures import sign_class
+
+    return {typ: sign_class(seeds, h, typ, 7)
+            for typ in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT))}
+
+
 def _signed_fixture(batch):
     from agnes_tpu.core import native
     from agnes_tpu.crypto import ed25519_jax as ejax
@@ -460,15 +470,7 @@ def _pipeline_harness(n_instances: int, n_validators: int, heights: int,
 
     def sign_height(h):
         """2V fresh signatures (one per validator per class)."""
-        out = {}
-        for typ in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
-            msgs = vote_messages_np(
-                np.full(V, h), np.zeros(V, np.int64),
-                np.full(V, typ), np.full(V, 7))
-            out[typ] = np.stack([
-                np.frombuffer(native.sign(seeds[v], msgs[v].tobytes()),
-                              np.uint8) for v in range(V)])
-        return out
+        return _sign_height_sigs(seeds, h)
 
     def run_height(h, sigs_by_typ):
         d.step()                       # entry + self proposal
@@ -575,15 +577,7 @@ def _pipeline_overlapped(n_instances: int, n_validators: int,
     n = I * V
 
     def sign_height(h):
-        out = {}
-        for typ in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
-            msgs = vote_messages_np(
-                np.full(V, h), np.zeros(V, np.int64),
-                np.full(V, typ), np.full(V, 7))
-            out[typ] = np.stack([
-                np.frombuffer(native.sign(seeds[v], msgs[v].tobytes()),
-                              np.uint8) for v in range(V)])
-        return out
+        return _sign_height_sigs(seeds, h)
 
     def run_height(h, sigs_by_typ):
         with span("entry_dispatch"):
@@ -653,15 +647,7 @@ def _pipeline_fused(n_instances: int, n_validators: int,
     n = I * V
 
     def sign_height(h):
-        out = {}
-        for typ in (int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)):
-            msgs = vote_messages_np(
-                np.full(V, h), np.zeros(V, np.int64),
-                np.full(V, typ), np.full(V, 7))
-            out[typ] = np.stack([
-                np.frombuffer(native.sign(seeds[v], msgs[v].tobytes()),
-                              np.uint8) for v in range(V)])
-        return out
+        return _sign_height_sigs(seeds, h)
 
     def run_height(h, sigs_by_typ):
         bat.sync_device(np.zeros(I, np.int64), np.full(I, h, np.int64))

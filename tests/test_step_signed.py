@@ -16,33 +16,26 @@ from agnes_tpu.bridge import VoteBatcher
 from agnes_tpu.bridge.ingest import vote_messages_np
 from agnes_tpu.core import native
 from agnes_tpu.harness.device_driver import DeviceDriver
+from agnes_tpu.harness.fixtures import (
+    deterministic_seeds,
+    full_mesh_cols,
+    validator_pubkeys,
+)
 from agnes_tpu.types import VoteType
 
 PV, PC = int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)
 
 I, V = 3, 4
-SEEDS = [bytes([v + 1]) + bytes(31) for v in range(V)]
-PUBKEYS = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
-                    for s in SEEDS])
+SEEDS = deterministic_seeds(V)
+PUBKEYS = validator_pubkeys(SEEDS)
 
 
 def _signed_cols(h, typ, value, forge_validator=None):
-    """Full-mesh (every instance x validator) columns + signatures."""
-    inst = np.repeat(np.arange(I), V)
-    val = np.tile(np.arange(V), I)
-    n = I * V
-    msgs = vote_messages_np(np.full(V, h), np.zeros(V, np.int64),
-                            np.full(V, typ), np.full(V, value))
-    sigs = np.stack([np.frombuffer(
-        native.sign(SEEDS[v], msgs[v].tobytes()), np.uint8)
-        for v in range(V)])
-    if forge_validator is not None:
-        wrong = (forge_validator + 1) % V
-        sigs[forge_validator] = np.frombuffer(
-            native.sign(SEEDS[wrong],
-                        msgs[forge_validator].tobytes()), np.uint8)
-    return (inst, val, np.full(n, h), np.zeros(n), np.full(n, typ),
-            np.full(n, value), sigs[val])
+    """Full-mesh (every instance x validator) columns + signatures —
+    the shared fixture, so the tested signing layout is the one the
+    compile check and the bench use."""
+    return full_mesh_cols(I, V, SEEDS, h, typ, value,
+                          forge_validator=forge_validator)
 
 
 def _drive(device_verify: bool, forge_validator=None):
