@@ -311,17 +311,22 @@ class VoteBatcher:
 
     # -- signature verification ----------------------------------------------
 
-    def _pack_verify_inputs(self, b: _Batch, pubkeys: np.ndarray):
-        """(pub, sig, blocks) Ed25519 verify-kernel inputs for a batch
-        — the ONE packing recipe, shared by the host-side _verify and
-        the device-fused lane packer so the two paths cannot desync."""
+    def _pack_verify_inputs_np(self, b: _Batch, pubkeys: np.ndarray):
+        """Numpy (pub, sig, blocks) Ed25519 verify-kernel inputs for a
+        batch — the ONE packing recipe, shared by the host-side
+        _verify, the device-fused lane packer and the dense builder so
+        the paths cannot desync (and so dense scattering never has to
+        fetch freshly uploaded device arrays back to the host)."""
         msg = vote_messages_np(b.height, b.round, b.typ, b.value)
         a_bytes = np.asarray(pubkeys)[b.validator]        # [N, 32]
         sig = (b.signature if b.signature is not None
                else np.zeros((len(b), 64), np.uint8))
-        blocks = jnp.asarray(_sha_blocks_np(sig[:, :32], a_bytes, msg))
-        return (jnp.asarray(a_bytes.astype(np.int32)),
-                jnp.asarray(sig.astype(np.int32)), blocks)
+        return (a_bytes.astype(np.int32), sig.astype(np.int32),
+                _sha_blocks_np(sig[:, :32], a_bytes, msg))
+
+    def _pack_verify_inputs(self, b: _Batch, pubkeys: np.ndarray):
+        pub, sig, blocks = self._pack_verify_inputs_np(b, pubkeys)
+        return jnp.asarray(pub), jnp.asarray(sig), jnp.asarray(blocks)
 
     def _verify(self, b: _Batch, pubkeys: np.ndarray) -> np.ndarray:
         """Batch-verify on the JAX plane; pubkeys [V, 32] uint8 is the
@@ -656,19 +661,10 @@ class VoteBatcher:
         variable per-tick vote counts reuse a logarithmic number of
         compiled (P, N) shapes instead of recompiling the fused step
         per tick."""
-        if self.verify_mode != "lanes" or not self._device_verify_eligible():
-            return self.build_phases(pubkeys), None
-        self._emitted_lane_groups = []
-        phases = self.build_phases(pubkeys, _device_verify=True)
-        groups, self._emitted_lane_groups = self._emitted_lane_groups, []
-        self._dv_pubkeys = None
-        if not phases:
-            return [], None
-        assert len(groups) == len(phases)
-        cat = _concat(groups)
-        phase_idx = np.concatenate(
-            [np.full(len(g), phase_offset + i, np.int64)
-             for i, g in enumerate(groups)])
+        phases, cat, pidx = self._build_device_common(pubkeys)
+        if cat is None:
+            return phases, None
+        phase_idx = pidx + phase_offset
         n = len(cat)
         n_pad = 1 << (n - 1).bit_length()
         real = np.ones(n_pad, bool)
@@ -688,6 +684,53 @@ class VoteBatcher:
             val=jnp.asarray(cat.validator, jnp.int32),
             real=jnp.asarray(real))
         return phases, lanes
+
+    def _build_device_common(self, pubkeys: np.ndarray):
+        """Shared device-verify build core: (phases, cat, phase_idx)
+        with 0-based numpy phase indices, or (host-verified phases,
+        None, None) on the fallback paths (ineligible traffic, MSM
+        mode, or an all-host-fallback build)."""
+        if self.verify_mode != "lanes" or not self._device_verify_eligible():
+            return self.build_phases(pubkeys), None, None
+        self._emitted_lane_groups = []
+        phases = self.build_phases(pubkeys, _device_verify=True)
+        groups, self._emitted_lane_groups = self._emitted_lane_groups, []
+        self._dv_pubkeys = None
+        if not phases:
+            return [], None, None
+        assert len(groups) == len(phases)
+        cat = _concat(groups)
+        phase_idx = np.concatenate([np.full(len(g), i, np.int64)
+                                    for i, g in enumerate(groups)])
+        return phases, cat, phase_idx
+
+    def build_phases_device_dense(self, pubkeys: np.ndarray):
+        """build_phases_device in the DENSE lane layout that shards
+        under shard_map (device/step.py DenseSignedPhases): returns
+        (phases, DenseSignedPhases) with sig/blocks scattered to
+        [Ps, I, V, ...] — feed to DeviceDriver.step_seq_signed_dense
+        (single chip or mesh).  Cells without a vote hold zeros and
+        verify False, which the mask AND discards.  Same eligibility
+        gate and host-fallback screening as build_phases_device; falls
+        back to (host-verified phases, None) identically.  The scatter
+        stays entirely in numpy (one device upload at the end — never
+        a fetch of freshly uploaded lane arrays)."""
+        phases, cat, pidx = self._build_device_common(pubkeys)
+        if cat is None:
+            return phases, None
+        from agnes_tpu.device.step import DenseSignedPhases
+
+        Ps = len(phases)
+        _, sig_np, blocks_np = self._pack_verify_inputs_np(cat, pubkeys)
+        sig = np.zeros((Ps, self.I, self.V, 64), np.int32)
+        blocks = np.zeros((Ps, self.I, self.V) + blocks_np.shape[1:],
+                          blocks_np.dtype)
+        sig[pidx, cat.instance, cat.validator] = sig_np
+        blocks[pidx, cat.instance, cat.validator] = blocks_np
+        dense = DenseSignedPhases(
+            pub=jnp.asarray(np.asarray(pubkeys).astype(np.int32)),
+            sig=jnp.asarray(sig), blocks=jnp.asarray(blocks))
+        return phases, dense
 
     def _intern_and_spill(self, b: _Batch, layer: Optional[np.ndarray] = None):
         """Intern slots; votes whose value overflows the instance's

@@ -327,7 +327,9 @@ class SignedStepOutputs(NamedTuple):
     state: DeviceState
     tally: TallyState
     msgs: DeviceMessage      # [P, n_stages, I] leaves
-    n_rejected: jnp.ndarray  # scalar: lanes that failed verification
+    n_rejected: jnp.ndarray  # failed-verification count: scalar (lane
+    #                          path) or [I] per-instance (dense path);
+    #                          consumers sum (driver._settle_rejects)
 
 
 def consensus_step_seq_signed(state: DeviceState,
@@ -384,6 +386,68 @@ def consensus_step_seq_signed(state: DeviceState,
 
 consensus_step_seq_signed_jit = jax.jit(
     consensus_step_seq_signed, static_argnames=("advance_height",))
+
+
+class DenseSignedPhases(NamedTuple):
+    """Dense per-cell Ed25519 inputs for the SHARDED fused path: entry
+    (p, i, v) holds the signature material for phase `P - Ps + p`'s
+    vote by validator v in instance i (the LAST Ps phases of the
+    sequence are the signed vote classes; leading phases — e.g. the
+    round-entry phase — carry no lanes).  The dense [.., I, V, ..]
+    layout shards exactly like the phase masks (data x val), so under
+    shard_map each device verifies its own cells LOCALLY — fused
+    verification adds no collective; the tally's quorum psums remain
+    the only communication (parallel/sharded.py layout table)."""
+
+    pub: jnp.ndarray      # [V, 32] int32 validator table
+    sig: jnp.ndarray      # [Ps, I, V, 64] int32
+    blocks: jnp.ndarray   # [Ps, I, V, nb, 32] uint32
+
+
+def consensus_step_seq_signed_dense(state: DeviceState,
+                                    tally: TallyState,
+                                    exts: ExtEvent,       # [P, I]
+                                    phases: VotePhase,    # [P, I(, V)]
+                                    dense: DenseSignedPhases,
+                                    powers: jnp.ndarray,
+                                    total_power: jnp.ndarray,
+                                    proposer_flag: jnp.ndarray,
+                                    propose_value: jnp.ndarray,
+                                    axis_name: str | None = None,
+                                    advance_height: bool = False,
+                                    ) -> SignedStepOutputs:
+    """consensus_step_seq_signed with DENSE per-cell lanes — the
+    layout that runs under shard_map (make_sharded_step_seq_signed):
+    verification is elementwise in (instance, validator), so it
+    shards with the phases and each device verifies only its local
+    cells.  Unmasked cells verify garbage and are discarded by the
+    mask AND; `n_rejected` comes back PER INSTANCE ([I], psum'd over
+    the validator axis when sharded) counting masked cells whose
+    signature failed."""
+    Ps, I, V = dense.sig.shape[:3]
+    P = phases.mask.shape[0]
+    pub = jnp.broadcast_to(dense.pub[None, None], (Ps, I, V, 32))
+    ok = _ejax.verify_batch(
+        pub.reshape(Ps * I * V, 32),
+        dense.sig.reshape(Ps * I * V, 64),
+        dense.blocks.reshape(Ps * I * V, *dense.blocks.shape[3:]))
+    vmask = jnp.concatenate(
+        [jnp.ones((P - Ps, I, V), bool), ok.reshape(Ps, I, V)], axis=0)
+    n_rej = (phases.mask & ~vmask).sum(axis=(0, 2)).astype(I32)  # [I]
+    if axis_name is not None:
+        n_rej = jax.lax.psum(n_rej, axis_name)
+    phases = phases._replace(mask=phases.mask & vmask)
+    out = consensus_step_seq(state, tally, exts, phases, powers,
+                             total_power, proposer_flag, propose_value,
+                             axis_name=axis_name,
+                             advance_height=advance_height)
+    return SignedStepOutputs(state=out.state, tally=out.tally,
+                             msgs=out.msgs, n_rejected=n_rej)
+
+
+consensus_step_seq_signed_dense_jit = jax.jit(
+    consensus_step_seq_signed_dense,
+    static_argnames=("axis_name", "advance_height"))
 
 
 def honest_heights(state: DeviceState,

@@ -35,6 +35,7 @@ from agnes_tpu.device.step import (
     VotePhase,
     consensus_step_jit,
     consensus_step_seq_jit,
+    consensus_step_seq_signed_dense_jit,
     consensus_step_seq_signed_jit,
     honest_heights_jit,
 )
@@ -85,10 +86,13 @@ class DeviceDriver:
             from agnes_tpu.parallel import (
                 make_sharded_step,
                 make_sharded_step_seq,
+                make_sharded_step_seq_signed,
             )
             self._sharded_step = make_sharded_step(
                 mesh, advance_height=advance_height)
             self._sharded_step_seq = make_sharded_step_seq(
+                mesh, advance_height=advance_height)
+            self._sharded_step_seq_signed = make_sharded_step_seq_signed(
                 mesh, advance_height=advance_height)
             self._sharded_honest: dict = {}   # heights -> jitted fn
         self.cfg = TallyConfig(n_validators=n_validators, n_rounds=n_rounds,
@@ -242,21 +246,31 @@ class DeviceDriver:
             raise NotImplementedError(
                 "device-verified stepping is single-device; mesh "
                 "drivers verify on the host path")
-        P = len(phases)
-        exts = exts if exts is not None else [self.ext()] * P
-        phases_st = jax.tree.map(lambda *xs: jnp.stack(xs), *phases)
-        exts_st = jax.tree.map(lambda *xs: jnp.stack(xs), *exts)
+        phases_st, exts_st, P = self._stack_seq(phases, exts)
         out = consensus_step_seq_signed_jit(
             self.state, self.tally, exts_st, phases_st, lanes,
             self.powers, self.total, self.proposer_flag,
             self.propose_value, advance_height=self.advance_height)
-        self.state, self.tally = out.state, out.tally
-        self.stats.steps += P
         # real lanes only (padding excluded); device rejects are
         # subtracted at settle time so the counter converges to
         # ACCEPTED votes — the same meaning the host-verified paths
         # give it (their phases are post-filter)
-        self.stats.votes_ingested += int(np.asarray(lanes.real).sum())
+        return self._finish_signed(out, P,
+                                   int(np.asarray(lanes.real).sum()))
+
+    def _stack_seq(self, phases, exts):
+        P = len(phases)
+        exts = exts if exts is not None else [self.ext()] * P
+        phases_st = jax.tree.map(lambda *xs: jnp.stack(xs), *phases)
+        exts_st = jax.tree.map(lambda *xs: jnp.stack(xs), *exts)
+        return phases_st, exts_st, P
+
+    def _finish_signed(self, out, P: int, n_votes: int):
+        """Shared tail of the signed step variants: stats, deferred
+        reject settlement, message collection."""
+        self.state, self.tally = out.state, out.tally
+        self.stats.steps += P
+        self.stats.votes_ingested += n_votes
         self._pending_rejects.append(out.n_rejected)
         if self.defer_collect:
             self._deferred_msgs.append(out.msgs)
@@ -268,12 +282,40 @@ class DeviceDriver:
     def _settle_rejects(self) -> None:
         """Fold deferred device-verify reject counts into the stats
         (forces a device fetch per pending count — call from collect/
-        block_until_ready, never mid-pipeline)."""
+        block_until_ready, never mid-pipeline).  Counts are scalars
+        from the lane path or [I] from the dense/sharded path."""
         rejects, self._pending_rejects = self._pending_rejects, []
         for r in rejects:
-            n = int(np.asarray(r))
+            n = int(np.asarray(r).sum())
             self.rejected_signature_device += n
             self.stats.votes_ingested -= n
+
+    def step_seq_signed_dense(self, phases, dense, exts=None
+                              ) -> "jnp.ndarray":
+        """Fused verify+step with DENSE per-cell lanes
+        (consensus_step_seq_signed_dense) — the variant that also runs
+        on a MESH (make_sharded_step_seq_signed: each device verifies
+        its local (instance, validator) cells; no added collectives).
+        `dense` must align with the TAIL len(dense.sig) phases of
+        `phases` (leading phases, e.g. the entry phase, carry no
+        lanes).  Build both with VoteBatcher.build_phases_device_dense
+        and prepend driver-side phases as needed."""
+        phases_st, exts_st, P = self._stack_seq(phases, exts)
+        if self.mesh is not None:
+            # jit reshards the host-built arrays per the in_specs
+            out = self._sharded_step_seq_signed(
+                self.state, self.tally, exts_st, phases_st, dense,
+                self.powers, self.total, self.proposer_flag,
+                self.propose_value)
+        else:
+            out = consensus_step_seq_signed_dense_jit(
+                self.state, self.tally, exts_st, phases_st, dense,
+                self.powers, self.total, self.proposer_flag,
+                self.propose_value,
+                advance_height=self.advance_height)
+        return self._finish_signed(
+            out, P, int(sum(int(np.asarray(p.mask).sum())
+                            for p in phases)))
 
     def _collect(self, msgs) -> None:
         """Fold one message batch into the stats.  Leaves are

@@ -27,5 +27,6 @@ from agnes_tpu.parallel.sharded import (  # noqa: F401
     make_sharded_honest_heights,
     make_sharded_step,
     make_sharded_step_seq,
+    make_sharded_step_seq_signed,
     shard_step_args,
 )

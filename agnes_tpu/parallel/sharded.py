@@ -44,11 +44,14 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from agnes_tpu.device.step import (
+    DenseSignedPhases,
     ExtEvent,
+    SignedStepOutputs,
     StepOutputs,
     VotePhase,
     consensus_step,
     consensus_step_seq,
+    consensus_step_seq_signed_dense,
     honest_heights,
 )
 from agnes_tpu.device.tally import TallyState
@@ -143,6 +146,42 @@ def make_sharded_step_seq(mesh: Mesh, advance_height: bool = False):
                 advance_height=advance_height),
         mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=True)
+    return jax.jit(fn)
+
+
+def make_sharded_step_seq_signed(mesh: Mesh, advance_height: bool = False):
+    """consensus_step_seq_signed_dense sharded over `mesh`: the FUSED
+    verify+step sequence multi-chip.  The dense lane tensors shard
+    like the phase masks (data x val), the pubkey table like powers
+    (val), so each device runs the Ed25519 kernel on its local
+    (instance, validator) cells — fused verification adds ZERO
+    collectives; the tally's quorum psums stay the only communication.
+    n_rejected comes back [I] (sharded on the data axes, psum'd over
+    val inside)."""
+    da = _data_axes(mesh)
+    s = _in_specs(da)
+    dense_spec = DenseSignedPhases(
+        pub=P(VAL_AXIS),
+        sig=P(None, da, VAL_AXIS),
+        blocks=P(None, da, VAL_AXIS))
+    in_specs = (s[0], s[1], _prepend_none(s[2]), _prepend_none(s[3]),
+                dense_spec, s[4], s[5], s[6], s[7])
+    out_specs = SignedStepOutputs(state=_state_spec(da), tally=s[1],
+                                  msgs=P(None, None, da),
+                                  n_rejected=P(da))
+    # check_vma=False here (alone among the wrappers): the SHA-512
+    # compression scan inside the verify kernel carries its replicated
+    # H0 init constants into a varying loop, which the static VMA
+    # checker rejects (scan carry in/out vma mismatch) even though the
+    # computation is elementwise-local per cell.  The bitwise
+    # sharded-vs-unsharded differential (tests/test_step_signed.py
+    # test_dense_sharded_matches_unsharded) checks the VALUES the
+    # static pass would have vouched for.
+    fn = jax.shard_map(
+        partial(consensus_step_seq_signed_dense, axis_name=VAL_AXIS,
+                advance_height=advance_height),
+        mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False)
     return jax.jit(fn)
 
 

@@ -244,3 +244,79 @@ def test_evidence_survives_key_rotation_epochs(tmp_path):
     bat2 = load_batcher(p)
     ev2 = bat2.signed_evidence(0, 2)
     assert ev2 is not None and {ev2[0].value, ev2[1].value} == {7, 9}
+
+
+def test_dense_matches_lane_path():
+    """The dense per-cell layout (the shardable one) must agree with
+    the packed-lane layout bit-for-bit, honest and forged."""
+    for forge in (None, 0):
+        d1, b1 = DeviceDriver(I, V), VoteBatcher(I, V, n_slots=4)
+        d2, b2 = DeviceDriver(I, V), VoteBatcher(I, V, n_slots=4)
+        for d, b in ((d1, b1), (d2, b2)):
+            d.step()
+            b.sync_device(np.asarray(d.tally.base_round),
+                          np.asarray(d.state.height))
+            for typ in (PV, PC):
+                b.add_arrays(*_signed_cols(0, typ, 7,
+                                           forge_validator=forge))
+        ph1, lanes = b1.build_phases_device(PUBKEYS)
+        assert lanes is not None
+        d1.step_seq_signed([p for p, _ in ph1], lanes)
+        d1.collect()
+        ph2, dense = b2.build_phases_device_dense(PUBKEYS)
+        assert dense is not None
+        d2.step_seq_signed_dense([p for p, _ in ph2], dense)
+        d2.collect()
+        for a, c in zip(d1.tally, d2.tally):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        for a, c in zip(d1.state, d2.state):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        assert (d1.rejected_signature_device
+                == d2.rejected_signature_device)
+        assert d1.all_decided() and d2.all_decided()
+
+
+@pytest.mark.parametrize("hier", [False, True])
+def test_dense_sharded_matches_unsharded(hier):
+    """The SHARDED fused signed step (each device verifying its local
+    (instance, validator) cells; quorum psums unchanged) must be
+    bitwise-identical to the single-device dense path — the standing
+    sharded-vs-unsharded contract extended to fused verification,
+    forged lanes included."""
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        full_mesh_cols,
+        validator_pubkeys,
+    )
+    from agnes_tpu.parallel import make_hierarchical_mesh, make_mesh
+
+    mesh = make_hierarchical_mesh(2, 2, 2) if hier else make_mesh(2, 4)
+    I2, V2 = 4, 4
+    seeds = deterministic_seeds(V2)
+    pubs = validator_pubkeys(seeds)
+
+    def run(mesh_arg):
+        d = DeviceDriver(I2, V2, mesh=mesh_arg)
+        b = VoteBatcher(I2, V2, n_slots=4)
+        d.step()
+        b.sync_device(np.asarray(d.tally.base_round),
+                      np.asarray(d.state.height))
+        for typ in (PV, PC):
+            b.add_arrays(*full_mesh_cols(I2, V2, seeds, 0, typ, 7,
+                                         forge_validator=1))
+        phases, dense = b.build_phases_device_dense(pubs)
+        assert dense is not None
+        d.step_seq_signed_dense([p for p, _ in phases], dense)
+        d.collect()
+        return d
+
+    ds = run(mesh)
+    du = run(None)
+    for a, c in zip(ds.tally, du.tally):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    for a, c in zip(ds.state, du.state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    # validator 1 forged in both classes across all instances
+    assert ds.rejected_signature_device == 2 * I2
+    assert du.rejected_signature_device == 2 * I2
+    assert ds.all_decided() and du.all_decided()
