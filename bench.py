@@ -80,45 +80,21 @@ def _tpu_holders() -> list:
     the detached hardware-suite stages and any sibling bench.  While
     one is alive, a hanging jax.devices() in a fresh interpreter is
     EXPECTED (second-client behavior on this platform), so probing —
-    and above all killing hung probes — must wait.
+    and above all killing hung probes — must wait.  The detection
+    lives in scripts/tpu_holders.py (stdlib-only; run_hw_suite.sh's
+    probe loop uses the SAME screen, so the armed runner defers to a
+    driver-launched bench instead of killing probes against its
+    claim, and vice versa — neither side ever waits on a process that
+    is merely probing).  Local addition here: a SIBLING bench.py
+    counts only when it started earlier (ps etimes; pid breaks ties)
+    — the elder bench probes, the younger waits, so two benches never
+    busy-wait on each other to mutual -1s."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from scripts.tpu_holders import process_table, tpu_holders
 
-    Screens against false positives: only python/bash INVOCATIONS of
-    the known TPU entry points count (an editor or tail with bench.py
-    on its command line does not), and a SIBLING bench.py counts only
-    when it started earlier (ps etimes; pid breaks ties) — the elder
-    bench probes, the younger waits, so two driver-launched benches
-    can never busy-wait on each other to mutual -1s."""
-    pats = ("bench.py", "agnes_tpu.harness.configs", "profile_verify",
-            "run_hw_suite", "sweep_pipeline", "timing_check")
-    try:
-        out = subprocess.run(["ps", "-eo", "pid,ppid,etimes,args"],
-                             capture_output=True, text=True,
-                             timeout=30).stdout
-    except Exception:
-        return []
-    procs = {}
-    for ln in out.splitlines():
-        parts = ln.strip().split(None, 3)
-        if (len(parts) >= 4 and parts[0].isdigit()
-                and parts[1].isdigit() and parts[2].isdigit()):
-            procs[int(parts[0])] = (int(parts[1]), int(parts[2]),
-                                    parts[3])
-    # exclude self AND every ancestor: when the detached suite runner
-    # invokes `python bench.py`, the parent shell's own command line
-    # matches "run_hw_suite" — it is the caller, not a rival claim
-    skip, pid = set(), os.getpid()
-    while pid in procs and pid not in skip:
-        skip.add(pid)
-        pid = procs[pid][0]
-    my_age = procs.get(os.getpid(), (0, 0, ""))[1]
+    my_age = process_table().get(os.getpid(), (0, 0, ""))[1]
     holders = []
-    for p, (pp, age, args) in sorted(procs.items()):
-        if p in skip or not any(pat in args for pat in pats):
-            continue
-        interp = args.split(None, 1)[0].rsplit("/", 1)[-1]
-        if not (interp.startswith("python") or interp in ("bash", "sh")
-                or interp == "timeout"):
-            continue                      # editor/tail/grep, not a run
+    for p, age, args in tpu_holders():
         if "bench.py" in args and "agnes_tpu" not in args:
             # sibling bench: defer only to an ELDER one
             if age < my_age or (age == my_age and p > os.getpid()):

@@ -22,6 +22,16 @@ case "${XLA_FLAGS:-}" in
 esac
 echo "[runner] probing for TPU from $(date)" >> "$LOG"
 while true; do
+    # never probe while another agnes TPU process is alive (e.g. the
+    # driver-launched round-end bench): a second client's jax.devices()
+    # hangs by design, and timeout-killing that probe mid-claim can
+    # wedge the relay for hours.  Same screen bench.py uses
+    # (scripts/tpu_holders.py; exit 0 = nobody else is running).
+    if ! python scripts/tpu_holders.py >> "$LOG" 2>&1; then
+        echo "[runner] TPU held by another process at $(date); deferring 180s" >> "$LOG"
+        sleep 180
+        continue
+    fi
     if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "[runner] TPU alive at $(date)" >> "$LOG"
         break

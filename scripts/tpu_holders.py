@@ -1,0 +1,85 @@
+"""Who (else) holds the single-process TPU claim?  Stdlib-only (safe
+to import before jax/agnes — backend init must not be triggered by a
+probe helper), shared by bench.py's busy-wait guard and
+run_hw_suite.sh's probe loop so BOTH sides defer to a live TPU
+process instead of killing hung probes against its claim (a probe
+SIGTERM'd mid-claim is a documented cause of hours-long relay
+wedges).
+
+Screens against false positives: a process counts only when it is a
+python invocation of a known TPU entry point (or a bash/sh/timeout
+wrapper that itself launches python) — an editor or grep with
+bench.py on its command line does not.  Callers exclude themselves
+and their ancestor chain; sibling-bench tie-breaking stays in
+bench.py (it needs the caller's own identity)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Dict, List, Tuple
+
+PATTERNS = ("bench.py", "agnes_tpu.harness.configs", "profile_verify",
+            "sweep_pipeline", "timing_check")
+
+
+def process_table() -> Dict[int, Tuple[int, int, str]]:
+    """pid -> (ppid, etimes, args) from ps; {} on any failure."""
+    try:
+        out = subprocess.run(["ps", "-eo", "pid,ppid,etimes,args"],
+                             capture_output=True, text=True,
+                             timeout=30).stdout
+    except Exception:
+        return {}
+    procs: Dict[int, Tuple[int, int, str]] = {}
+    for ln in out.splitlines():
+        parts = ln.strip().split(None, 3)
+        if (len(parts) >= 4 and parts[0].isdigit()
+                and parts[1].isdigit() and parts[2].isdigit()):
+            procs[int(parts[0])] = (int(parts[1]), int(parts[2]),
+                                    parts[3])
+    return procs
+
+
+def is_tpu_invocation(args: str) -> bool:
+    """True iff `args` is a python run of a known TPU entry point
+    (directly, or via a bash/sh/timeout wrapper that launches
+    python).  Command lines longer than any plausible launcher are
+    rejected outright: agent/driver wrapper shells on this box embed
+    kilobytes of prompt text in argv that happens to MENTION the
+    entry-point names — matching them would make every holder check
+    defer forever against a process that holds nothing."""
+    if len(args) > 500 or not any(p in args for p in PATTERNS):
+        return False
+    head, _, rest = args.partition(" ")
+    interp = head.rsplit("/", 1)[-1]
+    if interp.startswith("python"):
+        return True
+    return interp in ("bash", "sh", "timeout") and "python" in rest
+
+
+def ancestor_chain(procs, pid: int) -> set:
+    """pid plus every ancestor (a wrapper parent like
+    `sh -c 'python bench.py ...'` matches the patterns but is the
+    caller's own lineage, not a rival claim)."""
+    chain = set()
+    while pid in procs and pid not in chain:
+        chain.add(pid)
+        pid = procs[pid][0]
+    return chain
+
+
+def tpu_holders() -> List[Tuple[int, int, str]]:
+    """[(pid, etimes, args)] of other live TPU-entry-point processes,
+    self and ancestors excluded, pid-sorted."""
+    procs = process_table()
+    skip = ancestor_chain(procs, os.getpid())
+    return [(p, age, args) for p, (pp, age, args) in sorted(procs.items())
+            if p not in skip and is_tpu_invocation(args)]
+
+
+if __name__ == "__main__":
+    hs = tpu_holders()
+    for p, age, args in hs:
+        print(f"{p} {args}")
+    raise SystemExit(1 if hs else 0)
