@@ -40,6 +40,14 @@ import subprocess
 import sys
 import time
 
+# make `from scripts.tpu_holders import ...` resolve regardless of the
+# caller's cwd (guarded: __file__ is absent when the probe-guard
+# prefix of this file is exec'd standalone)
+if "__file__" in globals():
+    _here = os.path.dirname(os.path.abspath(__file__))
+    if _here not in sys.path:
+        sys.path.insert(0, _here)
+
 
 def _backend_hung_once(timeout_s: int) -> bool:
     """True iff backend init HANGS (wedged axon relay after a client
@@ -53,9 +61,13 @@ def _backend_hung_once(timeout_s: int) -> bool:
     a SIGKILLed probe dies mid-claim, which is itself one of the
     observed causes of hours-long relay wedges."""
     # DEVNULL, not PIPE: a killed child's helper processes can hold
-    # a captured pipe open and block the post-kill drain forever
+    # a captured pipe open and block the post-kill drain forever.
+    # PROBE_SNIPPET carries the marker that makes this probe visible
+    # to the suite runner's holder check while it is in flight.
+    from scripts.tpu_holders import PROBE_SNIPPET
+
     p = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()"],
+        [sys.executable, "-c", PROBE_SNIPPET],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     try:
         p.wait(timeout=timeout_s)
@@ -88,13 +100,15 @@ def _tpu_holders() -> list:
     is merely probing).  Local addition here: a SIBLING bench.py
     counts only when it started earlier (ps etimes; pid breaks ties)
     — the elder bench probes, the younger waits, so two benches never
-    busy-wait on each other to mutual -1s."""
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    busy-wait on each other to mutual -1s (ONE ps snapshot backs both
+    the sibling ages and my own, so the ordering cannot invert
+    between two reads)."""
     from scripts.tpu_holders import process_table, tpu_holders
 
-    my_age = process_table().get(os.getpid(), (0, 0, ""))[1]
+    procs = process_table()
+    my_age = procs.get(os.getpid(), (0, 0, ""))[1]
     holders = []
-    for p, age, args in tpu_holders():
+    for p, age, args in tpu_holders(procs):
         if "bench.py" in args and "agnes_tpu" not in args:
             # sibling bench: defer only to an ELDER one
             if age < my_age or (age == my_age and p > os.getpid()):

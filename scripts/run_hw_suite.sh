@@ -23,16 +23,22 @@ esac
 echo "[runner] probing for TPU from $(date)" >> "$LOG"
 while true; do
     # never probe while another agnes TPU process is alive (e.g. the
-    # driver-launched round-end bench): a second client's jax.devices()
-    # hangs by design, and timeout-killing that probe mid-claim can
-    # wedge the relay for hours.  Same screen bench.py uses
-    # (scripts/tpu_holders.py; exit 0 = nobody else is running).
-    if ! python scripts/tpu_holders.py >> "$LOG" 2>&1; then
+    # driver-launched round-end bench, or ITS in-flight marked probe):
+    # a second client's jax.devices() hangs by design, and
+    # timeout-killing that probe mid-claim can wedge the relay for
+    # hours.  Same screen bench.py uses (scripts/tpu_holders.py;
+    # exit 0 = clear, 1 = held, 2 = check broken -> probe anyway
+    # rather than deferring forever on a broken helper).
+    python scripts/tpu_holders.py >> "$LOG" 2>&1
+    HRC=$?
+    if [ "$HRC" -eq 1 ]; then
         echo "[runner] TPU held by another process at $(date); deferring 180s" >> "$LOG"
         sleep 180
         continue
+    elif [ "$HRC" -ne 0 ]; then
+        echo "[runner] holder check failed rc=$HRC at $(date); probing anyway" >> "$LOG"
     fi
-    if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    if timeout 120 python -c "import jax; jax.devices()  # agnes_tpu_probe" >/dev/null 2>&1; then
         echo "[runner] TPU alive at $(date)" >> "$LOG"
         break
     fi

@@ -20,7 +20,16 @@ import subprocess
 from typing import Dict, List, Tuple
 
 PATTERNS = ("bench.py", "agnes_tpu.harness.configs", "profile_verify",
-            "sweep_pipeline", "timing_check")
+            "sweep_pipeline", "timing_check", "agnes_tpu_probe")
+
+# the probe command EVERY cooperating prober must use: the trailing
+# comment is a marker that makes an in-flight probe visible to other
+# holder checks (closing the window where one side starts probing
+# while the other's 120s probe is already mid-claim — killing either
+# against the other's claim can wedge the relay).  Both sides check
+# holders immediately before probing, so the residual race is the
+# few ms between check and spawn, not a 120s window.
+PROBE_SNIPPET = "import jax; jax.devices()  # agnes_tpu_probe"
 
 
 def process_table() -> Dict[int, Tuple[int, int, str]]:
@@ -69,17 +78,29 @@ def ancestor_chain(procs, pid: int) -> set:
     return chain
 
 
-def tpu_holders() -> List[Tuple[int, int, str]]:
+def tpu_holders(procs: Dict[int, Tuple[int, int, str]] = None
+                ) -> List[Tuple[int, int, str]]:
     """[(pid, etimes, args)] of other live TPU-entry-point processes,
-    self and ancestors excluded, pid-sorted."""
-    procs = process_table()
+    self and ancestors excluded, pid-sorted.  Pass `procs` to evaluate
+    against ONE ps snapshot shared with other decisions (bench's
+    sibling tie-break needs its own age from the same read)."""
+    if procs is None:
+        procs = process_table()
     skip = ancestor_chain(procs, os.getpid())
     return [(p, age, args) for p, (pp, age, args) in sorted(procs.items())
             if p not in skip and is_tpu_invocation(args)]
 
 
 if __name__ == "__main__":
-    hs = tpu_holders()
-    for p, age, args in hs:
-        print(f"{p} {args}")
+    # exit codes: 0 = nobody else running, 1 = holders found (listed
+    # on stdout), 2 = the check itself failed — callers must treat 2
+    # as "unknown", NOT as "held" (a broken helper must never wedge a
+    # probe loop into deferring forever)
+    try:
+        hs = tpu_holders()
+        for p, age, args in hs:
+            print(f"{p} {args}")
+    except Exception as e:          # noqa: BLE001
+        print(f"holder check failed: {e!r}")
+        raise SystemExit(2)
     raise SystemExit(1 if hs else 0)

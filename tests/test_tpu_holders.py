@@ -1,0 +1,83 @@
+"""The TPU-claim holder screen (scripts/tpu_holders.py) — the
+protocol that keeps bench.py and the armed hardware-suite runner from
+killing probes against each other's live claims.  Pure stdlib; these
+pin the classification rules the two sides rely on."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from scripts.tpu_holders import (
+    ancestor_chain,
+    is_tpu_invocation,
+    tpu_holders,
+)
+
+
+def test_counts_python_entry_points():
+    assert is_tpu_invocation("python bench.py")
+    assert is_tpu_invocation("/usr/bin/python3 bench.py")
+    assert is_tpu_invocation("python -m agnes_tpu.harness.configs 4")
+    assert is_tpu_invocation("python scripts/profile_verify.py")
+
+
+def test_counts_wrappers_that_launch_python():
+    assert is_tpu_invocation("timeout 600 python bench.py")
+    assert is_tpu_invocation("sh -c 'python bench.py --x'")
+    assert is_tpu_invocation("bash -c python\\ bench.py")
+
+
+def test_counts_marked_probes_in_flight():
+    # the cooperative probe marker (PROBE_SNIPPET): an in-flight probe
+    # must be visible to the other side's holder check so nobody
+    # starts a second client against its claim
+    from scripts.tpu_holders import PROBE_SNIPPET
+
+    assert is_tpu_invocation(f"python -c {PROBE_SNIPPET}")
+    assert is_tpu_invocation(
+        f'timeout 120 python -c "{PROBE_SNIPPET}"')
+
+
+def test_rejects_non_runs():
+    # editors/pagers/greps mentioning the names are not claims
+    assert not is_tpu_invocation("vim bench.py")
+    assert not is_tpu_invocation("tail -f /tmp/hw/bench.py.log")
+    assert not is_tpu_invocation("grep -c votes bench.py")
+    # wrapper without python is not a claim either
+    assert not is_tpu_invocation("timeout 600 grep -c votes bench.py")
+    # the suite RUNNER shell itself must not count: while probing a
+    # dead tunnel it holds nothing (its stages match on their own)
+    assert not is_tpu_invocation("bash scripts/run_hw_suite.sh /tmp/x")
+
+
+def test_rejects_agent_wrapper_argv_novels():
+    # driver/agent shells embed kilobytes of prompt text in argv that
+    # MENTIONS bench.py and python; they must never count as holders
+    args = ("bash -c 'set -o pipefail; claude -p --append-system-prompt "
+            + "x" * 2000 + " bench.py python'")
+    assert not is_tpu_invocation(args)
+
+
+def test_self_and_ancestors_excluded():
+    procs = {1: (0, 99, "init"),
+             10: (1, 50, "bash scripts/run_hw_suite.sh /tmp/x"),
+             20: (10, 40, "python bench.py"),
+             30: (20, 30, "python -c import jax"),
+             40: (1, 20, "python bench.py")}
+    # from the perspective of pid 30 (a probe child of bench 20):
+    # its own bench ancestor is excluded, the unrelated bench is not
+    chain = ancestor_chain(procs, 30)
+    assert chain == {30, 20, 10, 1}
+    rivals = [p for p, (pp, age, a) in procs.items()
+              if p not in chain and is_tpu_invocation(a)]
+    assert rivals == [40]
+
+
+def test_live_call_runs_clean():
+    # in the test environment no rival TPU entry points should be
+    # running; mostly asserts the ps plumbing does not throw
+    out = tpu_holders()
+    assert isinstance(out, list)
+    for p, age, args in out:
+        assert isinstance(p, int) and isinstance(args, str)
