@@ -240,12 +240,15 @@ class DeviceDriver:
         signed sequences queue back-to-back under defer_collect — the
         pipelined flagship path.  Rejected-lane counts accumulate
         lazily; `rejected_signature_device` after collect()/
-        block_until_ready() has the total.  Single-device (the mesh
-        drivers verify host-side)."""
+        block_until_ready() has the total.  The packed-lane layout is
+        single-device; ON A MESH use step_seq_signed_dense (the dense
+        layout shards with the phases)."""
         if self.mesh is not None:
             raise NotImplementedError(
-                "device-verified stepping is single-device; mesh "
-                "drivers verify on the host path")
+                "the packed-lane signed step is single-device; on a "
+                "mesh use step_seq_signed_dense (+ VoteBatcher."
+                "build_phases_device_dense), which shards the lanes "
+                "with the phases")
         phases_st, exts_st, P = self._stack_seq(phases, exts)
         out = consensus_step_seq_signed_jit(
             self.state, self.tally, exts_st, phases_st, lanes,
