@@ -62,7 +62,8 @@ class DeviceDriver:
                  n_rounds: int = 4, n_slots: int = 4,
                  proposer_is_self: bool = True,
                  advance_height: bool = False,
-                 mesh=None, defer_collect: bool = False):
+                 mesh=None, defer_collect: bool = False,
+                 verify_chunk=None, hbm_budget_bytes: int = None):
         """With `mesh` (flat data x val or hierarchical
         slice x data x val, parallel/mesh.py) the closed loop runs the
         shard_map-sharded step with every argument placed per the
@@ -74,10 +75,23 @@ class DeviceDriver:
         k.  Deferred, step() returns the moment dispatch is queued and
         the host overlaps densify/verify of the next phase with the
         running device step; `collect()` (or `block_until_ready`)
-        drains the queued message batches when the stats are needed."""
+        drains the queued message batches when the stats are needed.
+
+        `verify_chunk` bounds the fused signed verify's HBM peak
+        (utils/budget.py; VERDICT r5 weak #3): None runs the
+        historical single-batch verify; an int streams that many
+        instance rows per microbatch through the dense path (lanes
+        scale by V per row on the packed-lane path); "auto" sizes the
+        tile from the device HBM budget (`hbm_budget_bytes` override,
+        else memory_stats/16 GiB default) — on a mesh the plan is made
+        on the per-device LOCAL shape.  Chunked and unchunked paths
+        are bit-identical (tests/test_step_signed.py)."""
         self.I, self.V = n_instances, n_validators
         self.advance_height = advance_height
         self.defer_collect = defer_collect
+        self.verify_chunk = verify_chunk
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self._verify_plans: dict = {}          # (Ps|None, I, V) -> plan
         self._deferred_msgs: list = []
         self._pending_rejects: list = []       # device-verify rejects
         self.rejected_signature_device = 0
@@ -92,8 +106,10 @@ class DeviceDriver:
                 mesh, advance_height=advance_height)
             self._sharded_step_seq = make_sharded_step_seq(
                 mesh, advance_height=advance_height)
-            self._sharded_step_seq_signed = make_sharded_step_seq_signed(
-                mesh, advance_height=advance_height)
+            # keyed by verify_chunk: the chunk is a static trace
+            # parameter of the sharded signed step
+            self._sharded_signed_cache: dict = {}
+            self._make_sharded_signed = make_sharded_step_seq_signed
             self._sharded_honest: dict = {}   # heights -> jitted fn
         self.cfg = TallyConfig(n_validators=n_validators, n_rounds=n_rounds,
                                n_slots=n_slots)
@@ -140,6 +156,73 @@ class DeviceDriver:
                 f"proposer table covers {flags.shape[1]} rounds; must be"
                 f" a multiple of the rotation period {rotation_period}")
         self.proposer_flag = flags
+
+    # -- verify chunk planning -----------------------------------------------
+
+    def _local_shape(self):
+        """(I, V) as ONE device sees them — the shapes the chunk plan
+        must bound (under shard_map the verify runs on local cells)."""
+        if self.mesh is None:
+            return self.I, self.V
+        from agnes_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS, VAL_AXIS
+
+        shape = dict(self.mesh.shape)
+        n_data = shape.get(DATA_AXIS, 1) * shape.get(SLICE_AXIS, 1)
+        return self.I // n_data, self.V // shape.get(VAL_AXIS, 1)
+
+    def _resolve_dense_chunk(self, n_phases: int):
+        """Instance rows per verify microbatch for the dense signed
+        path, or None for the single-batch call.  "auto" consults the
+        budget planner once per (Ps, local shape) and falls through to
+        None when the whole batch already fits (identical trace cache
+        key to the legacy path — no recompile)."""
+        if self.verify_chunk is None:
+            return None
+        local_i, local_v = self._local_shape()
+        if self.verify_chunk != "auto":
+            c = int(self.verify_chunk)
+            # a tile >= the (local) instance count is the unchunked
+            # call: normalize to None so it reuses the SAME jit cache
+            # entry (a distinct static arg would recompile an
+            # identical graph — minutes per trace with the persistent
+            # cache deliberately off, utils/compile_cache.py).
+            # <= 0 means "no chunking" too (matches the kernel's falsy
+            # handling on the lane path; 0 rows is not a tiling)
+            return None if c <= 0 or c >= local_i else c
+        from agnes_tpu.utils.budget import plan_dense_verify
+
+        key = (n_phases, local_i, local_v)
+        if key not in self._verify_plans:
+            self._verify_plans[key] = plan_dense_verify(
+                n_phases, local_i, local_v,
+                hbm_bytes=self.hbm_budget_bytes)
+        plan = self._verify_plans[key]
+        return plan.tile if plan.chunked else None
+
+    def _resolve_lane_chunk(self, n_lanes: int):
+        """Lanes per verify microbatch for the packed-lane signed path
+        (single-device), or None."""
+        if self.verify_chunk is None or n_lanes == 0:
+            return None
+        if self.verify_chunk != "auto":
+            # driver-level knob is in instance rows; a packed lane is
+            # one (instance, validator) cell of one phase.  A chunk
+            # covering the whole batch IS the unchunked call — and
+            # <= 0 rows means "no chunking" — normalize both to None
+            # to share the unchunked jit cache entry.
+            rows = int(self.verify_chunk)
+            if rows <= 0:
+                return None
+            c = rows * self.V
+            return None if c >= n_lanes else c
+        from agnes_tpu.utils.budget import plan_lane_verify
+
+        key = (None, n_lanes, self.V)
+        if key not in self._verify_plans:
+            self._verify_plans[key] = plan_lane_verify(
+                n_lanes, hbm_bytes=self.hbm_budget_bytes)
+        plan = self._verify_plans[key]
+        return plan.tile if plan.chunked else None
 
     # -- phase builders ------------------------------------------------------
 
@@ -253,7 +336,9 @@ class DeviceDriver:
         out = consensus_step_seq_signed_jit(
             self.state, self.tally, exts_st, phases_st, lanes,
             self.powers, self.total, self.proposer_flag,
-            self.propose_value, advance_height=self.advance_height)
+            self.propose_value, advance_height=self.advance_height,
+            verify_chunk=self._resolve_lane_chunk(
+                int(lanes.pub.shape[0])))
         # real lanes only (padding excluded); device rejects are
         # subtracted at settle time so the counter converges to
         # ACCEPTED votes — the same meaning the host-verified paths
@@ -304,9 +389,15 @@ class DeviceDriver:
         lanes).  Build both with VoteBatcher.build_phases_device_dense
         and prepend driver-side phases as needed."""
         phases_st, exts_st, P = self._stack_seq(phases, exts)
+        chunk = self._resolve_dense_chunk(int(dense.sig.shape[0]))
         if self.mesh is not None:
+            if chunk not in self._sharded_signed_cache:
+                self._sharded_signed_cache[chunk] = \
+                    self._make_sharded_signed(
+                        self.mesh, advance_height=self.advance_height,
+                        verify_chunk=chunk)
             # jit reshards the host-built arrays per the in_specs
-            out = self._sharded_step_seq_signed(
+            out = self._sharded_signed_cache[chunk](
                 self.state, self.tally, exts_st, phases_st, dense,
                 self.powers, self.total, self.proposer_flag,
                 self.propose_value)
@@ -315,7 +406,8 @@ class DeviceDriver:
                 self.state, self.tally, exts_st, phases_st, dense,
                 self.powers, self.total, self.proposer_flag,
                 self.propose_value,
-                advance_height=self.advance_height)
+                advance_height=self.advance_height,
+                verify_chunk=chunk)
         return self._finish_signed(
             out, P, int(sum(int(np.asarray(p.mask).sum())
                             for p in phases)))

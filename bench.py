@@ -29,6 +29,42 @@ on the axon-tunneled TPU platform (measured: timings stay flat as the
 in-kernel work is scaled 4x), so every timed region here forces a tiny
 host fetch (`_sync`) of a live output instead — the number includes
 real device execution, not dispatch.
+
+DEADLINE CONTRACT (VERDICT r5 weak #1: three rounds of missing
+scoreboard data because the probe-retry budget outlived the driver's
+`timeout 1800` and the process was SIGKILLed before its JSON line):
+
+* **Enclosing-budget discovery.**  At startup bench learns how long it
+  is allowed to live, in preference order: `AGNES_BENCH_DEADLINE_S`
+  env; an ancestor `timeout N ...` found by walking /proc cmdlines
+  (minus that wrapper's elapsed runtime — the discovery that makes
+  `timeout 1800 bash -c '... python bench.py'` visible from inside);
+  otherwise unbounded.  (utils/budget.Deadline.discover)
+
+* **Derived caps.**  Probe timeout, retry interval, probe budget and
+  busy budget are all clamped so the WORST wedged path ends with
+  margin to spare before the deadline; env overrides are honored but
+  never past the deadline, and AGNES_BENCH_PROBE_BUDGET_S is
+  hard-capped at 1200 s regardless (the driver window is 1800 s).
+
+* **Signal-emission guarantee.**  SIGTERM and SIGALRM are handled
+  from before the first probe until exit, and an alarm is scheduled
+  `margin` before a finite deadline: whatever kills this process —
+  wedged tunnel, dead backend, the enclosing timeout's TERM, or the
+  self-armed alarm — a PARSEABLE JSON record is printed as the last
+  stdout line (value -1 when the headline never ran; any stage
+  results that did complete ride along), and the exit code is 0.
+  Only an outright SIGKILL with no preceding signal can suppress the
+  record, which is why the caps above keep the process from ever
+  meeting the driver's KILL escalation.  Asserted by ci.sh's
+  forced-dead gate (`AGNES_BENCH_FORCE_DEAD=1`, a probe stub that
+  always hangs) and tests/test_bench_deadline.py.
+
+* **Claim protocol.**  The TPU claim tie-break runs through the
+  fcntl lease (scripts/tpu_holders.TpuLease): whoever holds the
+  lease probes/claims, everyone else waits — replacing the ad-hoc
+  elder-bench ps tie-break (two rounds of races).  The ps screen
+  remains as a backstop for non-lease processes.
 """
 
 from __future__ import annotations
@@ -47,6 +83,83 @@ if "__file__" in globals():
     _here = os.path.dirname(os.path.abspath(__file__))
     if _here not in sys.path:
         sys.path.insert(0, _here)
+else:
+    _here = os.getcwd()
+
+
+def _load_budget():
+    """utils/budget.py by FILE PATH: importing agnes_tpu.utils proper
+    would pull jax via the package __init__ and initialize a backend —
+    exactly what the probe guard exists to avoid.  budget.py's module
+    level is stdlib-only by contract."""
+    import importlib.util
+
+    path = os.path.join(_here, "agnes_tpu", "utils", "budget.py")
+    spec = importlib.util.spec_from_file_location("_agnes_budget", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass creation resolves cls.__module__ through sys.modules
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_budget = _load_budget()
+
+NORTH_STAR = 1_000_000  # votes/sec/chip (BASELINE.json north_star)
+
+#: the enclosing wall-clock budget (see DEADLINE CONTRACT above)
+_DEADLINE = _budget.Deadline.discover()
+
+#: stage results completed so far — the sentinel record carries them
+#: so a mid-bench kill still delivers every number already measured
+_RESULTS: dict = {}
+_STAGE = "probe-guard"
+_EMITTED = False
+_LEASE = None
+_PROBE_PROC = None         # in-flight probe child; reaped on any exit
+
+
+def _emit_sentinel(note: str) -> None:
+    """Print the unconditional JSON verdict (idempotent).  The
+    headline is whatever bench_pipeline measured if it got that far,
+    else -1; completed stage numbers ride along under 'partial'."""
+    global _EMITTED
+    if _EMITTED:
+        return
+    _EMITTED = True
+    value = _RESULTS.get("bench_pipeline", -1)
+    rec = {"metric": "pipeline_votes_per_sec", "value": value,
+           "unit": "votes/sec/chip",
+           "vs_baseline": round(value / NORTH_STAR, 3) if value > 0
+           else -1,
+           "note": note}
+    if _RESULTS:
+        rec["partial"] = dict(_RESULTS)
+    print(json.dumps(rec), flush=True)
+
+
+def _deadline_signal(signum: int) -> None:
+    """SIGTERM/SIGALRM: emit the verdict, reap the in-flight probe,
+    and exit 0 — the crash-safe last line the driver parses.  The
+    lease is left for dead-holder takeover (see below)."""
+    _emit_sentinel(
+        f"killed by {'SIGALRM (self-armed deadline)' if signum == signal.SIGALRM else 'SIGTERM'} "
+        f"during stage '{_STAGE}' with {_DEADLINE.remaining():.0f}s left "
+        f"of the discovered budget ({_DEADLINE.source}); emitted from "
+        "the signal handler per the deadline contract")
+    # deliberately NO _LEASE.release() here: release takes the lease
+    # flock, and this signal may have interrupted the main thread
+    # INSIDE that same critical section (acquire/refresh run every
+    # probe loop and stage) — flock from a second fd of one process
+    # still blocks, so releasing here could deadlock the very exit
+    # this handler guarantees.  Dying unreleased is safe by design:
+    # TpuLease detects a dead holder via pid+start-ticks and rivals
+    # take the lease over immediately.
+    try:
+        _reap_probe()      # a surviving marked probe reads as a claim
+    except Exception:  # noqa: BLE001
+        pass
+    os._exit(0)
 
 
 def _backend_hung_once(timeout_s: int) -> bool:
@@ -59,19 +172,42 @@ def _backend_hung_once(timeout_s: int) -> bool:
 
     A hung child is shut down GENTLY (SIGINT, grace, then escalate):
     a SIGKILLed probe dies mid-claim, which is itself one of the
-    observed causes of hours-long relay wedges."""
+    observed causes of hours-long relay wedges.
+
+    AGNES_BENCH_FORCE_DEAD=1 swaps the probe for a stub that always
+    hangs — CI's way to drive the wedged path (and every deadline/
+    signal guarantee behind it) without any backend at all."""
     # DEVNULL, not PIPE: a killed child's helper processes can hold
     # a captured pipe open and block the post-kill drain forever.
     # PROBE_SNIPPET carries the marker that makes this probe visible
     # to the suite runner's holder check while it is in flight.
     from scripts.tpu_holders import PROBE_SNIPPET
 
+    global _PROBE_PROC
+    snippet = PROBE_SNIPPET
+    if os.environ.get("AGNES_BENCH_FORCE_DEAD"):
+        snippet = ("import time; time.sleep(10**6)"
+                   "  # agnes_tpu_probe forced-dead stub")
+    def _die_with_parent():
+        # PR_SET_PDEATHSIG: the kernel kills the probe when bench
+        # dies, HOWEVER bench dies (even SIGKILL, where no handler
+        # runs).  An orphaned marked probe is poison: it matches every
+        # later bench's holder screen and reads as a live TPU claim.
+        import ctypes
+
+        try:
+            ctypes.CDLL(None).prctl(1, signal.SIGKILL)
+        except Exception:  # noqa: BLE001 — probe still works without
+            pass
+
     p = subprocess.Popen(
-        [sys.executable, "-c", PROBE_SNIPPET],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    try:
-        p.wait(timeout=timeout_s)
-        return False
+        [sys.executable, "-c", snippet],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        preexec_fn=_die_with_parent)
+    _PROBE_PROC = p     # visible to the deadline signal handler: a
+    try:                # probe orphaned by a mid-wait kill would keep
+        p.wait(timeout=timeout_s)     # matching the ps holder screen
+        return False                  # and wedge every LATER bench
     except subprocess.TimeoutExpired:
         for sig, grace in ((signal.SIGINT, 15), (signal.SIGTERM, 5)):
             try:
@@ -85,23 +221,50 @@ def _backend_hung_once(timeout_s: int) -> bool:
         p.kill()
         p.wait()
         return True
+    finally:
+        _PROBE_PROC = None
 
 
-def _tpu_holders() -> list:
+def _reap_probe() -> None:
+    """Kill an in-flight probe child before this process dies: the
+    exiting bench must not leave behind a marked probe that every
+    later bench's holder screen mistakes for a live TPU claim.  Gentle
+    first (SIGINT — a SIGKILLed probe mid-claim can wedge the relay),
+    but only a short grace: the enclosing timeout's own KILL is
+    seconds away."""
+    p = _PROBE_PROC
+    if p is None or p.poll() is not None:
+        return
+    try:
+        p.send_signal(signal.SIGINT)
+        p.wait(timeout=2)
+    except (subprocess.TimeoutExpired, OSError):
+        try:
+            p.kill()
+            p.wait(timeout=2)
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+
+
+def _tpu_holders(lease_rec=None) -> list:
     """Other processes that (may) hold the single-process TPU claim:
-    the detached hardware-suite stages and any sibling bench.  While
-    one is alive, a hanging jax.devices() in a fresh interpreter is
-    EXPECTED (second-client behavior on this platform), so probing —
-    and above all killing hung probes — must wait.  The detection
-    lives in scripts/tpu_holders.py (stdlib-only; run_hw_suite.sh's
-    probe loop uses the SAME screen, so the armed runner defers to a
-    driver-launched bench instead of killing probes against its
-    claim, and vice versa — neither side ever waits on a process that
-    is merely probing).  Local addition here: a SIBLING bench.py
-    counts only when it started earlier (ps etimes; pid breaks ties)
-    — the elder bench probes, the younger waits, so two benches never
-    busy-wait on each other to mutual -1s (ONE ps snapshot backs both
-    the sibling ages and my own, so the ordering cannot invert
+    the detached hardware-suite stages and similar non-lease entry
+    points.  While one is alive, a hanging jax.devices() in a fresh
+    interpreter is EXPECTED (second-client behavior on this platform),
+    so probing — and above all killing hung probes — must wait.  The
+    detection lives in scripts/tpu_holders.py (stdlib-only;
+    run_hw_suite.sh's probe loop uses the SAME screen, so the armed
+    runner defers to a driver-launched bench instead of killing probes
+    against its claim, and vice versa).
+
+    SIBLING benches: while a VALID lease exists anywhere (mine, an
+    ancestor's, a rival's), the fcntl lease arbitrates which bench
+    probes and siblings are skipped here — the old elder-bench ps
+    tie-break produced a race per round (VERDICT r5 weak #4).  With
+    NO lease in play a sibling may be a PRE-lease bench (old code)
+    already holding a live claim, so the elder tie-break survives as
+    the mixed-version backstop: the elder probes, the younger waits
+    (one ps snapshot backs both ages, so the ordering cannot invert
     between two reads)."""
     from scripts.tpu_holders import process_table, tpu_holders
 
@@ -110,41 +273,110 @@ def _tpu_holders() -> list:
     holders = []
     for p, age, args in tpu_holders(procs):
         if "bench.py" in args and "agnes_tpu" not in args:
-            # sibling bench: defer only to an ELDER one
+            if lease_rec is not None:
+                continue       # lease protocol in play: it arbitrates
             if age < my_age or (age == my_age and p > os.getpid()):
-                continue
+                continue       # pre-lease younger sibling: it waits
         holders.append(f"{p} {args}")
     return holders
+
+
+def _is_ancestor(pid) -> bool:
+    """True iff `pid` is this process or one of its ancestors — a
+    lease held there was taken by whoever launched us, on our
+    behalf."""
+    from scripts.tpu_holders import ancestor_chain, process_table
+
+    try:
+        return pid in ancestor_chain(process_table(), os.getpid())
+    except Exception:  # noqa: BLE001 — ps failure must not wedge
+        return False
+
+
+#: hard cap on the probe-retry budget, whatever the env says: the
+#: driver's window is 1800 s and r5 died precisely because an env
+#: default (2700 s) outlived it
+PROBE_BUDGET_HARD_CAP_S = 1200.0
+
+
+def _probe_caps():
+    """(probe_s, interval, budget, busy_budget) — env-tunable defaults
+    (probe 120 s, retry every 60 s, 900 s of hung probes, 1500 s of
+    busy waiting; all well under the driver's 1800 s window even
+    stacked with the final probe) further clamped so the worst wedged
+    path ends before the discovered deadline with margin to spare.
+    With no deadline the env/defaults stand as-is."""
+    probe_s = int(os.environ.get("AGNES_BENCH_PROBE_TIMEOUT_S", "120"))
+    interval = int(os.environ.get("AGNES_BENCH_PROBE_INTERVAL_S", "60"))
+    budget = min(float(os.environ.get("AGNES_BENCH_PROBE_BUDGET_S",
+                                      "900")),
+                 PROBE_BUDGET_HARD_CAP_S)
+    busy_budget = float(os.environ.get("AGNES_BENCH_BUSY_BUDGET_S",
+                                       "1500"))
+    rem = _DEADLINE.remaining()
+    if rem != float("inf"):
+        margin = _budget.deadline_margin_s(rem)
+        probe_s = max(2, min(probe_s, int(rem / 3)))
+        interval = max(1, min(interval, int(rem / 6)))
+        budget = max(2.0, min(budget, rem - margin - probe_s))
+        busy_budget = max(2.0, min(busy_budget, rem - margin - interval))
+    return probe_s, interval, budget, busy_budget
 
 
 def _backend_hung():
     """Bounded probe-RETRY loop (VERDICT r4 weak #1: a single probe
     emitted -1 twice in a row when the driver happened to run bench at
     a transiently-wedged moment).  Axon wedges observed in r3/r4 often
-    clear within tens of minutes, so keep probing — every
-    AGNES_BENCH_PROBE_INTERVAL_S (default 180s) for up to
-    AGNES_BENCH_PROBE_BUDGET_S (default 2700s = 45 min) of actual hung
-    probes — and only report a hang after the whole budget is spent.
-    While another agnes TPU process is alive (ps screen above) this
-    loop WAITS instead of probing, up to AGNES_BENCH_BUSY_BUDGET_S
-    (default 7200s): a second client hangs by design on this platform,
-    and killing such a probe mid-claim can wedge the relay for real.
+    clear within tens of minutes, so keep probing — every retry
+    interval for as long as the probe budget allows — and only report
+    a hang after the whole budget is spent.  All four caps derive from
+    the discovered deadline (`_probe_caps`), so the loop ALWAYS
+    returns in time to print the verdict (VERDICT r5 weak #1).
+
+    Probing is gated on the fcntl TPU lease: while another process
+    (sibling bench, armed suite runner) holds it — or a non-lease TPU
+    entry point shows in the ps screen — this loop WAITS instead of
+    probing: a second client hangs by design on this platform, and
+    killing such a probe mid-claim can wedge the relay for real.  On
+    success the lease is HELD (and refreshed between stages) until
+    exit, so rival probes defer to the running bench.
 
     Returns None when the backend is reachable, else a short reason
-    string ("busy": another process held the TPU for the whole busy
-    budget and no probe ever ran; "wedged": probes themselves hung for
-    the whole probe budget) so the emitted -1 record states the actual
-    cause."""
-    probe_s = int(os.environ.get("AGNES_BENCH_PROBE_TIMEOUT_S", "240"))
-    interval = int(os.environ.get("AGNES_BENCH_PROBE_INTERVAL_S", "180"))
-    budget = float(os.environ.get("AGNES_BENCH_PROBE_BUDGET_S", "2700"))
-    busy_budget = float(os.environ.get("AGNES_BENCH_BUSY_BUDGET_S",
-                                       "7200"))
+    string ("busy": the TPU was held for the whole busy budget and no
+    probe ever ran; "wedged": probes themselves hung for the whole
+    probe budget) so the emitted -1 record states the actual cause."""
+    global _LEASE
+    from scripts.tpu_holders import TpuLease
+
+    probe_s, interval, budget, busy_budget = _probe_caps()
+    lease = TpuLease()
     busy_deadline = time.monotonic() + busy_budget
     spent = 0.0
     attempt = 0
     while True:
-        holders = _tpu_holders()
+        rec = lease.holder()
+        holders = _tpu_holders(lease_rec=rec)
+        claimed = False
+        if not holders:
+            if lease.acquire(note="bench probe/claim"):
+                claimed = True
+            else:
+                rec = lease.holder()
+                if rec and _is_ancestor(rec.get("pid")):
+                    # the enclosing suite runner leased the claim to
+                    # its own shell and launched this bench as a
+                    # stage: its lease COVERS us (same principle as
+                    # the ps screen's ancestor exclusion) — probe
+                    # under it, don't hold it ourselves
+                    pass
+                elif rec:
+                    holders = [f"lease holder {rec}"]
+                else:
+                    # holder vanished between acquire and read:
+                    # transient — retry the acquire, don't probe
+                    # leaseless and don't burn a busy interval
+                    time.sleep(0.1)
+                    continue
         if holders:
             if time.monotonic() >= busy_deadline:
                 print("[bench] TPU still held by another process after "
@@ -155,6 +387,8 @@ def _backend_hung():
                   f"waiting {interval}s", file=sys.stderr, flush=True)
             time.sleep(interval)
             continue
+        if claimed:
+            _LEASE = lease                # held from here until exit
         attempt += 1
         t0 = time.monotonic()
         if not _backend_hung_once(probe_s):
@@ -170,25 +404,54 @@ def _backend_hung():
         time.sleep(interval)
 
 
+def _release_lease() -> None:
+    if _LEASE is not None:
+        try:
+            _LEASE.release()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 # the guard must run BEFORE the jax/agnes imports below (they trigger
 # backend init at import time)
 if __name__ == "__main__":
-    _reason = _backend_hung()
+    import atexit
+
+    atexit.register(_release_lease)
+    atexit.register(_reap_probe)
+    # arm the emission guarantee BEFORE anything can hang: SIGTERM +
+    # a self-alarm `margin` before the discovered deadline
+    _alarm = _budget.install_deadline_signals(_deadline_signal, _DEADLINE)
+    print(f"[bench] deadline: {_DEADLINE.source}, "
+          f"remaining {_DEADLINE.remaining():.0f}s, "
+          f"alarm in {_alarm:.0f}s" if _alarm else
+          f"[bench] deadline: {_DEADLINE.source} (unbounded; no alarm)",
+          file=sys.stderr, flush=True)
+    try:
+        _reason = _backend_hung()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the guard itself can
+        # die (unwritable lease path, malformed cap env, ps failure):
+        # the verdict contract outranks the traceback
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _emit_sentinel(
+            f"probe guard crashed before any stage: "
+            f"{type(e).__name__}: {e}")
+        sys.exit(0)
     if _reason == "busy":
-        print(json.dumps({
-            "metric": "pipeline_votes_per_sec", "value": -1,
-            "unit": "votes/sec/chip", "vs_baseline": -1,
-            "note": "TPU held by another process for the full busy "
-                    "budget (scheduling conflict, NOT a tunnel wedge); "
-                    "no probe or stage was run"}))
+        _emit_sentinel(
+            "TPU held by another process for the full busy budget "
+            "(scheduling conflict, NOT a tunnel wedge); no probe or "
+            f"stage was run (deadline source: {_DEADLINE.source})")
         sys.exit(0)
     if _reason == "wedged":
-        print(json.dumps({
-            "metric": "pipeline_votes_per_sec", "value": -1,
-            "unit": "votes/sec/chip", "vs_baseline": -1,
-            "note": "backend init timed out (wedged accelerator "
-                    "tunnel) for the full probe-retry budget; no "
-                    "stage was run"}))
+        _emit_sentinel(
+            "backend init timed out (wedged accelerator tunnel) for "
+            "the full probe-retry budget; no stage was run "
+            f"(deadline source: {_DEADLINE.source})")
         sys.exit(0)
 
 # the XLA:CPU codegen/serialization race workaround must land in
@@ -211,8 +474,6 @@ import numpy as np
 from agnes_tpu.device.encoding import DeviceState
 from agnes_tpu.device.tally import TallyConfig, TallyState
 from agnes_tpu.types import VoteType
-
-NORTH_STAR = 1_000_000  # votes/sec/chip (BASELINE.json north_star)
 
 
 def _sync(x) -> None:
@@ -696,11 +957,16 @@ def main() -> None:
     import traceback
 
     def guarded(fn):
+        global _STAGE
         name = fn.__name__
+        _STAGE = name          # the sentinel names the in-flight stage
+        if _LEASE is not None:
+            _LEASE.refresh()   # rival probes keep deferring to us
         print(f"[bench] {name} ...", file=sys.stderr, flush=True)
         t0 = time.perf_counter()
         try:
             out = round(fn())
+            _RESULTS[name] = out   # rides along in a sentinel verdict
         except Exception:
             traceback.print_exc(file=sys.stderr)
             out = -1
@@ -721,6 +987,13 @@ def main() -> None:
     # headline = the ONE fixed flagship path (numpy bridge); the native
     # feeder is reported alongside, never max()ed in (a max of two
     # noisy samples is upward-biased and switches meaning run-to-run)
+    global _EMITTED
+    signal.alarm(0)            # the final record is imminent: cancel
+    #                            the self-armed deadline alarm; a TERM
+    #                            in this window still gets a sentinel
+    #                            (carrying every stage result), since
+    #                            _EMITTED flips only AFTER the real
+    #                            verdict is fully printed
     print(json.dumps({
         "metric": "pipeline_votes_per_sec",
         "value": pipeline,
@@ -736,8 +1009,22 @@ def main() -> None:
         "decisions_per_sec": decisions,
         "bridge_votes_per_sec": bridge,
         "value_flood_votes_per_sec": flood,
-    }))
+    }), flush=True)
+    _EMITTED = True        # real verdict delivered; sentinel stands down
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — the contract: a
+        # parseable record is the LAST stdout line no matter how this
+        # process ends; stage exceptions are already contained by
+        # guarded(), so reaching here means harness plumbing died
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _emit_sentinel(
+            f"bench harness crashed outside any stage guard during "
+            f"stage '{_STAGE}': {type(e).__name__}: {e}")
+        raise SystemExit(0 if not isinstance(e, SystemExit)
+                         else (e.code or 0))

@@ -20,18 +20,35 @@ case "${XLA_FLAGS:-}" in
     *xla_cpu_parallel_codegen_split_count*) ;;
     *) export XLA_FLAGS="${XLA_FLAGS:-} --xla_cpu_parallel_codegen_split_count=1" ;;
 esac
+# the claim is arbitrated by the fcntl lease (scripts/tpu_holders.py
+# TpuLease; VERDICT r5 weak #4): acquire it for THIS shell before any
+# probe, refresh it between stages, release it on every exit path.
+# The ps holder screen stays as a backstop for pre-lease processes.
+lease() { python scripts/tpu_holders.py "lease-$1" --pid $$ "${@:2}"; }
+trap 'lease release >> "$LOG" 2>&1' EXIT
 echo "[runner] probing for TPU from $(date)" >> "$LOG"
 while true; do
-    # never probe while another agnes TPU process is alive (e.g. the
-    # driver-launched round-end bench, or ITS in-flight marked probe):
-    # a second client's jax.devices() hangs by design, and
-    # timeout-killing that probe mid-claim can wedge the relay for
-    # hours.  Same screen bench.py uses (scripts/tpu_holders.py;
-    # exit 0 = clear, 1 = held, 2 = check broken -> probe anyway
-    # rather than deferring forever on a broken helper).
+    if ! lease acquire --note "run_hw_suite $OUTDIR" >> "$LOG" 2>&1; then
+        echo "[runner] TPU lease held by another process at $(date); deferring 180s" >> "$LOG"
+        sleep 180
+        continue
+    fi
+    # never probe while another agnes TPU process is alive (e.g. a
+    # driver-launched round-end bench on pre-lease code, or ITS
+    # in-flight marked probe): a second client's jax.devices() hangs
+    # by design, and timeout-killing that probe mid-claim can wedge
+    # the relay for hours.  Same screen bench.py uses
+    # (scripts/tpu_holders.py; exit 0 = clear, 1 = held, 2 = check
+    # broken -> probe anyway rather than deferring forever on a
+    # broken helper).
     python scripts/tpu_holders.py >> "$LOG" 2>&1
     HRC=$?
     if [ "$HRC" -eq 1 ]; then
+        # drop the lease BEFORE deferring: a lease-aware bench we are
+        # deferring to would otherwise defer right back to our lease —
+        # mutual wait until its busy budget emits a -1 (the exact
+        # missing-scoreboard failure this protocol exists to fix)
+        lease release >> "$LOG" 2>&1
         echo "[runner] TPU held by another process at $(date); deferring 180s" >> "$LOG"
         sleep 180
         continue
@@ -45,18 +62,23 @@ while true; do
     echo "[runner] unreachable at $(date); sleeping 180s" >> "$LOG"
     sleep 180
 done
+lease refresh >> "$LOG" 2>&1
 echo "[runner] bench.py start $(date)" >> "$LOG"
 python bench.py > "$OUTDIR/bench.json" 2>> "$LOG"
 echo "[runner] bench rc=$? end $(date)" >> "$LOG"
+lease refresh >> "$LOG" 2>&1
 echo "[runner] config4 start $(date)" >> "$LOG"
 python -m agnes_tpu.harness.configs 4 > "$OUTDIR/config4.json" 2>> "$LOG"
 echo "[runner] config4 rc=$? end $(date)" >> "$LOG"
+lease refresh >> "$LOG" 2>&1
 echo "[runner] config2 start $(date)" >> "$LOG"
 python -m agnes_tpu.harness.configs 2 > "$OUTDIR/config2.json" 2>> "$LOG"
 echo "[runner] config2 rc=$? end $(date)" >> "$LOG"
+lease refresh >> "$LOG" 2>&1
 echo "[runner] config5 start $(date)" >> "$LOG"
 python -m agnes_tpu.harness.configs 5 > "$OUTDIR/config5.json" 2>> "$LOG"
 echo "[runner] config5 rc=$? end $(date)" >> "$LOG"
+lease refresh >> "$LOG" 2>&1
 echo "[runner] profile_verify start $(date)" >> "$LOG"
 python scripts/profile_verify.py > "$OUTDIR/profile_verify.txt" 2>> "$LOG"
 echo "[runner] profile_verify rc=$? end $(date)" >> "$LOG"

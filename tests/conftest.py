@@ -67,17 +67,46 @@ _ISOLATED = (
 _WRAPPER = "test_zz_heavy_isolated.py"
 
 
+# Deadline-bounded graceful degradation for the suite itself (the
+# same contract bench.py honors, ISSUE 1): the tier-1 gate runs
+# `timeout 870 pytest tests/ -m 'not slow'`, and with the persistent
+# compile cache deliberately off (utils/compile_cache.py) a single
+# fused-verify trace costs minutes of XLA:CPU compile on a small box.
+# Alphabetical order front-loads those compiles (test_bridge is file
+# #2), so the timeout used to discard the cheap majority of the suite
+# unrun.  Ordering by compile weight — stdlib/numpy/ctypes files
+# first, light-jit files next, multi-minute-trace files after —
+# degrades a timeout to "expensive tail cut", not "most of the suite
+# never ran".  Files keep their internal order; sort is stable.
+_CHEAP = (          # no XLA compiles (stdlib / numpy / ctypes / refs)
+    "test_bench_deadline.py", "test_budget.py", "test_capi_fuzz.py",
+    "test_ed25519_ref.py", "test_executor.py", "test_native_core.py",
+    "test_native_ingest.py", "test_round_votes.py",
+    "test_state_machine.py", "test_tpu_holders.py",
+    "test_validators.py", "test_value_flood.py",
+    "test_vote_executor.py",
+)
+_HEAVY = (          # multi-minute verify/sharded traces per test
+    "test_bridge.py", "test_harness.py", "test_msm.py",
+    "test_sharded.py", "test_step.py", "test_step_seq.py",
+    "test_step_signed.py", "test_utils.py",
+)
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest
 
     def group(item):
         name = item.fspath.basename
         if name == _WRAPPER:
-            return (2, 0)
+            return (9, 0)           # child-interpreter re-runs: last
         try:
-            return (1, _ISOLATED.index(name))
+            return (8, _ISOLATED.index(name))
         except ValueError:
+            pass
+        if name in _CHEAP:
             return (0, 0)
+        return (2, 0) if name in _HEAVY else (1, 0)
 
     items.sort(key=group)   # stable: original order within each group
     wrapper_collected = any(it.fspath.basename == _WRAPPER

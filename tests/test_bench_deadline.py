@@ -1,0 +1,182 @@
+"""bench.py's deadline contract (ISSUE 1 / VERDICT r5 weak #1): with
+an unreachable backend and an enclosing wall-clock budget — coreutils
+`timeout`, the env override, or an outright SIGTERM — the process must
+ALWAYS exit 0 having printed a parseable JSON verdict as its last
+stdout line, well before the budget's kill escalation.
+
+The dead backend is simulated with AGNES_BENCH_FORCE_DEAD=1 (the probe
+child becomes an unconditional hang), so these run anywhere, no TPU or
+jax import involved — bench's probe guard exits before the heavy
+imports.  Each run gets a private lease path so rival-looking benches
+in parallel CI never make each other "busy"."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _env(tmp_path, **extra):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("AGNES_BENCH_")}
+    env["AGNES_BENCH_FORCE_DEAD"] = "1"
+    env["AGNES_TPU_LEASE_PATH"] = str(tmp_path / "tpu.lease")
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _last_record(stdout: str) -> dict:
+    lines = [ln for ln in stdout.strip().splitlines() if ln]
+    assert lines, "bench printed nothing to stdout"
+    rec = json.loads(lines[-1])          # MUST parse — the driver does
+    assert rec["metric"] == "pipeline_votes_per_sec"
+    assert rec["value"] == -1
+    assert rec["vs_baseline"] == -1
+    assert rec["unit"] == "votes/sec/chip"
+    assert rec["note"]                   # states the actual cause
+    return rec
+
+
+def test_timeout_wrapped_dead_backend_still_emits_verdict(tmp_path):
+    """The acceptance-criterion path: `timeout N python bench.py`
+    against a dead backend.  bench must discover N from /proc, clamp
+    its probe budget under it, and exit 0 with the JSON record BEFORE
+    the wrapper's TERM ever fires."""
+    t0 = time.monotonic()
+    r = subprocess.run(
+        ["timeout", "15", sys.executable, BENCH],
+        env=_env(tmp_path), cwd=REPO,
+        capture_output=True, text=True, timeout=60)
+    took = time.monotonic() - t0
+    assert r.returncode == 0, (r.returncode, r.stderr[-800:])
+    rec = _last_record(r.stdout)
+    # either the clamped probe loop gave up or the self-armed alarm
+    # beat it by a hair — both are within-contract; what is NOT
+    # allowed is "busy" (nobody held the claim) or silence
+    assert "held by another process" not in rec["note"]
+    assert "proc:timeout" in rec["note"]     # the discovery is stated
+    assert took < 15, f"bench outlived its enclosing budget ({took:.0f}s)"
+
+
+def test_env_deadline_beats_huge_probe_budget(tmp_path):
+    """An env probe budget far past the deadline must be clamped: the
+    r5 failure was exactly an env default outliving the wrapper."""
+    r = subprocess.run(
+        [sys.executable, BENCH],
+        env=_env(tmp_path, AGNES_BENCH_DEADLINE_S=8,
+                 AGNES_BENCH_PROBE_BUDGET_S=99999,
+                 AGNES_BENCH_BUSY_BUDGET_S=99999),
+        cwd=REPO, capture_output=True, text=True, timeout=30)
+    assert r.returncode == 0, r.stderr[-800:]
+    rec = _last_record(r.stdout)
+    assert "env:AGNES_BENCH_DEADLINE_S" in rec["note"]
+
+
+def test_sigterm_mid_probe_emits_verdict(tmp_path):
+    """The kill path: TERM arriving while a probe hangs must produce
+    the verdict from the signal handler and exit 0 — the last-resort
+    guarantee when discovery finds no budget at all."""
+    p = subprocess.Popen(
+        [sys.executable, BENCH],
+        env=_env(tmp_path, AGNES_BENCH_DEADLINE_S=600),
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    try:
+        time.sleep(2.0)                  # let it arm + start probing
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    assert p.returncode == 0
+    rec = _last_record(out)
+    assert "SIGTERM" in rec["note"]
+
+
+def test_rival_lease_holder_means_busy(tmp_path):
+    """A lease held by an UNRELATED live process must make bench wait
+    (and, past the busy budget, report "busy" — not probe against the
+    rival's claim)."""
+    sys.path.insert(0, REPO)
+    from scripts.tpu_holders import TpuLease
+
+    rival = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(600)"])
+    try:
+        lease = TpuLease(path=str(tmp_path / "tpu.lease"), pid=rival.pid)
+        assert lease.acquire(note="rival")
+        # a roomy deadline with a SHORT busy budget: the busy verdict
+        # must come from the lease check, well clear of the alarm
+        r = subprocess.run(
+            [sys.executable, BENCH],
+            env=_env(tmp_path, AGNES_BENCH_DEADLINE_S=60,
+                     AGNES_BENCH_BUSY_BUDGET_S=4,
+                     AGNES_BENCH_PROBE_INTERVAL_S=1),
+            cwd=REPO, capture_output=True, text=True, timeout=30)
+        assert r.returncode == 0, r.stderr[-800:]
+        rec = _last_record(r.stdout)
+        assert "held by another process" in rec["note"]
+    finally:
+        rival.kill()
+        rival.wait()
+
+
+def test_ancestor_lease_is_inherited(tmp_path):
+    """The suite-runner composition: run_hw_suite.sh leases the claim
+    to its own shell, then launches bench as a stage.  bench must
+    recognize the ANCESTOR's lease as covering it and probe normally —
+    not busy-wait against its own parent (here: the lease names this
+    pytest process, bench's grandparent-ish ancestor)."""
+    sys.path.insert(0, REPO)
+    from scripts.tpu_holders import TpuLease
+
+    lease = TpuLease(path=str(tmp_path / "tpu.lease"))
+    assert lease.acquire(note="suite runner (this test)")
+    try:
+        # roomy deadline, tight probe caps: the wedged verdict must
+        # come from the probe loop itself, well clear of the alarm
+        r = subprocess.run(
+            [sys.executable, BENCH],
+            env=_env(tmp_path, AGNES_BENCH_DEADLINE_S=60,
+                     AGNES_BENCH_PROBE_TIMEOUT_S=3,
+                     AGNES_BENCH_PROBE_BUDGET_S=3,
+                     AGNES_BENCH_PROBE_INTERVAL_S=1),
+            cwd=REPO, capture_output=True, text=True, timeout=30)
+        assert r.returncode == 0, r.stderr[-800:]
+        rec = _last_record(r.stdout)
+        # probed (and found the forced-dead backend wedged) — did NOT
+        # classify its own ancestor's lease as a rival
+        assert "wedged" in rec["note"] or "timed out" in rec["note"]
+        # and it did not release or overwrite our lease on exit
+        mine = lease.holder()
+        assert mine is not None and mine["pid"] == os.getpid()
+    finally:
+        lease.release()
+
+
+def test_self_armed_alarm_is_the_backstop(tmp_path):
+    """No TERM ever arrives (e.g. an intermediate shell swallowed it):
+    the self-armed SIGALRM margin before the env deadline must fire
+    and deliver the verdict on its own."""
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, BENCH],
+        env=_env(tmp_path, AGNES_BENCH_DEADLINE_S=7,
+                 # probe caps that would outlive the alarm on their own
+                 AGNES_BENCH_PROBE_TIMEOUT_S=600,
+                 AGNES_BENCH_PROBE_INTERVAL_S=600),
+        cwd=REPO, capture_output=True, text=True, timeout=40)
+    took = time.monotonic() - t0
+    assert r.returncode == 0, r.stderr[-800:]
+    rec = _last_record(r.stdout)
+    assert took < 12, f"alarm never fired ({took:.0f}s)"
+    # either the clamped probe loop returned first or the alarm did;
+    # both are within-contract, but the record must say which
+    assert ("SIGALRM" in rec["note"] or "wedged" in rec["note"]
+            or "timed out" in rec["note"])

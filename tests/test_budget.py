@@ -1,0 +1,171 @@
+"""utils/budget.py — the deadline/HBM-budget subsystem.
+
+Planner tests are PURE MATH: the headline assertion is that the
+north-star shape (Ps=2 vote classes x 10k instances x 1000 validators,
+BASELINE config 4) gets a valid chunked plan under a simulated 16 GB
+v5e budget WITHOUT allocating anything — the proof VERDICT r5 weak #3
+asked for that the fused signed path can run at full shape at all.
+"""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import pytest
+
+from agnes_tpu.utils.budget import (
+    DEFAULT_HBM_BYTES,
+    GIB,
+    BudgetError,
+    Deadline,
+    dense_resident_bytes,
+    device_hbm_bytes,
+    enclosing_timeout_remaining,
+    parse_timeout_argv,
+    parse_timeout_duration,
+    plan_dense_verify,
+    plan_lane_verify,
+)
+
+# --- the north-star plan (ISSUE 1 acceptance criterion) --------------------
+
+
+def test_north_star_shape_plans_under_16gb():
+    """Ps=2, I=10k, V=1000 must yield a valid tile plan within a
+    simulated 16 GiB budget — statically, no device, no allocation."""
+    plan = plan_dense_verify(2, 10_000, 1000, n_blocks=1,
+                             hbm_bytes=16 * GIB)
+    assert plan.fits()
+    assert plan.chunked                       # one batch can NOT fit
+    assert 1 <= plan.tile < 10_000
+    assert plan.n_chunks == -(-10_000 // plan.tile)
+    assert plan.lanes_per_chunk == plan.tile * 2 * 1000
+    assert plan.peak_bytes <= 16 * GIB * plan.safety
+    # the resident operands alone are most of the budget (sig ~5.1 GB
+    # + blocks ~2.6 GB) — sanity that the operand math is in range
+    assert 7 * GIB < plan.resident_bytes < 12 * GIB
+
+
+def test_north_star_unchunked_exceeds_16gb():
+    """The r5 status quo: the single-batch verify at full shape blows
+    the budget (this is WHY the chunked path exists)."""
+    plan = plan_dense_verify(2, 10_000, 1000, hbm_bytes=16 * GIB)
+    unchunked_peak = (plan.resident_bytes
+                      + (plan.chunk_bytes // plan.tile) * 10_000)
+    assert unchunked_peak > 16 * GIB
+
+
+def test_plan_scales_with_budget():
+    small = plan_dense_verify(2, 1024, 64, hbm_bytes=2 * GIB)
+    large = plan_dense_verify(2, 1024, 64, hbm_bytes=64 * GIB)
+    assert small.fits() and large.fits()
+    assert small.tile <= large.tile
+    # power-of-two tiles (logarithmic compile-cache pressure)
+    assert small.tile & (small.tile - 1) == 0
+
+
+def test_plan_unchunked_when_everything_fits():
+    plan = plan_dense_verify(2, 8, 4, hbm_bytes=16 * GIB)
+    assert not plan.chunked and plan.tile == 8 and plan.n_chunks == 1
+
+
+def test_plan_raises_when_nothing_fits():
+    with pytest.raises(BudgetError):
+        plan_dense_verify(2, 10_000, 1000, hbm_bytes=1 * GIB)
+
+
+def test_lane_plan():
+    plan = plan_lane_verify(1 << 21, hbm_bytes=4 * GIB)  # 2M lanes
+    assert plan.chunked and plan.fits()
+    assert plan.tile * plan.n_chunks >= 1 << 21
+    tiny = plan_lane_verify(256, hbm_bytes=16 * GIB)
+    assert not tiny.chunked and tiny.tile == 256
+
+
+def test_resident_bytes_monotone():
+    a = dense_resident_bytes(2, 100, 64)
+    b = dense_resident_bytes(2, 200, 64)
+    assert 0 < a < b
+
+
+def test_device_hbm_env_override(monkeypatch):
+    monkeypatch.setenv("AGNES_HBM_BUDGET_BYTES", str(3 * GIB))
+    assert device_hbm_bytes() == 3 * GIB
+    monkeypatch.setenv("AGNES_HBM_BUDGET_BYTES", "nonsense")
+    # unparseable env falls through (CPU backend has no memory_stats
+    # limit here, so the v5e default comes back)
+    assert device_hbm_bytes() in (DEFAULT_HBM_BYTES,) or \
+        device_hbm_bytes() > 0
+
+
+# --- timeout cmdline parsing ------------------------------------------------
+
+
+def test_parse_timeout_duration():
+    assert parse_timeout_duration("870") == 870.0
+    assert parse_timeout_duration("30m") == 1800.0
+    assert parse_timeout_duration("2h") == 7200.0
+    assert parse_timeout_duration("1.5s") == 1.5
+    assert parse_timeout_duration("junk") is None
+
+
+def test_parse_timeout_argv():
+    assert parse_timeout_argv(["timeout", "1800", "bash", "-c", "x"]) \
+        == 1800.0
+    assert parse_timeout_argv(
+        ["timeout", "-k", "10", "870", "env", "python"]) == 870.0
+    assert parse_timeout_argv(
+        ["/usr/bin/timeout", "--kill-after=10", "-s", "TERM", "30m",
+         "python", "bench.py"]) == 1800.0
+    assert parse_timeout_argv(["timeout", "--foreground", "60",
+                               "sleep", "999"]) == 60.0
+    assert parse_timeout_argv(["python", "bench.py"]) is None
+    assert parse_timeout_argv(["timeout"]) is None
+    assert parse_timeout_argv([]) is None
+
+
+def test_deadline_env_override(monkeypatch):
+    monkeypatch.setenv("AGNES_BENCH_DEADLINE_S", "120")
+    d = Deadline.discover()
+    assert d.source == "env:AGNES_BENCH_DEADLINE_S"
+    assert 110 < d.remaining() <= 120
+
+
+def test_deadline_none_and_cap():
+    d = Deadline.none()
+    assert d.remaining() == float("inf") and not d.expired()
+    assert d.cap(300.0) == 300.0
+    d2 = Deadline.after(10.0)
+    assert 0 < d2.cap(300.0, margin=2.0) <= 8.0
+    assert d2.cap(1.0) == 1.0
+
+
+def test_enclosing_timeout_discovered_from_child(monkeypatch):
+    """A child under `timeout 300` must discover ~300s remaining via
+    the /proc walk — the exact mechanism bench.py relies on under the
+    driver's `timeout 1800`."""
+    monkeypatch.delenv("AGNES_BENCH_DEADLINE_S", raising=False)
+    code = ("import sys; sys.path.insert(0, '.');"
+            "from agnes_tpu.utils.budget import Deadline;"
+            "d = Deadline.discover();"
+            "print(d.source, d.remaining())")
+    r = subprocess.run(
+        ["timeout", "300", sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr
+    source, rem = r.stdout.split()
+    assert source == "proc:timeout"
+    # the discovery takes the TIGHTEST enclosing timeout: if this test
+    # session itself runs under one shorter than 300s, remaining is
+    # smaller — but never larger, and never non-positive
+    assert 0 < float(rem) <= 300
+
+
+def test_enclosing_timeout_none_here():
+    """This pytest process itself may or may not be under a timeout;
+    the call must simply not crash and return None-or-positive."""
+    rem = enclosing_timeout_remaining()
+    assert rem is None or isinstance(rem, float)

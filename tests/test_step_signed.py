@@ -276,46 +276,145 @@ def test_dense_matches_lane_path():
         assert d1.all_decided() and d2.all_decided()
 
 
-@pytest.mark.parametrize("hier", [False, True])
-def test_dense_sharded_matches_unsharded(hier):
-    """The SHARDED fused signed step (each device verifying its local
-    (instance, validator) cells; quorum psums unchanged) must be
-    bitwise-identical to the single-device dense path — the standing
-    sharded-vs-unsharded contract extended to fused verification,
-    forged lanes included."""
-    from agnes_tpu.harness.fixtures import (
-        deterministic_seeds,
-        full_mesh_cols,
-        validator_pubkeys,
-    )
-    from agnes_tpu.parallel import make_hierarchical_mesh, make_mesh
+def _drive_dense(I2, V2, seeds, pubs, mesh=None, verify_chunk=None,
+                 hbm_budget_bytes=None, forge_validator=1):
+    """One full dense signed sequence (entry + both vote classes,
+    forged lanes included) — the shared body for every differential
+    below."""
+    from agnes_tpu.harness.fixtures import full_mesh_cols
 
-    mesh = make_hierarchical_mesh(2, 2, 2) if hier else make_mesh(2, 4)
-    I2, V2 = 4, 4
-    seeds = deterministic_seeds(V2)
+    d = DeviceDriver(I2, V2, mesh=mesh, verify_chunk=verify_chunk,
+                     hbm_budget_bytes=hbm_budget_bytes)
+    b = VoteBatcher(I2, V2, n_slots=4)
+    d.step()
+    b.sync_device(np.asarray(d.tally.base_round),
+                  np.asarray(d.state.height))
+    for typ in (PV, PC):
+        b.add_arrays(*full_mesh_cols(I2, V2, seeds, 0, typ, 7,
+                                     forge_validator=forge_validator))
+    phases, dense = b.build_phases_device_dense(pubs)
+    assert dense is not None
+    d.step_seq_signed_dense([p for p, _ in phases], dense)
+    d.collect()
+    return d
+
+
+def _assert_bitwise_equal(da, db):
+    for a, c in zip(da.tally, db.tally):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    for a, c in zip(da.state, db.state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    assert (da.rejected_signature_device
+            == db.rejected_signature_device)
+
+
+# chunk grid vs I=3: 1 = one-row tiles, 2 = ragged last tile,
+# 3 = full batch in one tile, 8 = chunk >= I and 0 = "no chunking"
+# (both normalized to the single-call path — they share its compile,
+# so they stay in tier-1; the real chunked cases each pay a fresh
+# multi-minute verify-kernel compile and are tier-1-excluded via
+# `slow`, run by ci.sh)
+@pytest.mark.parametrize("chunk", [
+    pytest.param(1, marks=pytest.mark.slow),
+    pytest.param(2, marks=pytest.mark.slow),
+    pytest.param(3, marks=pytest.mark.slow),
+    8,
+    0,
+])
+def test_dense_chunked_matches_unchunked(chunk):
+    """The HBM-chunked dense verify (verify_chunk instance rows per
+    lax.map microbatch, utils/budget.py) must be BIT-identical to the
+    historical single-batch call — decisions, tally, state, and the
+    per-lane reject verdicts, forged lanes included (ISSUE 1
+    acceptance criterion)."""
+    seeds = deterministic_seeds(V)
     pubs = validator_pubkeys(seeds)
+    dc = _drive_dense(I, V, seeds, pubs, verify_chunk=chunk)
+    du = _drive_dense(I, V, seeds, pubs, verify_chunk=None)
+    _assert_bitwise_equal(dc, du)
+    assert dc.rejected_signature_device == 2 * I
+    assert dc.all_decided() and du.all_decided()
 
-    def run(mesh_arg):
-        d = DeviceDriver(I2, V2, mesh=mesh_arg)
-        b = VoteBatcher(I2, V2, n_slots=4)
+
+@pytest.mark.slow
+def test_dense_auto_chunk_matches_unchunked():
+    """verify_chunk="auto" under a tiny simulated HBM budget must pick
+    a real multi-chunk plan (planner math, no device introspection)
+    and still match the unchunked path bitwise."""
+    from agnes_tpu.utils.budget import plan_dense_verify
+
+    seeds = deterministic_seeds(V)
+    pubs = validator_pubkeys(seeds)
+    budget = 256_000          # forces tile < I at the Ps=2, 3x4 shape
+    plan = plan_dense_verify(2, I, V, hbm_bytes=budget)
+    assert plan.chunked       # the premise: auto must actually chunk
+    da = _drive_dense(I, V, seeds, pubs, verify_chunk="auto",
+                      hbm_budget_bytes=budget)
+    du = _drive_dense(I, V, seeds, pubs, verify_chunk=None)
+    _assert_bitwise_equal(da, du)
+    assert da.all_decided()
+
+
+@pytest.mark.parametrize("chunk", [
+    pytest.param(1, marks=pytest.mark.slow),
+    pytest.param(2, marks=pytest.mark.slow),
+    24,
+])
+def test_lane_chunked_matches_unchunked(chunk):
+    """The packed-lane fused path (step_seq_signed) with a chunked
+    verify: driver rows scale to lanes (chunk * V per microbatch);
+    chunk=2 leaves a ragged tail on the 24-lane batch, chunk=24 is
+    normalized to the single-call path (compile shared — tier-1-safe).
+    Bitwise against unchunked."""
+    def run(vc):
+        d = DeviceDriver(I, V, verify_chunk=vc)
+        b = VoteBatcher(I, V, n_slots=4)
         d.step()
         b.sync_device(np.asarray(d.tally.base_round),
                       np.asarray(d.state.height))
         for typ in (PV, PC):
-            b.add_arrays(*full_mesh_cols(I2, V2, seeds, 0, typ, 7,
-                                         forge_validator=1))
-        phases, dense = b.build_phases_device_dense(pubs)
-        assert dense is not None
-        d.step_seq_signed_dense([p for p, _ in phases], dense)
+            b.add_arrays(*_signed_cols(0, typ, 7, forge_validator=0))
+        phases, lanes = b.build_phases_device(PUBKEYS)
+        assert lanes is not None
+        d.step_seq_signed([p for p, _ in phases], lanes)
         d.collect()
         return d
 
-    ds = run(mesh)
-    du = run(None)
-    for a, c in zip(ds.tally, du.tally):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
-    for a, c in zip(ds.state, du.state):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    dc, du = run(chunk), run(None)
+    _assert_bitwise_equal(dc, du)
+    assert dc.rejected_signature_device == 2 * I
+    assert dc.all_decided() and du.all_decided()
+
+
+# (hier, I2, V2, verify_chunk) — the static-guarantee shape grid that
+# replaces check_vma on the sharded signed wrapper (VERDICT r5 weak
+# #6): flat + hierarchical meshes x unchunked / 1-row tiles / ragged
+# local tiles.  chunk counts LOCAL rows: flat I2=6 shards to 3
+# rows/device so chunk=2 leaves a ragged last tile; hier I2=8 shards
+# to 2 rows/device.
+@pytest.mark.parametrize("hier,I2,V2,chunk", [
+    (False, 4, 4, None),
+    (True, 4, 4, None),
+    pytest.param(False, 4, 4, 1, marks=pytest.mark.slow),
+    pytest.param(True, 8, 4, 1, marks=pytest.mark.slow),
+    pytest.param(False, 6, 4, 2, marks=pytest.mark.slow),
+])
+def test_dense_sharded_matches_unsharded(hier, I2, V2, chunk):
+    """The SHARDED fused signed step (each device verifying its local
+    (instance, validator) cells; quorum psums unchanged) must be
+    bitwise-identical to the single-device dense path — the standing
+    sharded-vs-unsharded contract extended to fused verification,
+    forged lanes included, chunked and unchunked (the chunk loop is a
+    shard-local lax.map: zero added collectives per chunk)."""
+    from agnes_tpu.parallel import make_hierarchical_mesh, make_mesh
+
+    mesh = make_hierarchical_mesh(2, 2, 2) if hier else make_mesh(2, 4)
+    seeds = deterministic_seeds(V2)
+    pubs = validator_pubkeys(seeds)
+    ds = _drive_dense(I2, V2, seeds, pubs, mesh=mesh,
+                      verify_chunk=chunk)
+    du = _drive_dense(I2, V2, seeds, pubs, mesh=None, verify_chunk=None)
+    _assert_bitwise_equal(ds, du)
     # validator 1 forged in both classes across all instances
     assert ds.rejected_signature_device == 2 * I2
     assert du.rejected_signature_device == 2 * I2
