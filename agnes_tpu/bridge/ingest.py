@@ -309,6 +309,18 @@ class VoteBatcher:
         validators via `signed_evidence` first)."""
         self._log = []
 
+    @property
+    def held_votes(self) -> int:
+        """Future-round votes currently held back (they re-enter on the
+        sync_device that rotates their window in; the serve plane's
+        drain reports what is still held at shutdown)."""
+        return self._held_n
+
+    @property
+    def pending_votes(self) -> int:
+        """Votes enqueued but not yet drained by a build."""
+        return sum(len(b) for b in self._pending)
+
     # -- signature verification ----------------------------------------------
 
     def _pack_verify_inputs_np(self, b: _Batch, pubkeys: np.ndarray):
@@ -632,7 +644,8 @@ class VoteBatcher:
         return True
 
     def build_phases_device(self, pubkeys: np.ndarray,
-                            phase_offset: int = 0):
+                            phase_offset: int = 0,
+                            lane_floor: int = 0):
         """Drain pending votes into dense phases with verification
         deferred to the DEVICE: returns (phases, SignedLanes) where the
         lanes carry every emitted vote's packed Ed25519 inputs, keyed
@@ -660,13 +673,16 @@ class VoteBatcher:
         device; a copy of a valid lane cannot inflate n_rejected) so
         variable per-tick vote counts reuse a logarithmic number of
         compiled (P, N) shapes instead of recompiling the fused step
-        per tick."""
+        per tick.  `lane_floor` raises that padding to at least the
+        given lane count (pass a serve ShapeLadder rung — itself a
+        power of two — so small micro-batches all land on ONE
+        precompiled shape instead of one per log2(n))."""
         phases, cat, pidx = self._build_device_common(pubkeys)
         if cat is None:
             return phases, None
         phase_idx = pidx + phase_offset
         n = len(cat)
-        n_pad = 1 << (n - 1).bit_length()
+        n_pad = max(1 << (n - 1).bit_length(), int(lane_floor))
         real = np.ones(n_pad, bool)
         if n_pad > n:
             real[n:] = False

@@ -122,6 +122,37 @@ def pack_wire_votes(instance, validator, height, round_, typ, value,
     return rec.tobytes()
 
 
+def unpack_wire_votes(wire) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                     np.ndarray, np.ndarray, np.ndarray,
+                                     np.ndarray]:
+    """Packed 96-byte wire records -> column arrays (vectorized): the
+    exact inverse of `pack_wire_votes`, for HOST consumers of the wire
+    ABI (the serve plane's admission queue screens and fairness-
+    accounts records before they reach a batcher).  Returns (instance,
+    validator, height, round, typ, value, signatures[N, 64]); value is
+    -1 for nil.  A trailing partial record is DROPPED (the caller
+    counts it via `len(wire) % REC_SIZE`)."""
+    buf = np.frombuffer(wire, np.uint8) if isinstance(wire, (bytes,
+                                                             bytearray,
+                                                             memoryview)) \
+        else np.asarray(wire, np.uint8).ravel()
+    n = len(buf) // REC_SIZE
+    rec = buf[:n * REC_SIZE].reshape(n, REC_SIZE)
+
+    def field(lo, hi, dt):
+        return np.ascontiguousarray(rec[:, lo:hi]).view(dt)[:, 0]
+
+    inst = field(0, 4, np.uint32).astype(np.int64)
+    val = field(4, 8, np.uint32).astype(np.int64)
+    height = field(8, 16, np.int64).copy()
+    round_ = field(16, 20, np.int32).astype(np.int64)
+    typ = rec[:, 20].astype(np.int64)
+    nonnil = rec[:, 21] != 0
+    value = np.where(nonnil, field(24, 32, np.int64), -1)
+    sigs = np.ascontiguousarray(rec[:, 32:96])
+    return inst, val, height, round_, typ, value, sigs
+
+
 class NativeIngestLoop:
     """One C++ ingestion loop per (driver, height window) — the native
     fast lane with the same tick protocol as VoteBatcher."""

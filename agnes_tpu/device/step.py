@@ -306,6 +306,20 @@ def consensus_step_seq(state: DeviceState,
 consensus_step_seq_jit = jax.jit(
     consensus_step_seq, static_argnames=("axis_name", "advance_height"))
 
+# DONATED variant for the streaming serve plane (serve/pipeline.py):
+# state/tally buffers are donated to XLA so the step sequence updates
+# them in place instead of allocating a fresh copy per dispatch — at
+# the north-star shape the tally's voted array alone is
+# I*W*2*V*4 B = 320 MB, and a service dispatching continuously would
+# otherwise hold two generations live across every in-flight step.
+# A SEPARATE jit entry (not a flag): donation is part of the compiled
+# executable's buffer aliasing, and the non-donating entries must keep
+# their historical semantics (callers may legally reuse the passed
+# state, e.g. the differential tests stepping two drivers in lockstep).
+consensus_step_seq_donated_jit = jax.jit(
+    consensus_step_seq, static_argnames=("axis_name", "advance_height"),
+    donate_argnums=(0, 1))
+
 
 class SignedLanes(NamedTuple):
     """Packed per-lane Ed25519 verify inputs for DEVICE-FUSED
@@ -428,6 +442,13 @@ def consensus_step_seq_signed(state: DeviceState,
 consensus_step_seq_signed_jit = jax.jit(
     consensus_step_seq_signed,
     static_argnames=("advance_height", "verify_chunk"))
+
+# donated twin (see consensus_step_seq_donated_jit): the serve plane's
+# continuous dispatch loop updates state/tally in place
+consensus_step_seq_signed_donated_jit = jax.jit(
+    consensus_step_seq_signed,
+    static_argnames=("advance_height", "verify_chunk"),
+    donate_argnums=(0, 1))
 
 
 class DenseSignedPhases(NamedTuple):

@@ -34,8 +34,10 @@ from agnes_tpu.device.step import (
     NULL_EVENT,
     VotePhase,
     consensus_step_jit,
+    consensus_step_seq_donated_jit,
     consensus_step_seq_jit,
     consensus_step_seq_signed_dense_jit,
+    consensus_step_seq_signed_donated_jit,
     consensus_step_seq_signed_jit,
     honest_heights_jit,
 )
@@ -346,6 +348,69 @@ class DeviceDriver:
         return self._finish_signed(out, P,
                                    int(np.asarray(lanes.real).sum()))
 
+    def step_async(self, phases, lanes=None, exts=None,
+                   donate: bool = True) -> "jnp.ndarray":
+        """The serve plane's dispatch entry: queue a fused step
+        sequence and return the moment dispatch is queued — message
+        collection is ALWAYS deferred (regardless of `defer_collect`;
+        call collect()/block_until_ready() when the stats are needed),
+        so the host immediately overlaps densify of batch k+1 with the
+        device's execution of batch k (serve/pipeline.py's double
+        buffer).
+
+        With `lanes` (SignedLanes from build_phases_device) the
+        device-fused signed step runs; without, the plain sequence
+        (host-verified or unsigned phases).  `donate` hands the
+        state/tally buffers to XLA for in-place update — the steady-
+        state serve configuration; pass False to share the jit cache
+        (and buffer semantics) with the non-donating step_seq* entries,
+        e.g. for lockstep differentials against the offline path.
+
+        NOTE: inputs must not alias the driver's live state when
+        donating — build entry phases from HOST heights (the serve
+        pipeline does), not from `empty_phase()` whose height leaf IS
+        `state.height`; an aliased donation degrades to a copy (jax
+        warns) instead of corrupting, but the point of this entry is
+        to avoid that copy.  Single-device (packed-lane layout); mesh
+        serving is an open ROADMAP item."""
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "step_async serves the single-device packed-lane "
+                "layout; on a mesh drive step_seq_signed_dense")
+        phases_st, exts_st, P = self._stack_seq(phases, exts)
+        state, tally = self.state, self.tally
+        if donate:
+            # DeviceState.new/TallyState.new deliberately reuse one
+            # zeros/fill array across fields — harmless normally, but
+            # XLA refuses to donate one buffer twice (`f(donate(a),
+            # donate(a))`), so the FIRST donated dispatch of a fresh
+            # driver must break those aliases (step outputs are
+            # distinct buffers, so later dispatches copy nothing)
+            state, tally = _dealias_buffers(state, tally)
+        if lanes is not None:
+            fn = (consensus_step_seq_signed_donated_jit if donate
+                  else consensus_step_seq_signed_jit)
+            out = fn(state, tally, exts_st, phases_st, lanes,
+                     self.powers, self.total, self.proposer_flag,
+                     self.propose_value,
+                     advance_height=self.advance_height,
+                     verify_chunk=self._resolve_lane_chunk(
+                         int(lanes.pub.shape[0])))
+            n_votes = int(np.asarray(lanes.real).sum())
+            n_rejected = out.n_rejected
+        else:
+            fn = (consensus_step_seq_donated_jit if donate
+                  else consensus_step_seq_jit)
+            out = fn(state, tally, exts_st, phases_st,
+                     self.powers, self.total, self.proposer_flag,
+                     self.propose_value,
+                     advance_height=self.advance_height)
+            n_votes = int(sum(int(np.asarray(p.mask).sum())
+                              for p in phases))
+            n_rejected = None
+        return self._finish_step(out, P, n_votes, n_rejected,
+                                 force_defer=True)
+
     def _stack_seq(self, phases, exts):
         P = len(phases)
         exts = exts if exts is not None else [self.ext()] * P
@@ -356,11 +421,20 @@ class DeviceDriver:
     def _finish_signed(self, out, P: int, n_votes: int):
         """Shared tail of the signed step variants: stats, deferred
         reject settlement, message collection."""
+        return self._finish_step(out, P, n_votes, out.n_rejected)
+
+    def _finish_step(self, out, P: int, n_votes: int, n_rejected=None,
+                     force_defer: bool = False):
+        """THE bookkeeping tail of every step-sequence dispatch:
+        state/tally swap, stats, deferred reject settlement, message
+        collection (`force_defer` = step_async's always-deferred
+        contract, independent of `defer_collect`)."""
         self.state, self.tally = out.state, out.tally
         self.stats.steps += P
         self.stats.votes_ingested += n_votes
-        self._pending_rejects.append(out.n_rejected)
-        if self.defer_collect:
+        if n_rejected is not None:
+            self._pending_rejects.append(n_rejected)
+        if self.defer_collect or force_defer:
             self._deferred_msgs.append(out.msgs)
         else:
             self._collect(out.msgs)
@@ -553,6 +627,30 @@ class DeviceDriver:
         self.collect()
         jax.block_until_ready(self.state)
         return self
+
+
+def _dealias_buffers(*trees):
+    """Copy any pytree leaf whose device buffer is already used by an
+    earlier leaf (across ALL given trees), so the whole set can be
+    donated in one dispatch.  Leaves that alias are the tiny [I]
+    state fields, so the occasional copy is nanoseconds."""
+    seen = set()
+    out = []
+    for t in trees:
+        leaves, treedef = jax.tree.flatten(t)
+        fixed = []
+        for x in leaves:
+            try:
+                key = x.unsafe_buffer_pointer()
+            except Exception:  # noqa: BLE001 — fall back to identity
+                key = id(x)
+            if key in seen:
+                x = x.copy()
+            else:
+                seen.add(key)
+            fixed.append(x)
+        out.append(jax.tree.unflatten(treedef, fixed))
+    return out
 
 
 def round_half_up(x: float) -> int:

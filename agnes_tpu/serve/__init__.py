@@ -1,0 +1,39 @@
+"""The streaming vote service plane (ISSUE 2 tentpole).
+
+Everything before this package was an offline batch build: tests and
+bench hand VoteBatcher a complete tick and drive the device by hand.
+This package is the ONLINE path between a network frontend and the
+device driver — the subsystem a "millions of users" deployment
+actually runs:
+
+  queue.py      bounded admission over packed 96-byte wire records;
+                explicit backpressure (reject-newest default,
+                drop-oldest optional) + per-instance fairness caps
+  batcher.py    deadline-aware micro-batching (close on size OR
+                deadline) over a precomputed ShapeLadder, so no
+                request-dependent shape ever triggers a fresh jit
+                compile
+  pipeline.py   double-buffered densify/dispatch: host densifies
+                batch k+1 (VoteBatcher.add_arrays — the offline
+                densify stage, reused) while the device runs the
+                async fused signed step on batch k with donated
+                state/tally buffers
+  service.py    the façade: submit / pump / poll_decisions / drain,
+                wired into utils.metrics (windowed serve rates,
+                queue-depth / batch-fill / latency gauges) and
+                utils.tracing spans
+
+Single-device (packed-lane fused path).  Mesh serving — sharding the
+admission plane with the dense lane layout — is a ROADMAP item.
+"""
+
+from agnes_tpu.serve.batcher import MicroBatcher, ShapeLadder  # noqa: F401
+from agnes_tpu.serve.pipeline import ServePipeline  # noqa: F401
+from agnes_tpu.serve.queue import (  # noqa: F401
+    AdmissionQueue,
+    AdmitResult,
+    DROP_OLDEST,
+    REJECT_NEWEST,
+    WireColumns,
+)
+from agnes_tpu.serve.service import Decision, VoteService  # noqa: F401

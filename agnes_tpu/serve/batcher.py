@@ -1,0 +1,167 @@
+"""Deadline-aware micro-batching over a precomputed shape ladder.
+
+The stage between admission (serve/queue.py) and densify/dispatch
+(serve/pipeline.py).  Two jobs:
+
+* **When to close a batch** (`MicroBatcher`): size-OR-deadline.  A
+  batch closes the moment the queue holds `target_votes` records
+  (throughput mode: full device batches), or when the OLDEST queued
+  record has waited `max_delay_s` (latency mode: a trickle of votes
+  still reaches the chip promptly).  The classic latency/throughput
+  dial of every serving system, applied to consensus votes.
+
+* **What shapes may reach the device** (`ShapeLadder`): the fused
+  signed step's compile key includes the lane count, and with the
+  persistent compile cache deliberately off (utils/compile_cache.py)
+  a fresh shape costs MINUTES of XLA trace on the tier-1 box — a
+  request-dependent shape is a self-inflicted DoS.  The ladder is the
+  full set of lane shapes the serve plane will ever emit: powers of
+  two from `min_rung` to a top rung planned against the device HBM
+  budget (utils/budget.plan_lane_verify — a rung whose resident
+  verify operands cannot fit is dropped).  The pipeline passes
+  `min_rung` as VoteBatcher's lane_floor, so every emitted batch pads
+  onto a rung: at most len(rungs) compiles for the service's entire
+  lifetime, each precompilable at startup (`ServePipeline.warmup`).
+
+The batch-fill ratio (votes / rung) is the honest utilization number:
+padding lanes do real device work, so sustained fill << 1 means the
+deadline is too tight or the target too big for the offered load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Tuple
+
+from agnes_tpu.serve.queue import AdmissionQueue, WireColumns
+from agnes_tpu.utils.budget import BudgetError, plan_lane_verify
+
+
+def _ceil_pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeLadder:
+    """Ascending power-of-two lane counts the serve plane may emit."""
+
+    rungs: Tuple[int, ...]
+
+    def __post_init__(self):
+        if not self.rungs:
+            raise ValueError("empty shape ladder")
+        for r in self.rungs:
+            if r & (r - 1) or r <= 0:
+                raise ValueError(f"rungs must be powers of two: {r}")
+        if list(self.rungs) != sorted(set(self.rungs)):
+            raise ValueError(f"rungs must be ascending: {self.rungs}")
+
+    @property
+    def min_rung(self) -> int:
+        return self.rungs[0]
+
+    @property
+    def max_rung(self) -> int:
+        return self.rungs[-1]
+
+    def rung_for(self, n_votes: int) -> int:
+        """Smallest rung holding `n_votes` lanes (the shape a batch of
+        that size pads onto).  n_votes above the top rung is a caller
+        bug — the micro-batcher's target is clamped to max_rung."""
+        for r in self.rungs:
+            if n_votes <= r:
+                return r
+        raise ValueError(
+            f"{n_votes} votes exceed the ladder's top rung "
+            f"{self.max_rung} (close smaller batches)")
+
+    @classmethod
+    def plan(cls, n_instances: int, n_validators: int,
+             max_votes: Optional[int] = None, min_rung: int = 256,
+             hbm_bytes: Optional[int] = None) -> "ShapeLadder":
+        """Build the ladder for a deployment shape: rungs from
+        `min_rung` up to the smaller of `max_votes` (default: one full
+        both-classes tick, 2*I*V — the largest honest micro-batch) and
+        the largest rung whose resident verify operands fit the HBM
+        budget at all (chunked execution handles the workspace; a rung
+        plan_lane_verify cannot even size is dropped)."""
+        top_want = 2 * n_instances * n_validators
+        if max_votes is not None:
+            top_want = min(top_want, int(max_votes))
+        min_rung = _ceil_pow2(min_rung)
+        top = max(_ceil_pow2(top_want), min_rung)
+        rungs = []
+        r = min_rung
+        while r <= top:
+            try:
+                plan_lane_verify(r, hbm_bytes=hbm_bytes)
+            except BudgetError:
+                break          # larger rungs only get worse
+            rungs.append(r)
+            r <<= 1
+        if not rungs:
+            raise BudgetError(
+                f"no ladder rung >= {min_rung} fits the HBM budget "
+                f"(shape {n_instances}x{n_validators})")
+        return cls(rungs=tuple(rungs))
+
+    def describe(self) -> str:
+        return ("shape ladder: " + " ".join(str(r) for r in self.rungs)
+                + " lanes")
+
+
+class MicroBatcher:
+    """Size-or-deadline batch closer over an AdmissionQueue."""
+
+    def __init__(self, queue: AdmissionQueue, ladder: ShapeLadder,
+                 target_votes: Optional[int] = None,
+                 max_delay_s: float = 0.005,
+                 clock=time.monotonic):
+        self.queue = queue
+        self.ladder = ladder
+        self.target = min(int(target_votes) if target_votes is not None
+                          else ladder.max_rung, ladder.max_rung)
+        if self.target <= 0:
+            raise ValueError(f"target_votes must be positive: "
+                             f"{target_votes}")
+        if max_delay_s < 0:
+            raise ValueError(f"max_delay_s must be >= 0: {max_delay_s}")
+        self.max_delay_s = float(max_delay_s)
+        self._clock = clock
+        self.batches_closed = 0
+        self.closed_by_size = 0
+        self.closed_by_deadline = 0
+
+    def poll(self, now: Optional[float] = None) -> Optional[WireColumns]:
+        """Close and return a batch iff the size target is met or the
+        oldest queued record's deadline has passed; else None (the
+        caller's pump loop just comes back)."""
+        if self.queue.depth <= 0:
+            return None
+        by_size = self.queue.depth >= self.target
+        if not by_size:
+            oldest = self.queue.oldest_ts
+            now = self._clock() if now is None else now
+            if oldest is None or now - oldest < self.max_delay_s:
+                return None
+        batch = self.queue.drain(self.target)
+        if batch is not None:
+            self.batches_closed += 1
+            if by_size:
+                self.closed_by_size += 1
+            else:
+                self.closed_by_deadline += 1
+        return batch
+
+    def flush(self) -> Optional[WireColumns]:
+        """Close a batch regardless of size/deadline (drain path)."""
+        batch = self.queue.drain(self.target)
+        if batch is not None:
+            self.batches_closed += 1
+        return batch
+
+    def fill(self, n_votes: int) -> float:
+        """Batch-fill ratio: votes over the rung they pad onto."""
+        return n_votes / self.ladder.rung_for(min(n_votes,
+                                                  self.ladder.max_rung))

@@ -1,0 +1,310 @@
+"""Double-buffered densify/dispatch loop of the serve plane.
+
+The stage that keeps the chip busy: while the device executes the
+async fused signed step on batch k (DeviceDriver.step_async — deferred
+collection, donated state/tally buffers), the host densifies batch
+k+1 (VoteBatcher.add_arrays -> build_phases_device: the EXISTING
+offline densify stage, reused verbatim so streaming and offline builds
+cannot diverge).  One staged slot is the whole buffer discipline:
+
+    pump(batch):
+      1. DISPATCH the staged (already densified) batch     [device]
+      2. DENSIFY `batch` into the staged slot              [host]
+
+so step 2's host work overlaps step 1's device work through JAX async
+dispatch, and the device never waits on densify of the batch after
+next.  This is the serve twin of bench.py's `_pipeline_fused` loop.
+
+Window discipline: densify needs the batcher synced to the device's
+(base_round, heights) — fetching those serializes host behind device
+(the fetch completes only after the in-flight step).  Production
+honest-path serving therefore passes `window_predictor` (the same
+prediction bench._pipeline_fused uses: honest pipeline -> round 0,
+height h) and keeps the loop fetch-free; without one the pipeline
+fetches per stage — always CORRECT, measurably slower ("the
+measured-overhead baseline", as with the host-verified build).
+
+Entry phases: the offline per-height loop prepends one empty entry
+phase (round entry + self-proposal) per height.  The pipeline does
+the same automatically whenever the window heights advance past the
+last entry it dispatched (and on the first dispatch), so honest
+streamed traffic reproduces the offline step sequence exactly —
+that's what makes the serve-vs-offline differential bit-identical.
+
+Degenerate ticks fail SOFT and CHEAP: a zero-vote batch, an all-held
+(future-round) batch, an all-stale batch — anything that densifies to
+zero phases — skips dispatch entirely (a counted no-op; no fresh
+compile, no crash) instead of pushing an empty step shape through jit.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from agnes_tpu.device.encoding import I32
+from agnes_tpu.device.step import VotePhase
+from agnes_tpu.serve.batcher import ShapeLadder
+from agnes_tpu.serve.queue import WireColumns
+from agnes_tpu.types import NIL_ID
+from agnes_tpu.utils.tracing import Tracer
+
+
+@dataclass
+class _StagedBatch:
+    """A densified batch waiting for its device dispatch."""
+
+    phases: list               # [(VotePhase, n_votes)]
+    lanes: object              # SignedLanes | None (host-verified)
+    entry: bool                # entry phase prepended?
+    entry_heights: Optional[np.ndarray]
+    n_votes: int
+    t_first: float             # earliest admission instant
+
+
+@dataclass
+class _Inflight:
+    t_first: float
+    n_votes: int
+    t_dispatch: float
+
+
+class ServePipeline:
+    """Densify + dispatch with one staged slot (module docstring)."""
+
+    def __init__(self, driver, batcher, pubkeys: Optional[np.ndarray],
+                 ladder: ShapeLadder,
+                 window_predictor: Optional[Callable] = None,
+                 donate: bool = True,
+                 tracer: Optional[Tracer] = None,
+                 clock=time.monotonic):
+        self.driver = driver
+        self.batcher = batcher
+        self.pubkeys = pubkeys          # None = unsigned deployment
+        self.ladder = ladder
+        self.window_predictor = window_predictor
+        self.donate = donate
+        self.tracer = tracer
+        self._clock = clock
+        self._staged: Optional[_StagedBatch] = None
+        self._inflight: List[_Inflight] = []
+        self._entry_h: Optional[np.ndarray] = None
+        # slot->value decode captured at each instance's FIRST height
+        # advance: sync_device resets an advanced instance's slot map,
+        # and the double buffer stages h+1 before h's decision
+        # messages are collected — so the FIRST (latched) decision of
+        # an instance must decode against the table that existed when
+        # it was made, not whatever a later height interned into the
+        # same slot (service.poll_decisions consumes this)
+        self.first_advance_decode: dict = {}
+        self.dispatched_batches = 0
+        self.dispatched_votes = 0
+        self.noop_ticks = 0
+        self.host_fallback_builds = 0
+        # lane shapes above the ladder's top rung: possible when a
+        # held future-round burst enters the window in the same round
+        # as a full new batch (one build drains both).  Still a power
+        # of two — log-bounded, never request-granular — but NOT
+        # warmed, so each costs a live compile stall: watch this
+        # counter in production (ROADMAP: window-aware splitting)
+        self.offladder_builds = 0
+
+    def _span(self, name: str):
+        import contextlib
+
+        return (self.tracer.span(name) if self.tracer is not None
+                else contextlib.nullcontext())
+
+    # -- window --------------------------------------------------------------
+
+    def _sync_window(self) -> np.ndarray:
+        """Adopt the target (base_round, heights) into the batcher;
+        returns the heights.  Predictor mode is fetch-free; device
+        mode forces a host<->device sync (docstring)."""
+        if self.window_predictor is not None:
+            base, hts = self.window_predictor()
+            base = np.asarray(base, np.int64)
+            hts = np.asarray(hts, np.int64)
+        else:
+            base = np.asarray(self.driver.tally.base_round,
+                              ).astype(np.int64)
+            hts = np.asarray(self.driver.state.height).astype(np.int64)
+        for i in np.nonzero(hts > self.batcher.heights)[0]:
+            if int(i) not in self.first_advance_decode:
+                self.first_advance_decode[int(i)] = {
+                    s: self.batcher.decode_slot(int(i), s)
+                    for s in range(self.batcher.slots.n_slots)}
+        self.batcher.sync_device(base, hts)
+        return hts
+
+    def _entry_phase(self, heights: np.ndarray) -> VotePhase:
+        """The round-entry phase, built from HOST heights so nothing
+        in a donated dispatch aliases the driver's live state
+        (DeviceDriver.step_async's donation contract)."""
+        I, V = self.driver.I, self.driver.V
+        return VotePhase(
+            round=jnp.zeros(I, I32),
+            typ=jnp.zeros(I, I32),
+            slots=jnp.full((I, V), NIL_ID, I32),
+            mask=jnp.zeros((I, V), bool),
+            height=jnp.asarray(heights, I32))
+
+    # -- stages --------------------------------------------------------------
+
+    def stage(self, batch: Optional[WireColumns],
+              sync: bool = True) -> bool:
+        """Densify `batch` into the staged slot (host work — overlaps
+        the in-flight device step).  Returns True when something was
+        staged; a batch that densifies to nothing (all held / stale /
+        rejected) is a counted no-op.  With batch None, whatever the
+        batcher already holds pending is built instead (the drain
+        path's held-vote re-entry; `sync=False` when the caller just
+        synced) — a no-batch no-pending call is a plain idle tick."""
+        n_new = len(batch) if batch is not None else 0
+        if n_new == 0 and self.batcher.pending_votes == 0:
+            return False
+        assert self._staged is None, "staged slot occupied (pump first)"
+        with self._span("serve.densify"):
+            hts = (self._sync_window() if sync
+                   else self.batcher.heights.copy())
+            if n_new:
+                self.batcher.add_arrays(batch.instance, batch.validator,
+                                        batch.height, batch.round_,
+                                        batch.typ, batch.value,
+                                        batch.signatures)
+            if self.pubkeys is not None:
+                phases, lanes = self.batcher.build_phases_device(
+                    self.pubkeys, phase_offset=1,
+                    lane_floor=self.ladder.min_rung)
+            else:
+                phases, lanes = self.batcher.build_phases(), None
+            if self.pubkeys is not None and lanes is None and phases:
+                # ineligible traffic (equivocation layers, mixed
+                # rounds, MSM mode): the batcher host-verified instead
+                self.host_fallback_builds += 1
+            if lanes is not None and \
+                    int(lanes.pub.shape[0]) > self.ladder.max_rung:
+                self.offladder_builds += 1
+        if not phases:
+            self.noop_ticks += 1
+            return False
+        # Entry policy: signed builds ALWAYS prepend the empty entry
+        # phase (their lanes were packed with phase_offset=1, and the
+        # honest steady state advances heights every batch anyway —
+        # exactly the offline per-height loop's shape); unsigned
+        # builds prepend when the window heights advanced past the
+        # last entry dispatched (or on the first dispatch).  An extra
+        # empty step on an instance mid-round is a state-machine no-op
+        # (the driver's canned scenarios rely on the same property).
+        entry = (lanes is not None or self._entry_h is None
+                 or bool((hts > self._entry_h).any()))
+        if entry:
+            self._entry_h = hts.copy()
+        n_votes = sum(n for _, n in phases)
+        self._staged = _StagedBatch(
+            phases=[p for p, _ in phases], lanes=lanes, entry=entry,
+            entry_heights=hts if entry else None,
+            n_votes=n_votes,
+            t_first=batch.t_first if batch is not None
+            else self._clock())
+        return True
+
+    def dispatch_staged(self) -> int:
+        """Queue the staged batch's fused step on the device (async;
+        never fetches).  Returns the votes dispatched (0 = no-op)."""
+        st, self._staged = self._staged, None
+        if st is None:
+            return 0
+        with self._span("serve.dispatch"):
+            phases = st.phases
+            if st.entry:
+                phases = [self._entry_phase(st.entry_heights)] + phases
+            self.driver.step_async(phases, st.lanes,
+                                   donate=self.donate)
+        self._inflight.append(_Inflight(
+            t_first=st.t_first, n_votes=st.n_votes,
+            t_dispatch=self._clock()))
+        self.dispatched_batches += 1
+        self.dispatched_votes += st.n_votes
+        return st.n_votes
+
+    def pump(self, batch: Optional[WireColumns]) -> Tuple[int, bool]:
+        """One pipeline tick: dispatch what was staged, then densify
+        `batch` while the device runs.  Returns (votes dispatched,
+        staged?)."""
+        dispatched = self.dispatch_staged()
+        staged = self.stage(batch)
+        return dispatched, staged
+
+    # -- settle --------------------------------------------------------------
+
+    def settle(self) -> List[_Inflight]:
+        """Collect every queued message batch (the one host<->device
+        sync point) and hand back the in-flight batch metadata so the
+        caller (service) can derive end-to-end latency."""
+        with self._span("serve.collect"):
+            self.driver.collect()
+        done, self._inflight = self._inflight, []
+        return done
+
+    def warmup(self, n_phases=(2, 3)) -> int:
+        """Precompile every (phase count, ladder rung) fused-step
+        shape so the first real batch of each is not a minutes-long
+        trace stall mid-service.  Runs the EXACT runtime entry
+        (donated or not, same dtypes, same verify-chunk resolution) on
+        all-padding synthetic lanes against throwaway COPIES of the
+        driver state — outputs are discarded, so the live state/tally
+        are untouched even under donation.  `n_phases` is the step-
+        sequence length(s) to warm: signed builds always prepend the
+        entry phase, so the honest shapes are P=3 (entry + both vote
+        classes, size-closed batches) AND P=2 (entry + ONE class — a
+        deadline-closed batch that caught only the round's prevotes),
+        hence the (2, 3) default.  Returns shapes warmed.  Signed
+        deployments only (unsigned phase sequences have data-dependent
+        layer counts)."""
+        if self.pubkeys is None:
+            return 0
+        import jax
+
+        from agnes_tpu.device.step import (
+            SignedLanes,
+            consensus_step_seq_signed_donated_jit,
+            consensus_step_seq_signed_jit,
+        )
+
+        if isinstance(n_phases, int):
+            n_phases = (n_phases,)
+        d = self.driver
+        fn = (consensus_step_seq_signed_donated_jit if self.donate
+              else consensus_step_seq_signed_jit)
+        zero_hts = np.zeros(d.I, np.int64)
+        warmed = 0
+        for P in n_phases:
+            phases = [self._entry_phase(zero_hts)] * P
+            exts = [d.ext()] * P
+            phases_st = jax.tree.map(lambda *xs: jnp.stack(xs), *phases)
+            exts_st = jax.tree.map(lambda *xs: jnp.stack(xs), *exts)
+            for r in self.ladder.rungs:
+                lanes = SignedLanes(
+                    pub=jnp.zeros((r, 32), jnp.int32),
+                    sig=jnp.zeros((r, 64), jnp.int32),
+                    blocks=jnp.zeros((r, 1, 32), jnp.uint32),
+                    phase_idx=jnp.full(r, P, jnp.int32),     # dropped
+                    inst=jnp.zeros(r, jnp.int32),
+                    val=jnp.zeros(r, jnp.int32),
+                    real=jnp.zeros(r, bool))
+                state_c = jax.tree.map(lambda x: x.copy(), d.state)
+                tally_c = jax.tree.map(lambda x: x.copy(), d.tally)
+                out = fn(state_c, tally_c, exts_st, phases_st, lanes,
+                         d.powers, d.total, d.proposer_flag,
+                         d.propose_value,
+                         advance_height=d.advance_height,
+                         verify_chunk=d._resolve_lane_chunk(r))
+                jax.block_until_ready(out.state)
+                warmed += 1
+        return warmed

@@ -1,0 +1,258 @@
+"""Bounded admission queue over packed 96-byte wire records.
+
+The first stage of the streaming vote service plane (serve/): a
+continuous network front pushes raw wire bytes in, the micro-batcher
+drains FIFO column batches out.  Everything here is unauthenticated —
+signature verification happens far downstream (fused on device) — so
+this queue is the system's overload valve and its first DoS surface:
+
+* **Bounded, fail-closed.**  `capacity` records, hard.  The default
+  overload policy is **reject-newest** (a full queue refuses new work
+  and tells the caller, who can push back on the network peer);
+  `drop_oldest` is available for deployments that prefer freshest-
+  vote semantics (old consensus votes age out of relevance anyway),
+  at the cost of silently shedding admitted work.
+* **Per-instance fairness.**  One flooded consensus instance must not
+  starve the other 9,999: an instance may never occupy more than
+  `instance_cap` queue slots, whatever the total depth.  Records
+  beyond the cap are rejected at admission (counted, never queued) —
+  the host-side twin of the device plane's value-flood containment
+  (bench.bench_value_flood).
+* **Cheap screens only.**  Records are parsed (vectorized
+  `unpack_wire_votes`) and screened just enough to account fairness:
+  truncated tails and out-of-range instance ids are rejected as
+  malformed here; every deeper screen (validator range, vote type,
+  height staleness, signatures) stays with VoteBatcher/device, where
+  it already exists — duplicating it would create two drifting
+  truths.
+
+Pure numpy + stdlib; no jax anywhere on the admission path.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from agnes_tpu.bridge.native_ingest import REC_SIZE, unpack_wire_votes
+
+#: overload policies
+REJECT_NEWEST = "reject_newest"
+DROP_OLDEST = "drop_oldest"
+
+
+class AdmitResult(NamedTuple):
+    """Per-submit admission verdict (counts of records)."""
+
+    accepted: int
+    rejected_overflow: int
+    rejected_fairness: int
+    rejected_malformed: int
+    evicted: int               # drop_oldest only: old records shed
+
+    @property
+    def rejected(self) -> int:
+        return (self.rejected_overflow + self.rejected_fairness
+                + self.rejected_malformed)
+
+
+class WireColumns(NamedTuple):
+    """A drained FIFO batch as VoteBatcher.add_arrays columns."""
+
+    instance: np.ndarray       # [N] int64
+    validator: np.ndarray      # [N] int64
+    height: np.ndarray         # [N] int64
+    round_: np.ndarray         # [N] int64
+    typ: np.ndarray            # [N] int64
+    value: np.ndarray          # [N] int64 (-1 = nil)
+    signatures: np.ndarray     # [N, 64] uint8
+    t_first: float             # earliest admission instant in the batch
+
+    def __len__(self) -> int:
+        return len(self.instance)
+
+
+@dataclass
+class _Chunk:
+    """One admitted submit's (surviving) columns + admission time."""
+
+    cols: tuple                # 7 arrays, WireColumns order sans t_first
+    ts: float
+
+    def __len__(self) -> int:
+        return len(self.cols[0])
+
+    def split(self, n: int):
+        head = _Chunk(tuple(c[:n] for c in self.cols), self.ts)
+        tail = _Chunk(tuple(c[n:] for c in self.cols), self.ts)
+        return head, tail
+
+
+def _cumcount(x: np.ndarray) -> np.ndarray:
+    """[N] rank of each element within its value group, in arrival
+    order (groupby-cumcount, vectorized)."""
+    n = len(x)
+    order = np.argsort(x, kind="stable")
+    sx = x[order]
+    new = np.ones(n, bool)
+    new[1:] = sx[1:] != sx[:-1]
+    starts = np.maximum.accumulate(np.where(new, np.arange(n), 0))
+    out = np.empty(n, np.int64)
+    out[order] = np.arange(n) - starts
+    return out
+
+
+class AdmissionQueue:
+    """FIFO of admitted wire records, bounded with per-instance
+    fairness (module docstring).  `submit` admits, `drain` hands FIFO
+    column batches to the micro-batcher."""
+
+    def __init__(self, n_instances: int, capacity: int,
+                 instance_cap: Optional[int] = None,
+                 policy: str = REJECT_NEWEST,
+                 clock=time.monotonic):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive: {capacity}")
+        if policy not in (REJECT_NEWEST, DROP_OLDEST):
+            raise ValueError(f"unknown overload policy: {policy}")
+        self.I = int(n_instances)
+        self.capacity = int(capacity)
+        # default: 2x the fair share — bursty-but-honest instances
+        # breathe, a single flooder still can't take the whole queue
+        # (for I >= 2 the cap is strictly below capacity)
+        self.instance_cap = (int(instance_cap) if instance_cap is not None
+                             else max(1, (2 * self.capacity) // self.I))
+        if self.instance_cap <= 0:
+            raise ValueError(
+                f"instance_cap must be positive: {instance_cap}")
+        self.policy = policy
+        self._clock = clock
+        # deque: a realistic frontend submits a few records per peer
+        # per call, so one micro-batch spans hundreds of chunks — a
+        # list's pop(0) would make every drain quadratic
+        self._chunks: collections.deque = collections.deque()
+        self.depth = 0
+        self._inst_counts = np.zeros(self.I, np.int64)
+        self.counters = {
+            "submitted": 0, "admitted": 0, "rejected_overflow": 0,
+            "rejected_fairness": 0, "rejected_malformed": 0,
+            "evicted": 0, "drained": 0,
+        }
+
+    @property
+    def oldest_ts(self) -> Optional[float]:
+        """Admission instant of the oldest queued record (None when
+        empty) — the micro-batcher's deadline anchor."""
+        return self._chunks[0].ts if self._chunks else None
+
+    def instance_depth(self, instance: int) -> int:
+        return int(self._inst_counts[instance])
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, wire_bytes) -> AdmitResult:
+        """Admit packed wire records (the serve plane's single entry
+        from the network).  Returns per-record counts; rejected
+        records are COUNTED and DROPPED, never queued."""
+        raw_len = len(wire_bytes)
+        n_whole = raw_len // REC_SIZE
+        malformed = 1 if raw_len % REC_SIZE else 0   # truncated tail
+        cols = unpack_wire_votes(wire_bytes)
+        inst = cols[0]
+        self.counters["submitted"] += n_whole + malformed
+        if n_whole == 0:
+            self.counters["rejected_malformed"] += malformed
+            return AdmitResult(0, 0, 0, malformed, 0)
+
+        # instance-range screen: fairness accounting needs a valid id
+        # (everything else is screened downstream by the batcher)
+        ok = (inst >= 0) & (inst < self.I)
+        malformed += int(n_whole - ok.sum())
+        keep = np.nonzero(ok)[0]
+
+        # fairness: occupancy-so-far + rank-within-this-submit < cap
+        inst_k = inst[keep]
+        occ = self._inst_counts[inst_k] + _cumcount(inst_k)
+        fair = occ < self.instance_cap
+        rejected_fairness = int(len(keep) - fair.sum())
+        keep = keep[fair]
+
+        # capacity
+        rejected_overflow = 0
+        evicted = 0
+        room = self.capacity - self.depth
+        if len(keep) > room:
+            if self.policy == REJECT_NEWEST:
+                rejected_overflow = len(keep) - max(room, 0)
+                keep = keep[:max(room, 0)]
+            else:                                     # DROP_OLDEST
+                # shed oldest queued records; if the submit alone
+                # exceeds capacity, keep its newest `capacity` records
+                if len(keep) > self.capacity:
+                    rejected_overflow = len(keep) - self.capacity
+                    keep = keep[len(keep) - self.capacity:]
+                evicted = min(self.depth,
+                              len(keep) - (self.capacity - self.depth))
+                if evicted > 0:
+                    self._pop(evicted, count_drained=False)
+                    self.counters["evicted"] += evicted
+
+        accepted = len(keep)
+        if accepted:
+            sub = tuple(c[keep] for c in cols)
+            self._chunks.append(_Chunk(sub, self._clock()))
+            self.depth += accepted
+            np.add.at(self._inst_counts, sub[0], 1)
+
+        self.counters["admitted"] += accepted
+        self.counters["rejected_overflow"] += rejected_overflow
+        self.counters["rejected_fairness"] += rejected_fairness
+        self.counters["rejected_malformed"] += malformed
+        return AdmitResult(accepted, rejected_overflow,
+                           rejected_fairness, malformed, evicted)
+
+    # -- drain ---------------------------------------------------------------
+
+    def _pop(self, n: int, count_drained: bool = True) -> List[_Chunk]:
+        """Remove the n oldest records (n <= depth), updating counts."""
+        out: List[_Chunk] = []
+        left = n
+        while left > 0:
+            c = self._chunks[0]
+            if len(c) <= left:
+                self._chunks.popleft()
+                out.append(c)
+                left -= len(c)
+            else:
+                head, tail = c.split(left)
+                self._chunks[0] = tail
+                out.append(head)
+                left = 0
+        for c in out:
+            np.subtract.at(self._inst_counts, c.cols[0], 1)
+        self.depth -= n
+        if count_drained:
+            self.counters["drained"] += n
+        return out
+
+    def drain(self, max_records: Optional[int] = None
+              ) -> Optional[WireColumns]:
+        """Pop up to `max_records` oldest records as one column batch
+        (None when empty).  FIFO across submits; a submit may split
+        across drains."""
+        if self.depth == 0:
+            return None
+        n = self.depth if max_records is None else min(self.depth,
+                                                       int(max_records))
+        chunks = self._pop(n)
+        t_first = min(c.ts for c in chunks)
+        if len(chunks) == 1:
+            cols = chunks[0].cols
+        else:
+            cols = tuple(np.concatenate([c.cols[k] for c in chunks])
+                         for k in range(7))
+        return WireColumns(*cols, t_first=t_first)

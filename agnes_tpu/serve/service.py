@@ -1,0 +1,267 @@
+"""VoteService: the streaming vote service plane's façade.
+
+The one object a network frontend talks to.  Wires the four stages —
+admission (queue.py), micro-batching (batcher.py), densify/dispatch
+(pipeline.py), decision collection — into three calls:
+
+    svc.submit(wire_bytes)   admit packed 96-byte wire records
+    svc.pump()               advance the pipeline one tick (the event
+                             loop calls this continuously; each tick
+                             dispatches at most one batch and stages
+                             the next)
+    svc.poll_decisions()     newly decided instances (collects the
+                             deferred device messages — the sync
+                             point; call at the scrape/report cadence,
+                             not per tick)
+    svc.drain()              graceful shutdown: flush the queue and
+                             the staged slot, re-enter held future-
+                             round votes once, settle everything, and
+                             return the final decision report
+
+Observability: every stage feeds a utils.metrics.Metrics registry —
+queue depth / batch fill / in-flight gauges, admission counters, and
+WINDOWED serve rates (Metrics.interval_rate — lifetime rates trend to
+zero on a long-lived service, the ISSUE-2 satellite) — and, given a
+Tracer, wraps itself in per-stage chrome-trace spans
+(serve.submit/densify/dispatch/collect).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from agnes_tpu.serve.batcher import MicroBatcher, ShapeLadder
+from agnes_tpu.serve.queue import AdmissionQueue, AdmitResult, REJECT_NEWEST
+from agnes_tpu.serve.pipeline import ServePipeline
+from agnes_tpu.utils.metrics import Metrics
+from agnes_tpu.utils.tracing import Tracer
+
+# serve-plane metric names (counters unless noted)
+SERVE_SUBMITTED = "serve_submitted"
+SERVE_ADMITTED = "serve_admitted"
+SERVE_REJECTED_OVERFLOW = "serve_rejected_overflow"
+SERVE_REJECTED_FAIRNESS = "serve_rejected_fairness"
+SERVE_REJECTED_MALFORMED = "serve_rejected_malformed"
+SERVE_EVICTED = "serve_evicted"
+SERVE_BATCHES = "serve_batches"
+SERVE_NOOP_TICKS = "serve_noop_ticks"
+SERVE_VOTES_DISPATCHED = "serve_votes_dispatched"
+SERVE_DECISIONS = "serve_decisions"
+#: gauges
+SERVE_QUEUE_DEPTH = "serve_queue_depth"
+SERVE_BATCH_FILL = "serve_batch_fill"
+SERVE_INFLIGHT = "serve_inflight"
+SERVE_E2E_LATENCY_S = "serve_e2e_latency_s"
+SERVE_ADMIT_RATE = "serve_admit_rate_per_sec_window"
+SERVE_DISPATCH_RATE = "serve_dispatch_rate_per_sec_window"
+
+
+class Decision(NamedTuple):
+    """One newly latched instance decision, decoded for the consumer
+    boundary (slot -> value id via the batcher's slot map)."""
+
+    instance: int
+    value_slot: int
+    value_id: Optional[int]
+    round: int
+
+
+class VoteService:
+    """Assembles and drives the serve plane (module docstring)."""
+
+    def __init__(self, driver, batcher,
+                 pubkeys: Optional[np.ndarray] = None, *,
+                 capacity: Optional[int] = None,
+                 instance_cap: Optional[int] = None,
+                 overload_policy: str = REJECT_NEWEST,
+                 target_votes: Optional[int] = None,
+                 max_delay_s: float = 0.005,
+                 ladder: Optional[ShapeLadder] = None,
+                 window_predictor=None,
+                 donate: bool = True,
+                 metrics: Optional[Metrics] = None,
+                 tracer: Optional[Tracer] = None,
+                 clock=time.monotonic):
+        I, V = driver.I, driver.V
+        if ladder is None:
+            ladder = ShapeLadder.plan(I, V)
+        # default queue: two full both-classes ticks — enough to
+        # absorb a burst while one tick is in flight, small enough
+        # that overload surfaces as rejects, not as unbounded memory
+        capacity = capacity if capacity is not None else 4 * I * V
+        self.queue = AdmissionQueue(I, capacity,
+                                    instance_cap=instance_cap,
+                                    policy=overload_policy, clock=clock)
+        self.micro = MicroBatcher(self.queue, ladder,
+                                  target_votes=target_votes,
+                                  max_delay_s=max_delay_s, clock=clock)
+        self.pipeline = ServePipeline(driver, batcher, pubkeys, ladder,
+                                      window_predictor=window_predictor,
+                                      donate=donate, tracer=tracer,
+                                      clock=clock)
+        self.driver = driver
+        self.batcher = batcher
+        self.metrics = metrics or Metrics()
+        self.tracer = tracer
+        self._clock = clock
+        self._reported = np.zeros(I, bool)
+        self._draining = False
+
+    # -- ingress -------------------------------------------------------------
+
+    def submit(self, wire_bytes) -> AdmitResult:
+        """Admit wire records (rejected records are counted + dropped;
+        a draining service rejects everything — fail closed)."""
+        if self._draining:
+            from agnes_tpu.bridge.native_ingest import REC_SIZE
+
+            n = len(wire_bytes) // REC_SIZE
+            tail = 1 if len(wire_bytes) % REC_SIZE else 0
+            # keep the submitted == admitted + rejected invariant on
+            # this path too (and classify the truncated tail honestly)
+            self.metrics.count(SERVE_SUBMITTED, n + tail)
+            self.metrics.count(SERVE_REJECTED_OVERFLOW, n)
+            self.metrics.count(SERVE_REJECTED_MALFORMED, tail)
+            return AdmitResult(0, n, 0, tail, 0)
+        if self.tracer is not None:
+            with self.tracer.span("serve.submit"):
+                res = self.queue.submit(wire_bytes)
+        else:
+            res = self.queue.submit(wire_bytes)
+        m = self.metrics
+        m.count(SERVE_SUBMITTED, res.accepted + res.rejected)
+        m.count(SERVE_ADMITTED, res.accepted)
+        m.count(SERVE_REJECTED_OVERFLOW, res.rejected_overflow)
+        m.count(SERVE_REJECTED_FAIRNESS, res.rejected_fairness)
+        m.count(SERVE_REJECTED_MALFORMED, res.rejected_malformed)
+        m.count(SERVE_EVICTED, res.evicted)
+        m.gauge(SERVE_QUEUE_DEPTH, self.queue.depth)
+        return res
+
+    # -- the event-loop tick -------------------------------------------------
+
+    def pump(self, now: Optional[float] = None) -> dict:
+        """One service tick: maybe close a micro-batch (size-or-
+        deadline), dispatch the staged batch, densify the closed one.
+        Never fetches from the device (collection happens in
+        poll_decisions/drain).  Returns a small status dict."""
+        batch = self.micro.poll(now)
+        n_batch = len(batch) if batch is not None else 0
+        dispatched, staged = self.pipeline.pump(batch)
+        m = self.metrics
+        if n_batch:
+            m.count(SERVE_BATCHES)
+            m.gauge(SERVE_BATCH_FILL, self.micro.fill(n_batch))
+        if dispatched:
+            m.count(SERVE_VOTES_DISPATCHED, dispatched)
+        if batch is not None and not staged:
+            m.count(SERVE_NOOP_TICKS)
+        m.gauge(SERVE_QUEUE_DEPTH, self.queue.depth)
+        m.gauge(SERVE_INFLIGHT, len(self.pipeline._inflight))
+        return {"batch_votes": n_batch, "dispatched": dispatched,
+                "staged": staged}
+
+    # -- egress --------------------------------------------------------------
+
+    def _settle(self) -> None:
+        """Collect deferred device work + update latency/rate gauges."""
+        done = self.pipeline.settle()
+        if done:
+            now = self._clock()
+            # worst case end-to-end: oldest admitted record of the
+            # settled batches to now (admission -> decision visible)
+            self.metrics.gauge(SERVE_E2E_LATENCY_S,
+                               now - min(b.t_first for b in done))
+        self.metrics.gauge(SERVE_INFLIGHT, 0)
+        self.metrics.gauge(SERVE_ADMIT_RATE,
+                           self.metrics.interval_rate(SERVE_ADMITTED))
+        self.metrics.gauge(
+            SERVE_DISPATCH_RATE,
+            self.metrics.interval_rate(SERVE_VOTES_DISPATCHED))
+
+    def poll_decisions(self) -> List[Decision]:
+        """Newly latched first-decisions since the last poll (under
+        advance_height the driver latches each instance's FIRST
+        decision; decisions_total in the drain report counts all).
+        This is the host<->device sync point."""
+        self._settle()
+        st = self.driver.stats
+        new = st.decided & ~self._reported
+        out: List[Decision] = []
+        for i in np.nonzero(new)[0]:
+            slot = int(st.decision_value[i])
+            # the driver latches each instance's FIRST decision, and
+            # sync_device rebuilt the slot map the moment that
+            # instance's height advanced — decode via the snapshot the
+            # pipeline took at that first advance (the live table is
+            # a LATER height's interning); fall through to the live
+            # table only when no advance ever happened
+            snap = self.pipeline.first_advance_decode.get(int(i))
+            if snap is not None and slot in snap:
+                value_id = snap[slot]
+            else:
+                value_id = self.batcher.decode_slot(int(i), slot)
+            out.append(Decision(
+                instance=int(i), value_slot=slot, value_id=value_id,
+                round=int(st.decision_round[i])))
+        self._reported |= new
+        if out:
+            self.metrics.count(SERVE_DECISIONS, len(out))
+        return out
+
+    # -- shutdown ------------------------------------------------------------
+
+    def drain(self) -> dict:
+        """Graceful shutdown: stop admitting, push everything queued
+        and staged through the device, re-enter held future-round
+        votes whose window has arrived (ONE device-synced pass —
+        still-future votes are reported, not spun on), settle, and
+        return the final report."""
+        self._draining = True
+        # 1. flush the admission queue through the pipeline
+        while self.queue.depth > 0:
+            self.pipeline.pump(self.micro.flush())
+        self.pipeline.pump(None)           # dispatch the last staged
+        # 2. re-enter held future-round votes against the REAL device
+        #    window (forces the sync fetch; we are shutting down),
+        #    then build + dispatch them through the pipeline's own
+        #    stages so the report/metrics/latency accounting sees them
+        self.pipeline.window_predictor = None
+        held_before = self.batcher.held_votes
+        if held_before:
+            self.driver.collect()
+            self.pipeline._sync_window()       # re-enters held votes
+            if self.pipeline.stage(None, sync=False):
+                self.pipeline.dispatch_staged()
+        # 3. settle everything and report.  Dispatches made on the
+        # drain path above went around pump()'s counting — reconcile
+        # the dispatched-votes counter against the pipeline's total so
+        # the final snapshot (and its windowed rate) is complete.
+        delta = (self.pipeline.dispatched_votes
+                 - self.metrics.counters.get(SERVE_VOTES_DISPATCHED, 0))
+        if delta > 0:
+            self.metrics.count(SERVE_VOTES_DISPATCHED, delta)
+        decisions = self.poll_decisions()
+        st = self.driver.stats
+        report = {
+            "decisions_total": st.decisions_total,
+            "decided_instances": int(st.decided.sum()),
+            "final_decisions": decisions,
+            "held_flushed": held_before - self.batcher.held_votes,
+            "held_remaining": self.batcher.held_votes,
+            "late_quorums": self.batcher.drain_host_events(),
+            "rejected_signature_device":
+                self.driver.rejected_signature_device,
+            "queue": dict(self.queue.counters),
+            "noop_ticks": self.pipeline.noop_ticks,
+            "host_fallback_builds": self.pipeline.host_fallback_builds,
+            "offladder_builds": self.pipeline.offladder_builds,
+            "dispatched_batches": self.pipeline.dispatched_batches,
+            "dispatched_votes": self.pipeline.dispatched_votes,
+            "metrics": self.metrics.snapshot(),
+            "serve_rates_window": self.metrics.interval_rates(),
+        }
+        return report

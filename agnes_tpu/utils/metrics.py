@@ -5,6 +5,17 @@ kernels yield the interesting numbers for free — votes ingested,
 thresholds crossed, decisions — and the host wraps them in a tiny
 registry with monotonic counters, gauges, and rate derivation.  Export
 is one JSON line (the bench.py / driver contract) or a plain dict.
+
+Two rate families, because they answer different questions:
+
+* `rate(name)` — lifetime average (counter / process elapsed).  Right
+  for a bench that starts, measures, exits.  WRONG for a long-running
+  service: the divisor grows forever, so a steady 1M votes/s reads as
+  0 after enough idle hours (the ISSUE-2 serve-gauge bug).
+* `interval_rate(name)` / `interval_rates()` — windowed: the delta
+  since the PREVIOUS call over the time since that call, then the
+  window resets.  This is what a scrape loop wants, and what the
+  serve plane's gauges report.
 """
 
 from __future__ import annotations
@@ -12,17 +23,24 @@ from __future__ import annotations
 import json
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 
 @dataclass
 class Metrics:
     """Process-local metric registry.  Counters are monotonic;
-    `rate(name)` derives per-second rates against the registry clock."""
+    `rate(name)` derives lifetime per-second rates against the
+    registry clock, `interval_rate(name)` windowed ones (see module
+    docstring)."""
 
     counters: Dict[str, int] = field(default_factory=dict)
     gauges: Dict[str, float] = field(default_factory=dict)
     _t0: float = field(default_factory=time.perf_counter)
+    # per-name interval windows: name -> (count at last call, t of
+    # last call); a shared window for interval_rates() lives under a
+    # key no counter can collide with
+    _win: Dict[str, Tuple[int, float]] = field(default_factory=dict)
+    _win_all: Optional[Tuple[Dict[str, int], float]] = None
 
     def count(self, name: str, delta: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + delta
@@ -34,8 +52,38 @@ class Metrics:
         return time.perf_counter() - self._t0
 
     def rate(self, name: str) -> float:
+        """Lifetime average rate — see the module docstring for when
+        this is the wrong tool."""
         dt = self.elapsed()
         return self.counters.get(name, 0) / dt if dt > 0 else 0.0
+
+    def interval_rate(self, name: str) -> float:
+        """Per-second rate of `name` over the window since the LAST
+        interval_rate(name) call (since construction on the first);
+        reading it closes the window and opens the next one.  Each
+        name keeps its own window, so independent scrapers of
+        different counters don't shorten each other's intervals."""
+        now = time.perf_counter()
+        last_c, last_t = self._win.get(name, (0, self._t0))
+        c = self.counters.get(name, 0)
+        self._win[name] = (c, now)
+        dt = now - last_t
+        return (c - last_c) / dt if dt > 0 else 0.0
+
+    def interval_rates(self) -> Dict[str, float]:
+        """One windowed snapshot of EVERY counter: `{name}_per_sec`
+        deltas since the previous interval_rates() call, sharing one
+        window (a consistent scrape line).  Does not disturb the
+        per-name interval_rate windows."""
+        now = time.perf_counter()
+        base, last_t = self._win_all or ({}, self._t0)
+        dt = now - last_t
+        out = {}
+        for name, c in self.counters.items():
+            d = c - base.get(name, 0)
+            out[f"{name}_per_sec"] = round(d / dt, 2) if dt > 0 else 0.0
+        self._win_all = (dict(self.counters), now)
+        return out
 
     def snapshot(self) -> dict:
         out = dict(self.counters)
@@ -60,13 +108,27 @@ EQUIVOCATIONS = "equivocations"
 
 def attach_to_driver(driver, metrics: Optional[Metrics] = None) -> Metrics:
     """Wrap a DeviceDriver's step() so the registry tracks the
-    north-star counters without touching the jitted path."""
+    north-star counters without touching the jitted path.
+
+    IDEMPOTENT: re-attaching used to stack a second wrapper on
+    `driver.step`, double-counting every counter from then on (the
+    ISSUE-2 satellite).  Now the wrapper is installed at most once and
+    reads its registry through `driver._agnes_metrics` at call time —
+    a re-attach with a new registry just rebinds that attribute (and
+    returns it); a bare re-attach returns the registry already in
+    place."""
     import numpy as np
 
-    m = metrics or Metrics()
+    if getattr(driver.step, "_agnes_metrics_wrapper", False):
+        if metrics is not None:
+            driver._agnes_metrics = metrics
+        return driver._agnes_metrics
+
+    driver._agnes_metrics = metrics or Metrics()
     inner = driver.step
 
     def step(ext=None, phase=None):
+        m = driver._agnes_metrics
         decided_before = int(driver.stats.decided.sum())
         votes_before = driver.stats.votes_ingested
         # tally.emitted holds the highest threshold code reached per
@@ -82,5 +144,6 @@ def attach_to_driver(driver, metrics: Optional[Metrics] = None) -> Metrics:
         m.gauge(EQUIVOCATIONS, int(driver.equivocators_detected().sum()))
         return msgs
 
+    step._agnes_metrics_wrapper = True
     driver.step = step
-    return m
+    return driver._agnes_metrics

@@ -97,6 +97,38 @@ assert rec.get("note"), rec
 print(f"deadline gate OK: -1 verdict emitted ({rec['note'][:70]}...)")
 PY
 
+echo "=== [3b/4] serve smoke gate (CPU, tiny shape ladder) ==="
+# the streaming serve plane (agnes_tpu/serve, ISSUE 2) closed-loop on
+# CPU at a tiny shape, bounded by an enclosing timeout that the bench
+# discovers (the SAME crash-safe contract as the gate above): on a box
+# fast enough to beat the fused-step compile the last stdout line is a
+# real pipeline_fused_votes_per_sec record; on a slower box the
+# self-armed alarm emits the -1 sentinel BEFORE the timeout kills us.
+# Either record passes; rc != 0 (124 = SIGKILLed without a verdict —
+# the r5 failure mode) fails.
+SERVE_DIR="$(mktemp -d)"
+SERVE_RC=0
+AGNES_BENCH_SERVE_SMOKE=1 AGNES_TPU_LEASE_PATH="$SERVE_DIR/tpu.lease" \
+  timeout -k 10 900 python bench.py > "$SERVE_DIR/serve.json" \
+  2> "$SERVE_DIR/serve.err" || SERVE_RC=$?
+if [ "$SERVE_RC" -ne 0 ]; then
+  echo "serve smoke gate FAILED: bench exited rc=$SERVE_RC"
+  tail -5 "$SERVE_DIR/serve.err"
+  exit 1
+fi
+python - "$SERVE_DIR/serve.json" <<'PY'
+import json, sys
+lines = [l for l in open(sys.argv[1]).read().strip().splitlines() if l]
+assert lines, "serve smoke printed no stdout"
+rec = json.loads(lines[-1])
+assert rec["metric"] == "pipeline_fused_votes_per_sec", rec
+assert isinstance(rec["value"], (int, float)), rec
+assert rec["value"] == -1 or rec["value"] > 0, rec
+kind = "-1 sentinel (deadline contract)" if rec["value"] == -1 \
+    else f"{rec['value']:.0f} votes/s"
+print(f"serve smoke gate OK: {kind}")
+PY
+
 echo "=== GATE SUMMARY: heavy isolated files ==="
 grep -E "test_isolated_file\[.*\] " "$HEAVY_LOG" \
   | sed -E 's/.*test_isolated_file\[(.*)\] ([A-Z]+).*/  \1: \2/' \

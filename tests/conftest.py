@@ -82,14 +82,14 @@ _CHEAP = (          # no XLA compiles (stdlib / numpy / ctypes / refs)
     "test_bench_deadline.py", "test_budget.py", "test_capi_fuzz.py",
     "test_ed25519_ref.py", "test_executor.py", "test_native_core.py",
     "test_native_ingest.py", "test_round_votes.py",
-    "test_state_machine.py", "test_tpu_holders.py",
+    "test_serve.py", "test_state_machine.py", "test_tpu_holders.py",
     "test_validators.py", "test_value_flood.py",
     "test_vote_executor.py",
 )
 _HEAVY = (          # multi-minute verify/sharded traces per test
     "test_bridge.py", "test_harness.py", "test_msm.py",
-    "test_sharded.py", "test_step.py", "test_step_seq.py",
-    "test_step_signed.py", "test_utils.py",
+    "test_serve_pipeline.py", "test_sharded.py", "test_step.py",
+    "test_step_seq.py", "test_step_signed.py", "test_utils.py",
 )
 
 

@@ -1,0 +1,376 @@
+"""Serve plane host-side stages: wire codec, admission queue policy,
+shape ladder, micro-batcher deadlines, and the DEGENERATE pipeline
+ticks (zero-vote / all-held / all-rejected) — everything here is
+numpy/stdlib + un-jitted driver construction, NO device dispatch and
+NO XLA compile (tier-1 cheap; the dispatching suite lives in
+tests/test_serve_pipeline.py, compile-heavy cases marked slow)."""
+
+import numpy as np
+import pytest
+
+from agnes_tpu.bridge import VoteBatcher
+from agnes_tpu.bridge.native_ingest import (
+    REC_SIZE,
+    pack_wire_votes,
+    unpack_wire_votes,
+)
+from agnes_tpu.serve import (
+    AdmissionQueue,
+    DROP_OLDEST,
+    MicroBatcher,
+    ShapeLadder,
+    VoteService,
+)
+from agnes_tpu.utils.budget import BudgetError, GIB
+
+
+# -- wire codec ---------------------------------------------------------------
+
+def test_wire_codec_roundtrip():
+    """unpack_wire_votes is the exact inverse of pack_wire_votes,
+    including nil normalization (any negative value -> -1)."""
+    inst = np.array([0, 3, 2], np.int64)
+    val = np.array([1, 0, 5], np.int64)
+    h = np.array([7, 7, 8], np.int64)
+    rnd = np.array([0, 2, 1], np.int64)
+    typ = np.array([0, 1, 0], np.int64)
+    value = np.array([9, -1, -5], np.int64)
+    sigs = np.arange(3 * 64, dtype=np.uint8).reshape(3, 64)
+    cols = unpack_wire_votes(pack_wire_votes(inst, val, h, rnd, typ,
+                                             value, sigs))
+    expect = (inst, val, h, rnd, typ, np.array([9, -1, -1]), sigs)
+    for a, b in zip(cols, expect):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_wire_codec_truncated_tail_dropped():
+    w = pack_wire_votes([0], [1], [0], [0], [0], [7])
+    cols = unpack_wire_votes(w + b"\x01\x02")     # 2 stray bytes
+    assert len(cols[0]) == 1
+
+
+# -- admission queue ----------------------------------------------------------
+
+def _wire(inst, value=7, height=0, round_=0, typ=0):
+    inst = np.asarray(inst, np.int64)
+    n = len(inst)
+    return pack_wire_votes(inst, np.arange(n) % 4, np.full(n, height),
+                           np.full(n, round_), np.full(n, typ),
+                           np.full(n, value))
+
+
+def test_queue_fifo_and_depth():
+    q = AdmissionQueue(4, capacity=10)
+    q.submit(_wire([0, 1]))
+    q.submit(_wire([2]))
+    assert q.depth == 3
+    b = q.drain(2)
+    np.testing.assert_array_equal(b.instance, [0, 1])
+    b = q.drain()
+    np.testing.assert_array_equal(b.instance, [2])
+    assert q.depth == 0 and q.drain() is None
+    assert q.counters["drained"] == 3
+
+
+def test_queue_reject_newest_overflow():
+    """Default overload policy: a full queue refuses the NEW records
+    (prefix of the submit fills remaining room) and counts them."""
+    q = AdmissionQueue(4, capacity=3, instance_cap=10)
+    res = q.submit(_wire([0, 1, 2, 3, 0]))
+    assert res.accepted == 3 and res.rejected_overflow == 2
+    assert q.depth == 3
+    # queue still full: everything new rejected
+    res = q.submit(_wire([1]))
+    assert res.accepted == 0 and res.rejected_overflow == 1
+    # draining opens room again
+    q.drain(2)
+    assert q.submit(_wire([1])).accepted == 1
+
+
+def test_queue_drop_oldest_overflow():
+    """drop_oldest sheds admitted work instead: freshest votes win."""
+    q = AdmissionQueue(4, capacity=3, instance_cap=10,
+                       policy=DROP_OLDEST)
+    q.submit(_wire([0, 1, 2]))
+    res = q.submit(_wire([3], value=8))
+    assert res.accepted == 1 and res.evicted == 1
+    assert q.depth == 3
+    b = q.drain()
+    np.testing.assert_array_equal(b.instance, [1, 2, 3])  # 0 evicted
+    assert q.counters["evicted"] == 1
+
+
+def test_queue_fairness_cap_contains_flooded_instance():
+    """One flooded instance may not starve the rest: its records cap
+    at instance_cap whatever the order, and other instances' records
+    still admit."""
+    q = AdmissionQueue(4, capacity=100, instance_cap=3)
+    res = q.submit(_wire([0] * 10))
+    assert res.accepted == 3 and res.rejected_fairness == 7
+    assert q.instance_depth(0) == 3
+    # instance 1 is unaffected by the flood
+    res = q.submit(_wire([1, 0, 1]))
+    assert res.accepted == 2 and res.rejected_fairness == 1
+    # draining instance-0 records frees its cap
+    q.drain(3)
+    assert q.instance_depth(0) < 3
+    assert q.submit(_wire([0])).accepted == 1
+
+
+def test_queue_fairness_within_one_submit_interleaved():
+    """The cap binds per record in arrival order, not per submit: an
+    interleaved flood admits exactly cap from the flooder."""
+    q = AdmissionQueue(2, capacity=100, instance_cap=2)
+    res = q.submit(_wire([0, 1, 0, 1, 0, 1, 0]))
+    assert res.accepted == 4           # 2 of each
+    assert res.rejected_fairness == 3  # flooder's surplus
+    b = q.drain()
+    np.testing.assert_array_equal(b.instance, [0, 1, 0, 1])
+
+
+def test_queue_malformed_screens():
+    q = AdmissionQueue(2, capacity=10)
+    # truncated tail + out-of-range instance id
+    res = q.submit(_wire([0, 5]) + b"\xff" * 7)
+    assert res.accepted == 1 and res.rejected_malformed == 2
+    assert q.counters["rejected_malformed"] == 2
+    assert q.submit(b"").accepted == 0
+
+
+def test_queue_validates_config():
+    with pytest.raises(ValueError):
+        AdmissionQueue(2, capacity=0)
+    with pytest.raises(ValueError):
+        AdmissionQueue(2, capacity=4, policy="evict_random")
+    with pytest.raises(ValueError):
+        AdmissionQueue(2, capacity=4, instance_cap=0)
+
+
+# -- shape ladder -------------------------------------------------------------
+
+def test_ladder_rungs_and_rung_for():
+    lad = ShapeLadder.plan(4, 4, min_rung=8)   # full tick = 32 lanes
+    assert lad.rungs == (8, 16, 32)
+    assert lad.rung_for(1) == 8 and lad.rung_for(9) == 16
+    assert lad.rung_for(32) == 32
+    with pytest.raises(ValueError):
+        lad.rung_for(33)
+
+
+def test_ladder_rejects_non_pow2_and_empty():
+    with pytest.raises(ValueError):
+        ShapeLadder(rungs=(8, 12))
+    with pytest.raises(ValueError):
+        ShapeLadder(rungs=())
+    with pytest.raises(ValueError):
+        ShapeLadder(rungs=(16, 8))
+
+
+def test_ladder_budget_caps_top_rung():
+    """A rung whose resident verify operands cannot fit the HBM budget
+    is dropped; a budget too small for even min_rung raises."""
+    full = ShapeLadder.plan(1024, 1024, min_rung=256,
+                            hbm_bytes=16 * GIB)
+    tiny = ShapeLadder.plan(1024, 1024, min_rung=256,
+                            hbm_bytes=GIB // 1024)  # 1 MiB
+    assert tiny.max_rung < full.max_rung
+    with pytest.raises(BudgetError):
+        ShapeLadder.plan(1024, 1024, min_rung=1 << 20,
+                         hbm_bytes=GIB // 1024)
+
+
+def test_ladder_max_votes_clamp():
+    lad = ShapeLadder.plan(1024, 1024, max_votes=1000, min_rung=64)
+    assert lad.max_rung == 1024
+
+
+# -- micro-batcher ------------------------------------------------------------
+
+def _fake_clock():
+    state = {"t": 100.0}
+
+    def clock():
+        return state["t"]
+
+    return state, clock
+
+
+def test_micro_batcher_closes_on_size():
+    state, clock = _fake_clock()
+    q = AdmissionQueue(4, capacity=100, clock=clock)
+    mb = MicroBatcher(q, ShapeLadder.plan(4, 4, min_rung=8),
+                      target_votes=4, max_delay_s=10.0, clock=clock)
+    q.submit(_wire([0, 1, 2]))
+    assert mb.poll() is None           # under target, under deadline
+    q.submit(_wire([3]))
+    b = mb.poll()
+    assert b is not None and len(b) == 4
+    assert mb.closed_by_size == 1 and mb.closed_by_deadline == 0
+
+
+def test_micro_batcher_closes_on_deadline():
+    state, clock = _fake_clock()
+    q = AdmissionQueue(4, capacity=100, clock=clock)
+    mb = MicroBatcher(q, ShapeLadder.plan(4, 4, min_rung=8),
+                      target_votes=100, max_delay_s=0.5, clock=clock)
+    q.submit(_wire([0, 1]))
+    assert mb.poll() is None
+    state["t"] += 0.6                  # oldest record's deadline passes
+    b = mb.poll()
+    assert b is not None and len(b) == 2
+    assert mb.closed_by_deadline == 1
+    # deadline anchors on the OLDEST record: a later submit does not
+    # reset it
+    q.submit(_wire([0]))
+    state["t"] += 0.1
+    assert mb.poll() is None
+    state["t"] += 0.5
+    assert mb.poll() is not None
+
+
+def test_micro_batcher_flush_ignores_policy():
+    state, clock = _fake_clock()
+    q = AdmissionQueue(4, capacity=100, clock=clock)
+    mb = MicroBatcher(q, ShapeLadder.plan(4, 4, min_rung=8),
+                      target_votes=100, max_delay_s=100.0, clock=clock)
+    q.submit(_wire([0]))
+    assert mb.poll() is None and mb.flush() is not None
+    assert mb.flush() is None          # empty
+    assert 0.0 < mb.fill(3) <= 1.0
+
+
+# -- degenerate service ticks (no dispatch, no compile) -----------------------
+
+def _service(I=2, V=4, **kw):
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    d = DeviceDriver(I, V)
+    bat = VoteBatcher(I, V, n_slots=4)
+    kw.setdefault("ladder", ShapeLadder.plan(I, V, min_rung=16))
+    kw.setdefault("capacity", 64)
+    kw.setdefault("max_delay_s", 0.0)  # close immediately when queued
+    return VoteService(d, bat, None, **kw), d, bat
+
+
+def test_service_zero_vote_tick_is_noop():
+    """An idle pump must not crash, dispatch, or trigger a compile."""
+    svc, d, _ = _service()
+    for _ in range(3):
+        out = svc.pump()
+        assert out == {"batch_votes": 0, "dispatched": 0,
+                       "staged": False}
+    assert d.stats.steps == 0
+
+
+def test_service_all_held_future_rounds_is_noop():
+    """A batch made entirely of future-round votes is held back by the
+    batcher (pre-verification window discipline) and must produce a
+    counted no-op tick — NOT an empty device step or a crash."""
+    svc, d, bat = _service()
+    n = 4
+    svc.submit(pack_wire_votes(np.zeros(n), np.arange(n), np.zeros(n),
+                               np.full(n, 50), np.zeros(n),
+                               np.full(n, 7)))
+    out = svc.pump()
+    assert out["batch_votes"] == n and not out["staged"]
+    assert bat.held_votes == n
+    assert svc.pipeline.noop_ticks == 1
+    assert d.stats.steps == 0
+    # drain: ONE device-synced re-entry pass; still-future votes are
+    # reported, never spun on, and still nothing was dispatched
+    rep = svc.drain()
+    assert rep["held_remaining"] == n and rep["held_flushed"] == 0
+    assert rep["dispatched_batches"] == 0
+    assert d.stats.steps == 0
+
+
+def test_service_all_stale_heights_is_noop():
+    """Votes for a height the instances already left densify to
+    nothing (dropped_stale_height) — a no-op tick, no dispatch."""
+    svc, d, bat = _service()
+    n = 4
+    svc.submit(pack_wire_votes(np.zeros(n), np.arange(n),
+                               np.full(n, 99), np.zeros(n),
+                               np.zeros(n), np.full(n, 7)))
+    out = svc.pump()
+    assert out["batch_votes"] == n and not out["staged"]
+    assert bat.dropped_stale_height == n
+    assert d.stats.steps == 0
+
+
+def test_service_all_rejected_admission_is_noop():
+    """A submit the queue fully rejects (flood past the fairness cap
+    of a full queue) leaves nothing to batch: pump is a zero-vote
+    tick."""
+    svc, d, _ = _service(capacity=2, instance_cap=1)
+    res = svc.submit(pack_wire_votes(
+        np.zeros(6), np.arange(6), np.zeros(6), np.zeros(6),
+        np.zeros(6), np.full(6, 7)))
+    assert res.accepted == 1           # fairness cap: one record
+    assert res.rejected == 5
+    svc.queue.drain()                  # empty it behind the service
+    out = svc.pump()
+    assert out["batch_votes"] == 0 and d.stats.steps == 0
+    snap = svc.metrics.snapshot()
+    assert snap["serve_rejected_fairness"] == 5
+
+
+def test_service_drain_on_empty_service():
+    svc, d, _ = _service()
+    rep = svc.drain()
+    assert rep["decisions_total"] == 0
+    assert rep["decided_instances"] == 0
+    assert rep["dispatched_votes"] == 0
+    assert d.stats.steps == 0
+    # a draining service fails closed — and its rejects keep the
+    # submitted == admitted + rejected counter invariant (truncated
+    # tails classified malformed, not overflow)
+    res = svc.submit(_wire([0]) + b"\x01")
+    assert res.accepted == 0 and res.rejected_overflow == 1
+    assert res.rejected_malformed == 1
+    snap = svc.metrics.snapshot()
+    assert snap["serve_submitted"] == (
+        snap.get("serve_admitted", 0) + snap["serve_rejected_overflow"]
+        + snap["serve_rejected_malformed"]
+        + snap.get("serve_rejected_fairness", 0))
+
+
+def test_service_decision_decode_survives_height_advance():
+    """sync_device rebuilds an advanced instance's slot map, and the
+    double buffer stages h+1 before h's decisions are collected — the
+    polled decision must decode against the FIRST-advance snapshot,
+    not whatever a later height interned into the same slot."""
+    svc, d, bat = _service()
+    # height 0 interns value 42 into slot 0 of instance 0
+    svc.submit(pack_wire_votes([0], [0], [0], [0], [0], [42]))
+    svc.pump()                       # densify (stages, no dispatch)
+    assert bat.decode_slot(0, 0) == 42
+    # the device plane decides slot 0 at height 0 (simulated latch:
+    # exercising the decode path without a compile-heavy dispatch)
+    d.stats.decided[0] = True
+    d.stats.decision_value[0] = 0
+    d.stats.decision_round[0] = 0
+    # window moves to height 1 BEFORE the decision is polled; a new
+    # value now claims slot 0
+    svc.pipeline.window_predictor = lambda: (np.zeros(2, np.int64),
+                                             np.array([1, 0], np.int64))
+    svc.pipeline._staged = None      # drop the stale staged build
+    svc.pipeline._sync_window()
+    bat.add_arrays([0], [1], [1], [0], [0], [99])
+    bat.build_phases()
+    assert bat.decode_slot(0, 0) == 99   # the live table moved on
+    decs = svc.poll_decisions()
+    assert len(decs) == 1 and decs[0].value_id == 42   # snapshot wins
+
+
+def test_service_gauges_and_windowed_rates():
+    """The serve gauges use WINDOWED rates (satellite: lifetime rates
+    trend to zero on a long-lived service)."""
+    svc, d, _ = _service()
+    svc.submit(_wire([0, 1]))
+    svc.pump()
+    svc.poll_decisions()
+    snap = svc.metrics.snapshot()
+    assert snap["serve_queue_depth"] == 0.0
+    assert "serve_admit_rate_per_sec_window" in snap
+    assert snap["serve_admitted"] == 2

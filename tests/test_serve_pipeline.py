@@ -1,0 +1,186 @@
+"""Serve plane THROUGH the device: bit-identity vs the offline fused
+path, burst/overload/forged-lane closed loop, and a byzantine
+equivocation flood — every test here dispatches real fused steps, so
+each distinct (P, lanes) shape costs a multi-minute XLA:CPU trace with
+the persistent cache off: ALL marked slow (tier-1 runs the host-side
+suite in tests/test_serve.py; ci.sh runs these)."""
+
+import numpy as np
+import pytest
+
+from agnes_tpu.bridge import VoteBatcher
+from agnes_tpu.bridge.native_ingest import pack_wire_votes
+from agnes_tpu.harness.device_driver import DeviceDriver
+from agnes_tpu.harness.fixtures import (
+    deterministic_seeds,
+    full_mesh_cols,
+    validator_pubkeys,
+)
+from agnes_tpu.serve import ShapeLadder, VoteService
+from agnes_tpu.types import VoteType
+
+PV, PC = int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)
+
+I, V = 3, 4
+N = I * V
+SEEDS = deterministic_seeds(V)
+PUBKEYS = validator_pubkeys(SEEDS)
+RUNG = 1 << (2 * N - 1).bit_length()        # one full tick's lanes
+
+
+def _serve_service(donate, capacity=None, heights_box=None, pubkeys=PUBKEYS,
+                   **kw):
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bat = VoteBatcher(I, V, n_slots=4)
+    predictor = None
+    if heights_box is not None:
+        predictor = lambda: (np.zeros(I, np.int64),             # noqa: E731
+                             np.full(I, heights_box["h"], np.int64))
+    svc = VoteService(
+        d, bat, pubkeys,
+        capacity=capacity if capacity is not None else 4 * 2 * N,
+        target_votes=2 * N, max_delay_s=0.0,
+        ladder=ShapeLadder.plan(I, V, min_rung=RUNG),
+        window_predictor=predictor, donate=donate)
+    return svc, d, bat
+
+
+def _wire_height(h, forge_validator=None):
+    """Both vote classes of one honest height as wire bytes."""
+    out = b""
+    for typ in (PV, PC):
+        cols = full_mesh_cols(I, V, SEEDS, h, typ, 7,
+                              forge_validator=(forge_validator
+                                               if typ == PV else None))
+        out += pack_wire_votes(*cols)
+    return out
+
+
+@pytest.mark.slow
+def test_serve_bit_identical_to_offline_fused():
+    """ISSUE 2 acceptance: decisions served through the streaming
+    plane are BIT-identical to the offline VoteBatcher ->
+    consensus_step_seq_signed path — same traffic, leaf-for-leaf equal
+    state/tally and identical decision stats.  donate=False so both
+    loops share one jit entry (one compile for the whole test; the
+    donated entry is exercised by the tests below)."""
+    heights = 3
+
+    # offline reference: the bench._pipeline_fused shape
+    dA = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bA = VoteBatcher(I, V, n_slots=4)
+    for h in range(heights):
+        bA.sync_device(np.zeros(I, np.int64), np.full(I, h, np.int64))
+        for typ in (PV, PC):
+            bA.add_arrays(*full_mesh_cols(I, V, SEEDS, h, typ, 7))
+        phases, lanes = bA.build_phases_device(PUBKEYS, phase_offset=1,
+                                               lane_floor=RUNG)
+        dA.step_seq_signed([dA.empty_phase()] + [p for p, _ in phases],
+                           lanes)
+    dA.block_until_ready()
+    assert dA.stats.decisions_total == I * heights
+
+    # streaming plane, same wire traffic height by height
+    box = {"h": 0}
+    svc, dB, bB = _serve_service(donate=False, heights_box=box)
+    for h in range(heights):
+        box["h"] = h
+        svc.submit(_wire_height(h))
+        svc.pump()                    # dispatch h-1, densify h
+    rep = svc.drain()                 # dispatch the last + settle
+
+    assert rep["decisions_total"] == I * heights
+    assert rep["rejected_signature_device"] == 0
+    for a, b in zip(dA.state, dB.state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(dA.tally, dB.tally):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(dA.stats.decision_value,
+                                  dB.stats.decision_value)
+    np.testing.assert_array_equal(dA.stats.decision_round,
+                                  dB.stats.decision_round)
+    assert bool(dB.stats.decided.all())
+
+
+@pytest.mark.slow
+def test_serve_burst_overload_forged_and_drain():
+    """The closed loop under stress, on the DONATED entry: a burst
+    twice the queue capacity is admitted up to the bound and the rest
+    rejected-newest; a forged prevote lane is rejected ON DEVICE
+    without losing the height; warmup precompiles the ladder rung the
+    traffic then reuses (cache-size assertion = the no-recompile
+    invariant); drain returns a coherent report."""
+    from agnes_tpu.device.step import consensus_step_seq_signed_donated_jit
+
+    box = {"h": 0}
+    svc, d, bat = _serve_service(donate=True, capacity=2 * N,
+                                 heights_box=box)
+    warmed = svc.pipeline.warmup(n_phases=3)
+    assert warmed == 1                 # single-rung ladder
+
+    # burst: height 0 twice — the queue holds exactly one full tick,
+    # so the second copy is rejected-newest at admission
+    wire = _wire_height(0)
+    assert svc.submit(wire).accepted == 2 * N
+    res = svc.submit(wire)
+    assert res.accepted == 0 and res.rejected_overflow == 2 * N
+    svc.pump()                         # densify h0
+    svc.pump()                         # dispatch h0
+    decisions = svc.poll_decisions()
+    assert len(decisions) == I
+    assert all(dec.value_id == 7 for dec in decisions)
+
+    # height 1 with validator 0's prevote forged: the fused verify
+    # masks I lanes on device; 3 of 4 prevotes still quorum -> decide
+    box["h"] = 1
+    svc.submit(_wire_height(1, forge_validator=0))
+    svc.pump()
+    rep = svc.drain()
+
+    assert rep["decisions_total"] == 2 * I
+    assert rep["decided_instances"] == I
+    assert rep["rejected_signature_device"] == I
+    assert rep["queue"]["rejected_overflow"] == 2 * N
+    assert rep["dispatched_batches"] == 2
+    assert rep["dispatched_votes"] == 4 * N
+    assert rep["held_remaining"] == 0
+    snap = rep["metrics"]
+    assert snap["serve_e2e_latency_s"] > 0
+    assert snap["serve_votes_dispatched"] == 4 * N
+    # warmup + two heights of traffic share ONE compiled shape
+    assert consensus_step_seq_signed_donated_jit._cache_size() == 1
+
+
+@pytest.mark.slow
+def test_serve_unsigned_equivocation_flood():
+    """A byzantine equivocation flood through the queue on an UNSIGNED
+    service: validator 0 double-votes in every instance, the batcher
+    layers the conflict (device-verify ineligible -> host build), the
+    donated plain sequence dispatches it, and the device tally flags
+    the equivocator — the serve plane survives hostile traffic without
+    a request-dependent compile shape."""
+    d = DeviceDriver(I, V)             # single height, no advance
+    bat = VoteBatcher(I, V, n_slots=4)
+    svc = VoteService(d, bat, None, capacity=8 * N, target_votes=8 * N,
+                      max_delay_s=0.0,
+                      ladder=ShapeLadder.plan(I, V, min_rung=RUNG),
+                      donate=True)
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+    # honest prevotes for 7 ... plus validator 0 re-voting 8 everywhere
+    svc.submit(pack_wire_votes(inst, val, np.zeros(n), np.zeros(n),
+                               np.full(n, PV), np.full(n, 7)))
+    svc.submit(pack_wire_votes(np.arange(I), np.zeros(I), np.zeros(I),
+                               np.zeros(I), np.full(I, PV),
+                               np.full(I, 8)))
+    out = svc.pump()                   # densify (layered, host build)
+    assert out["staged"]
+    svc.pump()                         # dispatch
+    rep = svc.drain()
+
+    assert rep["dispatched_batches"] == 1
+    assert rep["host_fallback_builds"] == 0   # unsigned: not a fallback
+    assert np.asarray(d.equivocators_detected()).sum() == I
+    ev = bat.signed_evidence(0, 0)
+    assert ev is not None and {ev[0].value, ev[1].value} == {7, 8}

@@ -121,6 +121,58 @@ def test_metrics_registry_and_driver_attach():
     assert snap[DECISIONS] == 2
 
 
+def test_metrics_interval_rates_are_windowed():
+    """ISSUE-2 satellite: lifetime rate() divides by process elapsed
+    and trends to zero on a long-lived service; interval rates measure
+    since the PREVIOUS call and must see the full delta of a fresh
+    window regardless of prior history."""
+    import time as _time
+
+    m = Metrics()
+    m.count("x", 10)
+    _time.sleep(0.02)
+    r1 = m.interval_rate("x")
+    assert r1 > 0
+    # an idle window reads ~0 even though lifetime rate stays > 0
+    _time.sleep(0.02)
+    assert m.interval_rate("x") == 0.0
+    assert m.rate("x") > 0
+    # a fresh burst is measured against ITS window, not the lifetime
+    m.count("x", 100)
+    assert m.interval_rate("x") > 0
+    # per-name windows are independent: reading x must not shorten y's
+    m.count("y", 5)
+    assert m.interval_rate("y") > 0
+    # the shared-window snapshot covers every counter at once
+    m.count("x", 3)
+    rates = m.interval_rates()
+    assert set(rates) == {"x_per_sec", "y_per_sec"}
+    assert rates["x_per_sec"] > 0
+    second = m.interval_rates()
+    assert second["x_per_sec"] == 0.0       # window consumed
+
+
+def test_metrics_attach_to_driver_is_idempotent():
+    """ISSUE-2 satellite: re-attaching used to stack wrappers on
+    driver.step and double-count every counter."""
+    d = DeviceDriver(n_instances=2, n_validators=4)
+    m1 = attach_to_driver(d)
+    step_after_first = d.step
+    m2 = attach_to_driver(d)
+    assert m2 is m1                    # bare re-attach: same registry
+    assert d.step is step_after_first  # no second wrapper stacked
+    d.run_honest_round(0)
+    assert m1.snapshot()[VOTES_INGESTED] == 2 * 2 * 4  # counted ONCE
+
+    # re-attach with a NEW registry rebinds without re-wrapping
+    fresh = Metrics()
+    m3 = attach_to_driver(d, fresh)
+    assert m3 is fresh and d.step is step_after_first
+    d.run_honest_round(1)
+    assert fresh.snapshot()[VOTES_INGESTED] == 2 * 2 * 4
+    assert m1.snapshot()[VOTES_INGESTED] == 2 * 2 * 4  # old one frozen
+
+
 def test_tracer_chrome_trace(tmp_path):
     tr = Tracer()
     with tr.span("outer"):
