@@ -402,8 +402,40 @@ class VoteBatcher:
 
     # -- densification -------------------------------------------------------
 
+    def _defer_pending(self, max_votes: Optional[int]) -> List[_Batch]:
+        """Cap the NEXT build at `max_votes` pending votes (arrival
+        order — a straddling batch splits), returning the deferred
+        tail for the caller to restore into `_pending` after the
+        build.  This is the serve plane's window-aware split: a held
+        future-round burst re-entering on the sync that rotated its
+        window in lands in `_pending` ALONGSIDE the fresh tick, and an
+        uncapped build would drain both into one lane shape above the
+        ladder's top rung — a live compile stall (the ISSUE-2
+        `offladder_builds` leak).  Capped, the burst and the tick
+        build separately, each onto a warmed rung."""
+        if max_votes is None:
+            return []
+        if int(max_votes) <= 0:
+            raise ValueError(f"max_votes must be positive: {max_votes}")
+        left = int(max_votes)
+        head: List[_Batch] = []
+        tail: List[_Batch] = []
+        for b in self._pending:
+            if left <= 0:
+                tail.append(b)
+            elif len(b) <= left:
+                head.append(b)
+                left -= len(b)
+            else:
+                head.append(b.take(np.arange(left)))
+                tail.append(b.take(np.arange(left, len(b))))
+                left = 0
+        self._pending = head
+        return tail
+
     def build_phases(self, pubkeys: Optional[np.ndarray] = None,
-                     _device_verify: bool = False
+                     _device_verify: bool = False,
+                     max_votes: Optional[int] = None
                      ) -> List[Tuple[VotePhase, int]]:
         """Drain pending votes into dense phases.
 
@@ -414,7 +446,17 @@ class VoteBatcher:
         bulk verification to the device-fused step — only the
         host-fallback subsets (past rounds, slot spill) verify here,
         because their tallies happen host-side where device verdicts
-        never arrive."""
+        never arrive.  `max_votes` caps the build at the oldest
+        `max_votes` pending votes; the rest stay pending for the next
+        build (_defer_pending — the serve plane's ladder-cap split)."""
+        if max_votes is not None:
+            tail = self._defer_pending(max_votes)
+            try:
+                return self.build_phases(pubkeys,
+                                         _device_verify=_device_verify)
+            finally:
+                if tail:
+                    self._pending.extend(tail)
         if not self._pending:
             return []
         b, self._pending = _concat(self._pending), []
@@ -645,7 +687,8 @@ class VoteBatcher:
 
     def build_phases_device(self, pubkeys: np.ndarray,
                             phase_offset: int = 0,
-                            lane_floor: int = 0):
+                            lane_floor: int = 0,
+                            max_votes: Optional[int] = None):
         """Drain pending votes into dense phases with verification
         deferred to the DEVICE: returns (phases, SignedLanes) where the
         lanes carry every emitted vote's packed Ed25519 inputs, keyed
@@ -676,8 +719,11 @@ class VoteBatcher:
         per tick.  `lane_floor` raises that padding to at least the
         given lane count (pass a serve ShapeLadder rung — itself a
         power of two — so small micro-batches all land on ONE
-        precompiled shape instead of one per log2(n))."""
-        phases, cat, pidx = self._build_device_common(pubkeys)
+        precompiled shape instead of one per log2(n)).  `max_votes`
+        caps the build (oldest first; _defer_pending) so one build can
+        never exceed a serve ladder's top rung."""
+        phases, cat, pidx = self._build_device_common(pubkeys,
+                                                      max_votes=max_votes)
         if cat is None:
             return phases, None
         phase_idx = pidx + phase_offset
@@ -701,26 +747,39 @@ class VoteBatcher:
             real=jnp.asarray(real))
         return phases, lanes
 
-    def _build_device_common(self, pubkeys: np.ndarray):
+    def _build_device_common(self, pubkeys: np.ndarray,
+                             max_votes: Optional[int] = None):
         """Shared device-verify build core: (phases, cat, phase_idx)
         with 0-based numpy phase indices, or (host-verified phases,
         None, None) on the fallback paths (ineligible traffic, MSM
-        mode, or an all-host-fallback build)."""
-        if self.verify_mode != "lanes" or not self._device_verify_eligible():
-            return self.build_phases(pubkeys), None, None
-        self._emitted_lane_groups = []
-        phases = self.build_phases(pubkeys, _device_verify=True)
-        groups, self._emitted_lane_groups = self._emitted_lane_groups, []
-        self._dv_pubkeys = None
-        if not phases:
-            return [], None, None
-        assert len(groups) == len(phases)
-        cat = _concat(groups)
-        phase_idx = np.concatenate([np.full(len(g), i, np.int64)
-                                    for i, g in enumerate(groups)])
-        return phases, cat, phase_idx
+        mode, or an all-host-fallback build).  `max_votes` defers the
+        pending tail BEFORE the eligibility gate, so eligibility is
+        judged on exactly the votes this build will drain (a capped
+        burst must not be declared ineligible by traffic that builds
+        separately after it)."""
+        tail = self._defer_pending(max_votes)
+        try:
+            if (self.verify_mode != "lanes"
+                    or not self._device_verify_eligible()):
+                return self.build_phases(pubkeys), None, None
+            self._emitted_lane_groups = []
+            phases = self.build_phases(pubkeys, _device_verify=True)
+            groups, self._emitted_lane_groups = \
+                self._emitted_lane_groups, []
+            self._dv_pubkeys = None
+            if not phases:
+                return [], None, None
+            assert len(groups) == len(phases)
+            cat = _concat(groups)
+            phase_idx = np.concatenate([np.full(len(g), i, np.int64)
+                                        for i, g in enumerate(groups)])
+            return phases, cat, phase_idx
+        finally:
+            if tail:
+                self._pending.extend(tail)
 
-    def build_phases_device_dense(self, pubkeys: np.ndarray):
+    def build_phases_device_dense(self, pubkeys: np.ndarray,
+                                  max_votes: Optional[int] = None):
         """build_phases_device in the DENSE lane layout that shards
         under shard_map (device/step.py DenseSignedPhases): returns
         (phases, DenseSignedPhases) with sig/blocks scattered to
@@ -731,7 +790,8 @@ class VoteBatcher:
         back to (host-verified phases, None) identically.  The scatter
         stays entirely in numpy (one device upload at the end — never
         a fetch of freshly uploaded lane arrays)."""
-        phases, cat, pidx = self._build_device_common(pubkeys)
+        phases, cat, pidx = self._build_device_common(pubkeys,
+                                                      max_votes=max_votes)
         if cat is None:
             return phases, None
         from agnes_tpu.device.step import DenseSignedPhases

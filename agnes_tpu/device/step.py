@@ -551,6 +551,14 @@ consensus_step_seq_signed_dense_jit = jax.jit(
     consensus_step_seq_signed_dense,
     static_argnames=("axis_name", "advance_height", "verify_chunk"))
 
+# donated twin (see consensus_step_seq_donated_jit): the serve plane's
+# dense dispatch mode — single-device here; parallel/sharded.py's
+# make_sharded_step_seq_signed(donate=True) is the mesh analogue
+consensus_step_seq_signed_dense_donated_jit = jax.jit(
+    consensus_step_seq_signed_dense,
+    static_argnames=("axis_name", "advance_height", "verify_chunk"),
+    donate_argnums=(0, 1))
+
 
 def honest_heights(state: DeviceState,
                    tally: TallyState,

@@ -21,6 +21,7 @@ Schedules express the §4(c) scenarios without a cluster:
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Optional
 
 import jax
@@ -30,12 +31,14 @@ import numpy as np
 from agnes_tpu.core.state_machine import MsgTag
 from agnes_tpu.device.encoding import I32, DeviceState
 from agnes_tpu.device.step import (
+    DenseSignedPhases,
     ExtEvent,
     NULL_EVENT,
     VotePhase,
     consensus_step_jit,
     consensus_step_seq_donated_jit,
     consensus_step_seq_jit,
+    consensus_step_seq_signed_dense_donated_jit,
     consensus_step_seq_signed_dense_jit,
     consensus_step_seq_signed_donated_jit,
     consensus_step_seq_signed_jit,
@@ -108,15 +111,26 @@ class DeviceDriver:
                 mesh, advance_height=advance_height)
             self._sharded_step_seq = make_sharded_step_seq(
                 mesh, advance_height=advance_height)
-            # keyed by verify_chunk: the chunk is a static trace
-            # parameter of the sharded signed step
+            # keyed by (verify_chunk, donate): the chunk is a static
+            # trace parameter of the sharded signed step, donation a
+            # property of the compiled executable
             self._sharded_signed_cache: dict = {}
             self._make_sharded_signed = make_sharded_step_seq_signed
+            self._make_sharded_seq = make_sharded_step_seq
             self._sharded_honest: dict = {}   # heights -> jitted fn
         self.cfg = TallyConfig(n_validators=n_validators, n_rounds=n_rounds,
                                n_slots=n_slots)
         self.state = DeviceState.new((self.I,))
         self.tally = TallyState.new(self.I, self.cfg)
+        if mesh is not None:
+            # commit per the layout table NOW: otherwise the first
+            # dispatch (uncommitted host arrays) and every later one
+            # (committed sharded outputs) key two jit cache entries
+            # for one graph — a double compile the serve warmup could
+            # never cover (parallel/sharded.place_step_state)
+            from agnes_tpu.parallel import place_step_state
+            self.state, self.tally = place_step_state(
+                mesh, self.state, self.tally)
         self.powers = jnp.ones((self.V,), I32)
         self.total = jnp.asarray(self.V, I32)
         # every instance's node proposes every round by default: the
@@ -164,13 +178,9 @@ class DeviceDriver:
     def _local_shape(self):
         """(I, V) as ONE device sees them — the shapes the chunk plan
         must bound (under shard_map the verify runs on local cells)."""
-        if self.mesh is None:
-            return self.I, self.V
-        from agnes_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS, VAL_AXIS
+        from agnes_tpu.utils.budget import mesh_local_shape
 
-        shape = dict(self.mesh.shape)
-        n_data = shape.get(DATA_AXIS, 1) * shape.get(SLICE_AXIS, 1)
-        return self.I // n_data, self.V // shape.get(VAL_AXIS, 1)
+        return mesh_local_shape(self.mesh, self.I, self.V)
 
     def _resolve_dense_chunk(self, n_phases: int):
         """Instance rows per verify microbatch for the dense signed
@@ -358,25 +368,26 @@ class DeviceDriver:
         device's execution of batch k (serve/pipeline.py's double
         buffer).
 
-        With `lanes` (SignedLanes from build_phases_device) the
-        device-fused signed step runs; without, the plain sequence
-        (host-verified or unsigned phases).  `donate` hands the
-        state/tally buffers to XLA for in-place update — the steady-
-        state serve configuration; pass False to share the jit cache
-        (and buffer semantics) with the non-donating step_seq* entries,
-        e.g. for lockstep differentials against the offline path.
+        `lanes` selects the layout: SignedLanes (packed-lane,
+        build_phases_device — single-device only) runs the fused
+        signed step; DenseSignedPhases (build_phases_device_dense)
+        runs the dense fused signed step, which is also the layout
+        that dispatches ON A MESH (make_sharded_step_seq_signed: each
+        device verifies its local cells, zero added collectives);
+        None runs the plain sequence (host-verified or unsigned
+        phases), sharded when the driver has a mesh.  `donate` hands
+        the state/tally buffers to XLA for in-place update — the
+        steady-state serve configuration; pass False to share the jit
+        cache (and buffer semantics) with the non-donating step_seq*
+        entries, e.g. for lockstep differentials against the offline
+        path.
 
         NOTE: inputs must not alias the driver's live state when
         donating — build entry phases from HOST heights (the serve
         pipeline does), not from `empty_phase()` whose height leaf IS
         `state.height`; an aliased donation degrades to a copy (jax
         warns) instead of corrupting, but the point of this entry is
-        to avoid that copy.  Single-device (packed-lane layout); mesh
-        serving is an open ROADMAP item."""
-        if self.mesh is not None:
-            raise NotImplementedError(
-                "step_async serves the single-device packed-lane "
-                "layout; on a mesh drive step_seq_signed_dense")
+        to avoid that copy."""
         phases_st, exts_st, P = self._stack_seq(phases, exts)
         state, tally = self.state, self.tally
         if donate:
@@ -387,7 +398,20 @@ class DeviceDriver:
             # driver must break those aliases (step outputs are
             # distinct buffers, so later dispatches copy nothing)
             state, tally = _dealias_buffers(state, tally)
-        if lanes is not None:
+        n_rejected = None
+        if isinstance(lanes, DenseSignedPhases):
+            fn = self._dense_dispatch_fn(int(lanes.sig.shape[0]),
+                                         donate=donate)
+            out = fn(state, tally, exts_st, phases_st, lanes)
+            n_votes = int(sum(int(np.asarray(p.mask).sum())
+                              for p in phases))
+            n_rejected = out.n_rejected
+        elif lanes is not None:
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "the packed-lane signed layout is single-device; "
+                    "on a mesh feed step_async DenseSignedPhases "
+                    "(VoteBatcher.build_phases_device_dense)")
             fn = (consensus_step_seq_signed_donated_jit if donate
                   else consensus_step_seq_signed_jit)
             out = fn(state, tally, exts_st, phases_st, lanes,
@@ -399,15 +423,19 @@ class DeviceDriver:
             n_votes = int(np.asarray(lanes.real).sum())
             n_rejected = out.n_rejected
         else:
-            fn = (consensus_step_seq_donated_jit if donate
-                  else consensus_step_seq_jit)
+            if self.mesh is not None:
+                fn = self._make_sharded_seq(
+                    self.mesh, advance_height=self.advance_height,
+                    donate=donate)
+            else:
+                fn = partial(consensus_step_seq_donated_jit if donate
+                             else consensus_step_seq_jit,
+                             advance_height=self.advance_height)
             out = fn(state, tally, exts_st, phases_st,
                      self.powers, self.total, self.proposer_flag,
-                     self.propose_value,
-                     advance_height=self.advance_height)
+                     self.propose_value)
             n_votes = int(sum(int(np.asarray(p.mask).sum())
                               for p in phases))
-            n_rejected = None
         return self._finish_step(out, P, n_votes, n_rejected,
                                  force_defer=True)
 
@@ -452,6 +480,32 @@ class DeviceDriver:
             self.rejected_signature_device += n
             self.stats.votes_ingested -= n
 
+    def _dense_dispatch_fn(self, n_dense_phases: int, donate: bool):
+        """Resolve the dense fused-signed entry for a Ps-class dense
+        batch — sharded on a mesh, jitted single-device otherwise;
+        donated or not — as f(state, tally, exts_st, phases_st, dense).
+        The serve pipeline's dense dispatch and warmup go through this
+        too, so they hit the exact executable the offline path uses."""
+        chunk = self._resolve_dense_chunk(n_dense_phases)
+        if self.mesh is not None:
+            key = (chunk, bool(donate))
+            if key not in self._sharded_signed_cache:
+                self._sharded_signed_cache[key] = \
+                    self._make_sharded_signed(
+                        self.mesh, advance_height=self.advance_height,
+                        verify_chunk=chunk, donate=donate)
+            fn = self._sharded_signed_cache[key]
+            # jit reshards the host-built arrays per the in_specs
+            return lambda st, ta, ex, ph, dn: fn(
+                st, ta, ex, ph, dn, self.powers, self.total,
+                self.proposer_flag, self.propose_value)
+        jitfn = (consensus_step_seq_signed_dense_donated_jit if donate
+                 else consensus_step_seq_signed_dense_jit)
+        return lambda st, ta, ex, ph, dn: jitfn(
+            st, ta, ex, ph, dn, self.powers, self.total,
+            self.proposer_flag, self.propose_value,
+            advance_height=self.advance_height, verify_chunk=chunk)
+
     def step_seq_signed_dense(self, phases, dense, exts=None
                               ) -> "jnp.ndarray":
         """Fused verify+step with DENSE per-cell lanes
@@ -463,25 +517,9 @@ class DeviceDriver:
         lanes).  Build both with VoteBatcher.build_phases_device_dense
         and prepend driver-side phases as needed."""
         phases_st, exts_st, P = self._stack_seq(phases, exts)
-        chunk = self._resolve_dense_chunk(int(dense.sig.shape[0]))
-        if self.mesh is not None:
-            if chunk not in self._sharded_signed_cache:
-                self._sharded_signed_cache[chunk] = \
-                    self._make_sharded_signed(
-                        self.mesh, advance_height=self.advance_height,
-                        verify_chunk=chunk)
-            # jit reshards the host-built arrays per the in_specs
-            out = self._sharded_signed_cache[chunk](
-                self.state, self.tally, exts_st, phases_st, dense,
-                self.powers, self.total, self.proposer_flag,
-                self.propose_value)
-        else:
-            out = consensus_step_seq_signed_dense_jit(
-                self.state, self.tally, exts_st, phases_st, dense,
-                self.powers, self.total, self.proposer_flag,
-                self.propose_value,
-                advance_height=self.advance_height,
-                verify_chunk=chunk)
+        fn = self._dense_dispatch_fn(int(dense.sig.shape[0]),
+                                     donate=False)
+        out = fn(self.state, self.tally, exts_st, phases_st, dense)
         return self._finish_signed(
             out, P, int(sum(int(np.asarray(p.mask).sum())
                             for p in phases)))

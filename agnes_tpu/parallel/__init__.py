@@ -28,5 +28,6 @@ from agnes_tpu.parallel.sharded import (  # noqa: F401
     make_sharded_step,
     make_sharded_step_seq,
     make_sharded_step_seq_signed,
+    place_step_state,
     shard_step_args,
 )

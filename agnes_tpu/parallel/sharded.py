@@ -59,6 +59,27 @@ from agnes_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS, VAL_AXIS
 
 _SCALAR = P()
 
+#: memoized jitted step factories: (factory name, mesh, statics) -> fn.
+#: Two DeviceDrivers over one mesh historically each built their OWN
+#: jit object for the identical shard_map'd step, so a differential
+#: (offline driver vs serve driver) paid the multi-minute XLA trace
+#: TWICE for one graph.  Mesh is hashable (axis names + device grid),
+#: so the factory result can be shared process-wide — the serve plane
+#: and the offline path then hit one compiled executable, which is
+#: also what makes their bit-identity differentials cheap to run.
+_FACTORY_CACHE: dict = {}
+
+
+def _memo(key, build):
+    try:
+        hash(key)
+    except TypeError:          # unhashable exotic mesh: just rebuild
+        return build()
+    fn = _FACTORY_CACHE.get(key)
+    if fn is None:
+        fn = _FACTORY_CACHE[key] = build()
+    return fn
+
 
 def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma):
     """shard_map across the JAX API generations this framework meets:
@@ -119,23 +140,28 @@ def _state_spec(da: Tuple[str, ...]):
 def make_sharded_step(mesh: Mesh, advance_height: bool = False):
     """A jitted consensus_step sharded over `mesh` (flat data x val or
     hierarchical slice x data x val); call with arrays already placed
-    by `shard_step_args` (or let jit reshard).
+    by `shard_step_args` (or let jit reshard).  Memoized per (mesh,
+    statics) — see _FACTORY_CACHE.
 
     check_vma=True: shard_map statically validates the replication
     claims of every output spec (VERDICT r2 weak #6); the bitwise
     sharded-vs-unsharded scenario suite in tests/test_sharded.py checks
     the values on top."""
-    da = _data_axes(mesh)
-    specs = _in_specs(da)
-    out_specs = StepOutputs(state=_state_spec(da),
-                            tally=specs[1],
-                            msgs=P(None, da))
-    fn = _shard_map(
-        partial(consensus_step, axis_name=VAL_AXIS,
-                advance_height=advance_height),
-        mesh=mesh, in_specs=specs, out_specs=out_specs,
-        check_vma=True)
-    return jax.jit(fn)
+
+    def build():
+        da = _data_axes(mesh)
+        specs = _in_specs(da)
+        out_specs = StepOutputs(state=_state_spec(da),
+                                tally=specs[1],
+                                msgs=P(None, da))
+        fn = _shard_map(
+            partial(consensus_step, axis_name=VAL_AXIS,
+                    advance_height=advance_height),
+            mesh=mesh, in_specs=specs, out_specs=out_specs,
+            check_vma=True)
+        return jax.jit(fn)
+
+    return _memo(("step", mesh, advance_height), build)
 
 
 def _prepend_none(spec_tree):
@@ -145,28 +171,42 @@ def _prepend_none(spec_tree):
                         is_leaf=lambda x: isinstance(x, P))
 
 
-def make_sharded_step_seq(mesh: Mesh, advance_height: bool = False):
+def make_sharded_step_seq(mesh: Mesh, advance_height: bool = False,
+                          donate: bool = False):
     """consensus_step_seq sharded over `mesh`: P phases in ONE sharded
     dispatch (the same fused-sequence rationale as the single-device
     path — device/step.py — with the quorum psums riding the val axis
     inside the scanned body).  exts/phases carry a leading replicated
-    sequence axis; msgs come back [P, n_stages, I] sharded on I."""
-    da = _data_axes(mesh)
-    s = _in_specs(da)
-    in_specs = (s[0], s[1], _prepend_none(s[2]), _prepend_none(s[3]),
-                s[4], s[5], s[6], s[7])
-    out_specs = StepOutputs(state=_state_spec(da), tally=s[1],
-                            msgs=P(None, None, da))
-    fn = _shard_map(
-        partial(consensus_step_seq, axis_name=VAL_AXIS,
-                advance_height=advance_height),
-        mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=True)
-    return jax.jit(fn)
+    sequence axis; msgs come back [P, n_stages, I] sharded on I.
+
+    `donate=True` is the serve plane's async twin (the mesh analogue
+    of consensus_step_seq_donated_jit): state/tally are donated so the
+    continuous dispatch loop updates them in place.  A separate jit
+    entry for the same reason as the single-device pair — donation is
+    part of the executable's buffer aliasing, and the non-donating
+    entry keeps its historical reuse semantics."""
+
+    def build():
+        da = _data_axes(mesh)
+        s = _in_specs(da)
+        in_specs = (s[0], s[1], _prepend_none(s[2]), _prepend_none(s[3]),
+                    s[4], s[5], s[6], s[7])
+        out_specs = StepOutputs(state=_state_spec(da), tally=s[1],
+                                msgs=P(None, None, da))
+        fn = _shard_map(
+            partial(consensus_step_seq, axis_name=VAL_AXIS,
+                    advance_height=advance_height),
+            mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=True)
+        return (jax.jit(fn, donate_argnums=(0, 1)) if donate
+                else jax.jit(fn))
+
+    return _memo(("step_seq", mesh, advance_height, donate), build)
 
 
 def make_sharded_step_seq_signed(mesh: Mesh, advance_height: bool = False,
-                                 verify_chunk: int | None = None):
+                                 verify_chunk: int | None = None,
+                                 donate: bool = False):
     """consensus_step_seq_signed_dense sharded over `mesh`: the FUSED
     verify+step sequence multi-chip.  The dense lane tensors shard
     like the phase masks (data x val), the pubkey table like powers
@@ -180,51 +220,89 @@ def make_sharded_step_seq_signed(mesh: Mesh, advance_height: bool = False,
     utils/budget.plan_dense_verify on the per-device shape) bounds the
     verify workspace per chunk; the chunk loop is a shard-local
     `lax.map`, so the zero-added-collectives property holds PER CHUNK
-    — nothing new crosses the mesh between tiles."""
-    da = _data_axes(mesh)
-    s = _in_specs(da)
-    dense_spec = DenseSignedPhases(
-        pub=P(VAL_AXIS),
-        sig=P(None, da, VAL_AXIS),
-        blocks=P(None, da, VAL_AXIS))
-    in_specs = (s[0], s[1], _prepend_none(s[2]), _prepend_none(s[3]),
-                dense_spec, s[4], s[5], s[6], s[7])
-    out_specs = SignedStepOutputs(state=_state_spec(da), tally=s[1],
-                                  msgs=P(None, None, da),
-                                  n_rejected=P(da))
-    # check_vma=False here (alone among the wrappers): the SHA-512
-    # compression scan inside the verify kernel carries its replicated
-    # H0 init constants into a varying loop, which the static VMA
-    # checker rejects (scan carry in/out vma mismatch) even though the
-    # computation is elementwise-local per cell.  The static guarantee
-    # is restored by the SHAPE GRID differential instead
-    # (tests/test_step_signed.py test_dense_sharded_matches_unsharded:
-    # flat + hierarchical meshes x chunked/unchunked x ragged tiles,
-    # bitwise against the single-device path — the values the static
-    # pass would have vouched for, VERDICT r5 weak #6).
-    fn = _shard_map(
-        partial(consensus_step_seq_signed_dense, axis_name=VAL_AXIS,
-                advance_height=advance_height,
-                verify_chunk=verify_chunk),
-        mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=False)
-    return jax.jit(fn)
+    — nothing new crosses the mesh between tiles.
+
+    `donate=True` is the mesh serve plane's dispatch entry (the
+    sharded analogue of consensus_step_seq_signed_dense_donated_jit):
+    the streaming pipeline's continuous dispatch updates state/tally
+    in place across chips."""
+
+    def build():
+        da = _data_axes(mesh)
+        s = _in_specs(da)
+        dense_spec = DenseSignedPhases(
+            pub=P(VAL_AXIS),
+            sig=P(None, da, VAL_AXIS),
+            blocks=P(None, da, VAL_AXIS))
+        in_specs = (s[0], s[1], _prepend_none(s[2]), _prepend_none(s[3]),
+                    dense_spec, s[4], s[5], s[6], s[7])
+        out_specs = SignedStepOutputs(state=_state_spec(da), tally=s[1],
+                                      msgs=P(None, None, da),
+                                      n_rejected=P(da))
+        # check_vma=False here (alone among the wrappers): the SHA-512
+        # compression scan inside the verify kernel carries its
+        # replicated H0 init constants into a varying loop, which the
+        # static VMA checker rejects (scan carry in/out vma mismatch)
+        # even though the computation is elementwise-local per cell.
+        # The static guarantee is restored by the SHAPE GRID
+        # differential instead (tests/test_step_signed.py
+        # test_dense_sharded_matches_unsharded: flat + hierarchical
+        # meshes x chunked/unchunked x ragged tiles, bitwise against
+        # the single-device path — the values the static pass would
+        # have vouched for, VERDICT r5 weak #6).
+        fn = _shard_map(
+            partial(consensus_step_seq_signed_dense, axis_name=VAL_AXIS,
+                    advance_height=advance_height,
+                    verify_chunk=verify_chunk),
+            mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False)
+        return (jax.jit(fn, donate_argnums=(0, 1)) if donate
+                else jax.jit(fn))
+
+    return _memo(("seq_signed", mesh, advance_height, verify_chunk,
+                  donate), build)
 
 
 def make_sharded_honest_heights(mesh: Mesh, heights: int):
     """honest_heights sharded over `mesh`: H full honest heights in ONE
     sharded dispatch; msgs come back [H, 3, n_stages, I] sharded on I."""
+
+    def build():
+        da = _data_axes(mesh)
+        s = _in_specs(da)
+        iv = P(da, VAL_AXIS)
+        in_specs = (s[0], s[1], iv, iv, s[4], s[5], s[6], s[7])
+        out_specs = StepOutputs(state=_state_spec(da), tally=s[1],
+                                msgs=P(None, None, None, da))
+        fn = _shard_map(
+            partial(honest_heights, heights=heights, axis_name=VAL_AXIS),
+            mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=True)
+        return jax.jit(fn)
+
+    return _memo(("honest_heights", mesh, heights), build)
+
+
+def place_step_state(mesh: Mesh, state, tally):
+    """Commit state/tally onto `mesh` per the layout table.  The jit
+    cache keys on input shardings: a driver whose FIRST dispatch
+    passes fresh uncommitted host arrays and whose later dispatches
+    pass the committed sharded outputs compiles the SAME graph twice
+    (minutes each with the persistent cache off) — and the serve
+    plane's warmup would only ever warm the uncommitted variant, so
+    the second real batch of a service would stall on a live compile.
+    Committing at driver construction pins one sharding for the whole
+    lifetime: one compile, warmup that actually covers the steady
+    state, and donation that is in-place from the first call."""
     da = _data_axes(mesh)
-    s = _in_specs(da)
-    iv = P(da, VAL_AXIS)
-    in_specs = (s[0], s[1], iv, iv, s[4], s[5], s[6], s[7])
-    out_specs = StepOutputs(state=_state_spec(da), tally=s[1],
-                            msgs=P(None, None, None, da))
-    fn = _shard_map(
-        partial(honest_heights, heights=heights, axis_name=VAL_AXIS),
-        mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=True)
-    return jax.jit(fn)
+    specs = _in_specs(da)
+
+    def place(tree, spec):
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            tree, spec, is_leaf=lambda x: x is None)
+
+    return place(state, specs[0]), place(tally, specs[1])
 
 
 def shard_step_args(mesh: Mesh, state, tally, ext, phase, powers,

@@ -23,8 +23,14 @@ actually runs:
                 queue-depth / batch-fill / latency gauges) and
                 utils.tracing spans
 
-Single-device (packed-lane fused path).  Mesh serving — sharding the
-admission plane with the dense lane layout — is a ROADMAP item.
+Dispatch is layout-polymorphic (ISSUE 3 tentpole): single-device
+drivers run the packed-lane fused path; drivers built on a MESH
+densify through `VoteBatcher.build_phases_device_dense` and dispatch
+the shard_map-sharded dense fused signed step (donated buffers, zero
+added collectives — parallel/sharded.py).  threaded.py adds the host
+event loop above VoteService: a submit thread draining a socket-shaped
+Inbox into admission while a dispatch thread pumps ticks, with submit
+wait-free relative to in-flight XLA dispatch.
 """
 
 from agnes_tpu.serve.batcher import MicroBatcher, ShapeLadder  # noqa: F401
@@ -33,7 +39,12 @@ from agnes_tpu.serve.queue import (  # noqa: F401
     AdmissionQueue,
     AdmitResult,
     DROP_OLDEST,
+    Inbox,
     REJECT_NEWEST,
     WireColumns,
 )
 from agnes_tpu.serve.service import Decision, VoteService  # noqa: F401
+from agnes_tpu.serve.threaded import (  # noqa: F401
+    ThreadedVoteService,
+    threaded_service,
+)

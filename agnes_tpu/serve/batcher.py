@@ -35,7 +35,11 @@ import time
 from typing import Optional, Tuple
 
 from agnes_tpu.serve.queue import AdmissionQueue, WireColumns
-from agnes_tpu.utils.budget import BudgetError, plan_lane_verify
+from agnes_tpu.utils.budget import (
+    BudgetError,
+    plan_dense_verify,
+    plan_lane_verify,
+)
 
 
 def _ceil_pow2(n: int) -> int:
@@ -104,6 +108,38 @@ class ShapeLadder:
             raise BudgetError(
                 f"no ladder rung >= {min_rung} fits the HBM budget "
                 f"(shape {n_instances}x{n_validators})")
+        return cls(rungs=tuple(rungs))
+
+    @classmethod
+    def plan_dense(cls, n_instances: int, n_validators: int,
+                   local_shape: Optional[Tuple[int, int]] = None,
+                   n_classes: int = 2,
+                   max_votes: Optional[int] = None, min_rung: int = 256,
+                   hbm_bytes: Optional[int] = None) -> "ShapeLadder":
+        """Ladder for the DENSE dispatch mode (mesh serving): the
+        dense fused signed step's compile key is (P, I, V) — fixed by
+        the deployment, NOT by the batch size — so rungs here only
+        pace how many votes each micro-batch carries (host densify
+        cost and latency), never which shapes compile.  What the
+        budget must validate instead is the deployment itself: the
+        dense verify of `n_classes` signed vote classes over the
+        PER-DEVICE `local_shape` (utils/budget.mesh_local_shape) has
+        to fit the per-device HBM slice at least chunked —
+        plan_dense_verify raises BudgetError when it cannot, failing
+        the service at plan time rather than live at first dispatch."""
+        li, lv = (local_shape if local_shape is not None
+                  else (n_instances, n_validators))
+        plan_dense_verify(n_classes, li, lv, hbm_bytes=hbm_bytes)
+        top_want = 2 * n_instances * n_validators
+        if max_votes is not None:
+            top_want = min(top_want, int(max_votes))
+        min_rung = _ceil_pow2(min_rung)
+        top = max(_ceil_pow2(top_want), min_rung)
+        rungs = []
+        r = min_rung
+        while r <= top:
+            rungs.append(r)
+            r <<= 1
         return cls(rungs=tuple(rungs))
 
     def describe(self) -> str:

@@ -3,9 +3,11 @@
 The stage that keeps the chip busy: while the device executes the
 async fused signed step on batch k (DeviceDriver.step_async — deferred
 collection, donated state/tally buffers), the host densifies batch
-k+1 (VoteBatcher.add_arrays -> build_phases_device: the EXISTING
-offline densify stage, reused verbatim so streaming and offline builds
-cannot diverge).  One staged slot is the whole buffer discipline:
+k+1 (VoteBatcher.add_arrays -> build_phases_device — or the dense
+builder on a mesh: the EXISTING offline densify stages, reused
+verbatim so streaming and offline builds cannot diverge).  One staged
+slot — a FIFO when a tick's window-aware split stages several capped
+builds (class docstring) — is the whole buffer discipline:
 
     pump(batch):
       1. DISPATCH the staged (already densified) batch     [device]
@@ -75,12 +77,39 @@ class _Inflight:
 
 
 class ServePipeline:
-    """Densify + dispatch with one staged slot (module docstring)."""
+    """Densify + dispatch with one staged slot (module docstring).
+
+    Two dispatch modes, chosen by the driver:
+
+    * **packed-lane** (single-device): `build_phases_device` packs the
+      emitted votes into SignedLanes padded onto a ladder rung — the
+      compile key includes the lane count, so the ladder IS the shape
+      discipline.
+    * **dense** (driver has a mesh; forceable via `dense=True`):
+      `build_phases_device_dense` scatters the Ed25519 inputs to
+      [Ps, I, V] DenseSignedPhases, the layout that shards under
+      shard_map — `step_async` dispatches the sharded fused signed
+      step with donated buffers, each device verifying its local
+      cells.  The compile key is (P, I, V) — fixed by the deployment —
+      so the ladder's rungs only pace votes per micro-batch
+      (ShapeLadder.plan_dense validates the per-device budget).
+
+    Builds are CAPPED at the ladder's top rung and held-vote re-entry
+    builds separately from the fresh batch (window-aware split): a
+    held future-round burst entering the window in the same tick as a
+    full batch used to drain into ONE build above the top rung — a
+    pow2 but UNWARMED lane shape, i.e. a live multi-minute compile
+    stall counted in `offladder_builds`.  Since the split, every build
+    lands on a warmed rung and the counter is a regression alarm, not
+    an accepted cost.  A tick can therefore stage SEVERAL builds; the
+    staged slot is a FIFO and `dispatch_staged` queues them all
+    back-to-back (async dispatch — the device never waits)."""
 
     def __init__(self, driver, batcher, pubkeys: Optional[np.ndarray],
                  ladder: ShapeLadder,
                  window_predictor: Optional[Callable] = None,
                  donate: bool = True,
+                 dense: Optional[bool] = None,
                  tracer: Optional[Tracer] = None,
                  clock=time.monotonic):
         self.driver = driver
@@ -89,9 +118,11 @@ class ServePipeline:
         self.ladder = ladder
         self.window_predictor = window_predictor
         self.donate = donate
+        self.dense = (dense if dense is not None
+                      else getattr(driver, "mesh", None) is not None)
         self.tracer = tracer
         self._clock = clock
-        self._staged: Optional[_StagedBatch] = None
+        self._staged: List[_StagedBatch] = []
         self._inflight: List[_Inflight] = []
         self._entry_h: Optional[np.ndarray] = None
         # slot->value decode captured at each instance's FIRST height
@@ -106,12 +137,14 @@ class ServePipeline:
         self.dispatched_votes = 0
         self.noop_ticks = 0
         self.host_fallback_builds = 0
-        # lane shapes above the ladder's top rung: possible when a
-        # held future-round burst enters the window in the same round
-        # as a full new batch (one build drains both).  Still a power
-        # of two — log-bounded, never request-granular — but NOT
-        # warmed, so each costs a live compile stall: watch this
-        # counter in production (ROADMAP: window-aware splitting)
+        # lane shapes above the ladder's top rung.  Historically: a
+        # held future-round burst entering the window in the same
+        # round as a full new batch drained into one build — a pow2
+        # but UNWARMED shape, i.e. a live compile stall.  The
+        # window-aware split (stage/_build_all: held re-entry builds
+        # separately, every build capped at max_rung votes) PREVENTS
+        # this; the counter stays as the regression alarm (tests
+        # assert it is 0)
         self.offladder_builds = 0
 
     def _span(self, name: str):
@@ -158,40 +191,79 @@ class ServePipeline:
 
     def stage(self, batch: Optional[WireColumns],
               sync: bool = True) -> bool:
-        """Densify `batch` into the staged slot (host work — overlaps
-        the in-flight device step).  Returns True when something was
-        staged; a batch that densifies to nothing (all held / stale /
-        rejected) is a counted no-op.  With batch None, whatever the
-        batcher already holds pending is built instead (the drain
-        path's held-vote re-entry; `sync=False` when the caller just
-        synced) — a no-batch no-pending call is a plain idle tick."""
+        """Densify into the staged FIFO (host work — overlaps the
+        in-flight device step).  Returns True when something was
+        staged; a tick whose traffic densifies to nothing (all held /
+        stale / rejected) is a counted no-op.  With batch None,
+        whatever the batcher already holds pending is built instead
+        (the drain path's held-vote re-entry; `sync=False` when the
+        caller just synced) — a no-batch no-pending call is a plain
+        idle tick.
+
+        Window-aware split (class docstring): held votes that
+        re-entered on this tick's sync — anything already pending —
+        build BEFORE the fresh batch is even added, and every build is
+        capped at the ladder's top rung, so no single build can ever
+        exceed a warmed shape."""
         n_new = len(batch) if batch is not None else 0
         if n_new == 0 and self.batcher.pending_votes == 0:
             return False
-        assert self._staged is None, "staged slot occupied (pump first)"
         with self._span("serve.densify"):
             hts = (self._sync_window() if sync
                    else self.batcher.heights.copy())
+            staged_any = False
+            if self.batcher.pending_votes:
+                staged_any |= self._build_all(hts, self._clock())
             if n_new:
                 self.batcher.add_arrays(batch.instance, batch.validator,
                                         batch.height, batch.round_,
                                         batch.typ, batch.value,
                                         batch.signatures)
-            if self.pubkeys is not None:
+                staged_any |= self._build_all(hts, batch.t_first)
+        if not staged_any:
+            self.noop_ticks += 1
+        return staged_any
+
+    def _build_all(self, hts: np.ndarray, t_first: float) -> bool:
+        """Drain everything pending into staged builds, at most
+        `ladder.max_rung` votes per build (each build consumes its cap
+        from the pending queue, so the loop strictly progresses even
+        when a build densifies to nothing — held/stale votes leave
+        `pending` too)."""
+        staged = False
+        while self.batcher.pending_votes > 0:
+            before = self.batcher.pending_votes
+            staged |= self._build_one(hts, t_first)
+            if self.batcher.pending_votes >= before:  # defensive: a
+                break          # non-draining build must not spin
+        return staged
+
+    def _build_one(self, hts: np.ndarray, t_first: float) -> bool:
+        """One capped build -> staged FIFO entry (False = densified to
+        nothing)."""
+        cap = self.ladder.max_rung
+        if self.pubkeys is not None:
+            if self.dense:
+                phases, lanes = self.batcher.build_phases_device_dense(
+                    self.pubkeys, max_votes=cap)
+            else:
                 phases, lanes = self.batcher.build_phases_device(
                     self.pubkeys, phase_offset=1,
-                    lane_floor=self.ladder.min_rung)
-            else:
-                phases, lanes = self.batcher.build_phases(), None
-            if self.pubkeys is not None and lanes is None and phases:
-                # ineligible traffic (equivocation layers, mixed
-                # rounds, MSM mode): the batcher host-verified instead
-                self.host_fallback_builds += 1
-            if lanes is not None and \
-                    int(lanes.pub.shape[0]) > self.ladder.max_rung:
-                self.offladder_builds += 1
+                    lane_floor=self.ladder.min_rung, max_votes=cap)
+        else:
+            phases, lanes = self.batcher.build_phases(max_votes=cap), \
+                None
+        if self.pubkeys is not None and lanes is None and phases:
+            # ineligible traffic (equivocation layers, mixed
+            # rounds, MSM mode): the batcher host-verified instead
+            self.host_fallback_builds += 1
+        if (not self.dense and lanes is not None
+                and int(lanes.pub.shape[0]) > self.ladder.max_rung):
+            # unreachable since the max_votes cap (lanes <= votes and
+            # the cap is itself a pow2 rung) — kept as the production
+            # regression alarm the ISSUE-2 ROADMAP item promised
+            self.offladder_builds += 1
         if not phases:
-            self.noop_ticks += 1
             return False
         # Entry policy: signed builds ALWAYS prepend the empty entry
         # phase (their lanes were packed with phase_offset=1, and the
@@ -201,37 +273,48 @@ class ServePipeline:
         # last entry dispatched (or on the first dispatch).  An extra
         # empty step on an instance mid-round is a state-machine no-op
         # (the driver's canned scenarios rely on the same property).
-        entry = (lanes is not None or self._entry_h is None
+        entry = (lanes is not None or self.dense or self._entry_h is None
                  or bool((hts > self._entry_h).any()))
         if entry:
             self._entry_h = hts.copy()
         n_votes = sum(n for _, n in phases)
-        self._staged = _StagedBatch(
+        self._staged.append(_StagedBatch(
             phases=[p for p, _ in phases], lanes=lanes, entry=entry,
             entry_heights=hts if entry else None,
-            n_votes=n_votes,
-            t_first=batch.t_first if batch is not None
-            else self._clock())
+            n_votes=n_votes, t_first=t_first))
         return True
 
     def dispatch_staged(self) -> int:
-        """Queue the staged batch's fused step on the device (async;
-        never fetches).  Returns the votes dispatched (0 = no-op)."""
-        st, self._staged = self._staged, None
-        if st is None:
-            return 0
-        with self._span("serve.dispatch"):
-            phases = st.phases
-            if st.entry:
-                phases = [self._entry_phase(st.entry_heights)] + phases
-            self.driver.step_async(phases, st.lanes,
-                                   donate=self.donate)
-        self._inflight.append(_Inflight(
-            t_first=st.t_first, n_votes=st.n_votes,
-            t_dispatch=self._clock()))
-        self.dispatched_batches += 1
-        self.dispatched_votes += st.n_votes
-        return st.n_votes
+        """Queue every staged build's fused step on the device (async;
+        never fetches; back-to-back queueing — the split builds of one
+        tick ride consecutive dispatches).  Returns the votes
+        dispatched (0 = no-op).  If a dispatch RAISES (transient XLA
+        error), the failing build and everything after it go back on
+        the staged FIFO before the exception propagates — a caller
+        that catches and retries loses no staged vote (the
+        admitted == dispatched + counted-drops conservation the tests
+        assert)."""
+        staged, self._staged = self._staged, []
+        total = 0
+        for k, st in enumerate(staged):
+            try:
+                with self._span("serve.dispatch"):
+                    phases = st.phases
+                    if st.entry:
+                        phases = [self._entry_phase(st.entry_heights)] \
+                            + phases
+                    self.driver.step_async(phases, st.lanes,
+                                           donate=self.donate)
+            except BaseException:
+                self._staged = staged[k:] + self._staged
+                raise
+            self._inflight.append(_Inflight(
+                t_first=st.t_first, n_votes=st.n_votes,
+                t_dispatch=self._clock()))
+            self.dispatched_batches += 1
+            self.dispatched_votes += st.n_votes
+            total += st.n_votes
+        return total
 
     def pump(self, batch: Optional[WireColumns]) -> Tuple[int, bool]:
         """One pipeline tick: dispatch what was staged, then densify
@@ -253,18 +336,21 @@ class ServePipeline:
         return done
 
     def warmup(self, n_phases=(2, 3)) -> int:
-        """Precompile every (phase count, ladder rung) fused-step
-        shape so the first real batch of each is not a minutes-long
-        trace stall mid-service.  Runs the EXACT runtime entry
-        (donated or not, same dtypes, same verify-chunk resolution) on
-        all-padding synthetic lanes against throwaway COPIES of the
-        driver state — outputs are discarded, so the live state/tally
-        are untouched even under donation.  `n_phases` is the step-
-        sequence length(s) to warm: signed builds always prepend the
-        entry phase, so the honest shapes are P=3 (entry + both vote
-        classes, size-closed batches) AND P=2 (entry + ONE class — a
-        deadline-closed batch that caught only the round's prevotes),
-        hence the (2, 3) default.  Returns shapes warmed.  Signed
+        """Precompile every fused-step shape the steady state will
+        dispatch, so the first real batch of each is not a minutes-
+        long trace stall mid-service.  Runs the EXACT runtime entry
+        (donated or not, mesh-sharded or not, same dtypes, same
+        verify-chunk resolution) on all-padding synthetic lanes
+        against throwaway COPIES of the driver state — outputs are
+        discarded, so the live state/tally are untouched even under
+        donation.  `n_phases` is the step-sequence length(s) to warm:
+        signed builds always prepend the entry phase, so the honest
+        shapes are P=3 (entry + both vote classes, size-closed
+        batches) AND P=2 (entry + ONE class — a deadline-closed batch
+        that caught only the round's prevotes), hence the (2, 3)
+        default.  Packed-lane mode warms one shape per (P, ladder
+        rung); dense mode warms one per P — the dense compile key is
+        (P, I, V), rung-independent.  Returns shapes warmed.  Signed
         deployments only (unsigned phase sequences have data-dependent
         layer counts)."""
         if self.pubkeys is None:
@@ -272,6 +358,7 @@ class ServePipeline:
         import jax
 
         from agnes_tpu.device.step import (
+            DenseSignedPhases,
             SignedLanes,
             consensus_step_seq_signed_donated_jit,
             consensus_step_seq_signed_jit,
@@ -280,8 +367,6 @@ class ServePipeline:
         if isinstance(n_phases, int):
             n_phases = (n_phases,)
         d = self.driver
-        fn = (consensus_step_seq_signed_donated_jit if self.donate
-              else consensus_step_seq_signed_jit)
         zero_hts = np.zeros(d.I, np.int64)
         warmed = 0
         for P in n_phases:
@@ -289,6 +374,21 @@ class ServePipeline:
             exts = [d.ext()] * P
             phases_st = jax.tree.map(lambda *xs: jnp.stack(xs), *phases)
             exts_st = jax.tree.map(lambda *xs: jnp.stack(xs), *exts)
+            if self.dense:
+                Ps = max(P - 1, 1)           # entry carries no lanes
+                dense = DenseSignedPhases(
+                    pub=jnp.zeros((d.V, 32), jnp.int32),
+                    sig=jnp.zeros((Ps, d.I, d.V, 64), jnp.int32),
+                    blocks=jnp.zeros((Ps, d.I, d.V, 1, 32), jnp.uint32))
+                fn = d._dense_dispatch_fn(Ps, donate=self.donate)
+                state_c = jax.tree.map(lambda x: x.copy(), d.state)
+                tally_c = jax.tree.map(lambda x: x.copy(), d.tally)
+                out = fn(state_c, tally_c, exts_st, phases_st, dense)
+                jax.block_until_ready(out.state)
+                warmed += 1
+                continue
+            fn = (consensus_step_seq_signed_donated_jit if self.donate
+                  else consensus_step_seq_signed_jit)
             for r in self.ladder.rungs:
                 lanes = SignedLanes(
                     pub=jnp.zeros((r, 32), jnp.int32),

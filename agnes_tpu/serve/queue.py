@@ -32,6 +32,7 @@ Pure numpy + stdlib; no jax anywhere on the admission path.
 from __future__ import annotations
 
 import collections
+import threading
 import time
 from dataclasses import dataclass
 from typing import List, NamedTuple, Optional
@@ -104,6 +105,72 @@ def _cumcount(x: np.ndarray) -> np.ndarray:
     out = np.empty(n, np.int64)
     out[order] = np.arange(n) - starts
     return out
+
+
+class Inbox:
+    """Socket-shaped thread-safe blob inbox for the threaded host
+    (serve/threaded.py): network threads `put` raw wire-bytes blobs,
+    the submit thread `get`s them and feeds the AdmissionQueue.
+
+    This is the ONLY structure the caller-facing `submit` touches in
+    the threaded host, and it shares no lock with anything device-
+    side — a put is a bounded-deque append under a private mutex held
+    for nanoseconds, so producers stay wait-free relative to in-flight
+    XLA dispatch no matter what the pipeline is doing.  Bounded and
+    fail-closed like the AdmissionQueue itself (a full inbox refuses
+    the blob and counts it; unauthenticated bytes must never buffer
+    unboundedly), but in BLOBS, not records: real record accounting —
+    parse, fairness, overload policy — stays with AdmissionQueue,
+    where it already exists."""
+
+    def __init__(self, capacity: int = 1024):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive: {capacity}")
+        self.capacity = int(capacity)
+        self._q: collections.deque = collections.deque()
+        self._mu = threading.Lock()
+        self._not_empty = threading.Condition(self._mu)
+        self.closed = False
+        self.enqueued = 0
+        self.dropped = 0
+
+    @property
+    def depth(self) -> int:
+        return len(self._q)          # len(deque) is atomic
+
+    def put(self, blob) -> bool:
+        """Enqueue a wire blob; False (and counted) when full or
+        closed."""
+        with self._mu:
+            if self.closed or len(self._q) >= self.capacity:
+                self.dropped += 1
+                return False
+            self._q.append(blob)
+            self.enqueued += 1
+            self._not_empty.notify()
+        return True
+
+    def close(self) -> None:
+        """Atomically stop accepting blobs: every `put` that returned
+        True happened-before this call and its blob is still in the
+        deque (drainable); every later `put` returns False.  This is
+        what lets the threaded host's drain close the submit/stop
+        race loss-free — a stop FLAG checked outside the inbox mutex
+        cannot order a racing put against the final flush."""
+        with self._mu:
+            self.closed = True
+            self._not_empty.notify_all()
+
+    def get(self, timeout: Optional[float] = None):
+        """Dequeue the oldest blob, waiting up to `timeout` seconds
+        (None = block until a blob arrives or the inbox closes);
+        returns None on timeout/empty-after-close.  `wait_for`
+        absorbs spurious condition wakeups, so the block-forever
+        contract of timeout=None actually holds."""
+        with self._not_empty:
+            self._not_empty.wait_for(lambda: self._q or self.closed,
+                                     timeout)
+            return self._q.popleft() if self._q else None
 
 
 class AdmissionQueue:

@@ -57,6 +57,14 @@ SERVE_INFLIGHT = "serve_inflight"
 SERVE_E2E_LATENCY_S = "serve_e2e_latency_s"
 SERVE_ADMIT_RATE = "serve_admit_rate_per_sec_window"
 SERVE_DISPATCH_RATE = "serve_dispatch_rate_per_sec_window"
+#: threaded-host gauges (serve/threaded.py): per-thread depth and
+#: utilization — the inbox depth the submit thread drains, and each
+#: loop's busy fraction over its last gauge window
+SERVE_INBOX_DEPTH = "serve_inbox_depth"
+SERVE_INBOX_DROPPED = "serve_inbox_dropped"          # counter
+SERVE_THREAD_FAILURES = "serve_thread_failures"      # counter
+SERVE_SUBMIT_BUSY_FRAC = "serve_submit_busy_frac"
+SERVE_DISPATCH_BUSY_FRAC = "serve_dispatch_busy_frac"
 
 
 class Decision(NamedTuple):
@@ -87,7 +95,14 @@ class VoteService:
                  clock=time.monotonic):
         I, V = driver.I, driver.V
         if ladder is None:
-            ladder = ShapeLadder.plan(I, V)
+            if getattr(driver, "mesh", None) is not None:
+                # dense dispatch mode: the compile shape is fixed by
+                # the deployment; plan the budget against the
+                # PER-DEVICE slice (tentpole: mesh serving)
+                ladder = ShapeLadder.plan_dense(
+                    I, V, local_shape=driver._local_shape())
+            else:
+                ladder = ShapeLadder.plan(I, V)
         # default queue: two full both-classes ticks — enough to
         # absorb a burst while one tick is in flight, small enough
         # that overload surfaces as rejects, not as unbounded memory
@@ -147,8 +162,23 @@ class VoteService:
         """One service tick: maybe close a micro-batch (size-or-
         deadline), dispatch the staged batch, densify the closed one.
         Never fetches from the device (collection happens in
-        poll_decisions/drain).  Returns a small status dict."""
-        batch = self.micro.poll(now)
+        poll_decisions/drain).  Returns a small status dict.
+
+        Split into `_close_batch` (admission/micro-batcher state — the
+        part a threaded host guards with its admission lock) and
+        `_pump_batch` (pipeline + device dispatch — guarded by the
+        device lock), so ThreadedVoteService can hold the admission
+        lock ONLY across the microseconds-of-numpy close, never across
+        an XLA dispatch: that is what keeps `submit` wait-free
+        relative to in-flight device work (serve/threaded.py)."""
+        return self._pump_batch(self._close_batch(now))
+
+    def _close_batch(self, now: Optional[float] = None):
+        """Size-or-deadline micro-batch close (admission side only)."""
+        return self.micro.poll(now)
+
+    def _pump_batch(self, batch) -> dict:
+        """Pipeline half of a tick: dispatch staged, densify `batch`."""
         n_batch = len(batch) if batch is not None else 0
         dispatched, staged = self.pipeline.pump(batch)
         m = self.metrics

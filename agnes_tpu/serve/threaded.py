@@ -1,0 +1,253 @@
+"""ThreadedVoteService: the host event loop above VoteService.
+
+VoteService is single-threaded by contract: one caller alternates
+submit / pump / poll.  A real frontend cannot — bytes arrive on socket
+threads while the dispatch loop must keep the chip fed.  This module
+is that layer: two daemon threads over one VoteService,
+
+    submit thread    drains a socket-shaped Inbox (serve/queue.py)
+                     into the bounded AdmissionQueue
+    dispatch thread  pumps service ticks continuously: closes
+                     micro-batches, densifies, queues fused device
+                     dispatches
+
+with a two-lock discipline chosen so the caller-facing `submit` is
+WAIT-FREE relative to in-flight XLA dispatch:
+
+* ``_admission`` guards the AdmissionQueue + MicroBatcher state.  It
+  is held across `queue.submit` (submit thread) and `micro.poll`
+  (dispatch thread) — both microseconds of numpy — and NEVER across a
+  device dispatch.
+* ``_device`` guards the pipeline + driver (densify, dispatch,
+  collection).  Only the dispatch thread and the caller's
+  poll_decisions/drain take it; the submit thread never does.
+
+`submit()` itself takes NEITHER lock — it appends to the Inbox (its
+own nanosecond mutex).  So a socket thread can always hand bytes off,
+even while the dispatch thread sits inside a multi-second XLA call.
+
+Observability (per-thread depth/utilization, the ISSUE-3 satellite):
+`serve_inbox_depth`, `serve_submit_busy_frac` and
+`serve_dispatch_busy_frac` gauges — each loop's busy time over wall
+time, windowed per gauge interval — plus the `serve_inbox_dropped`
+counter, all on the service's (thread-safe) Metrics registry.
+
+Shutdown is drain-then-join, loss-free for admitted work: `drain()`
+stops intake, lets the submit thread finish the inbox, joins both
+threads, then runs VoteService.drain() (flush + held re-entry +
+settle) on the calling thread and returns its report.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from agnes_tpu.serve.queue import Inbox
+from agnes_tpu.serve.service import (
+    SERVE_DISPATCH_BUSY_FRAC,
+    SERVE_INBOX_DEPTH,
+    SERVE_INBOX_DROPPED,
+    SERVE_SUBMIT_BUSY_FRAC,
+    SERVE_THREAD_FAILURES,
+    VoteService,
+)
+
+
+class ThreadedVoteService:
+    """Submit/dispatch threads over a VoteService (module docstring).
+
+    ``idle_wait_s`` bounds how long either loop sleeps when it finds
+    no work (the inbox get timeout and the dispatch idle nap);
+    ``gauge_interval_s`` is the busy-fraction gauge window."""
+
+    def __init__(self, service: VoteService, *,
+                 inbox_capacity: int = 1024,
+                 idle_wait_s: float = 0.0005,
+                 gauge_interval_s: float = 0.05,
+                 clock=time.monotonic):
+        self.service = service
+        self.inbox = Inbox(inbox_capacity)
+        self.idle_wait_s = float(idle_wait_s)
+        self.gauge_interval_s = float(gauge_interval_s)
+        self._clock = clock
+        self._admission = threading.Lock()
+        self._device = threading.Lock()
+        self._stop = threading.Event()       # stop intake, finish work
+        self._started = False
+        #: first exception that killed a loop (None = healthy).  A
+        #: dead loop FAILS CLOSED: the guard closes the inbox (so
+        #: submit refuses) and stops the twin loop; drain() surfaces
+        #: the exception in its report under "thread_failure".
+        self.failure: Optional[BaseException] = None
+        self._submit_t = threading.Thread(
+            target=lambda: self._guard(self._submit_loop), daemon=True,
+            name="agnes-serve-submit")
+        self._dispatch_t = threading.Thread(
+            target=lambda: self._guard(self._dispatch_loop),
+            daemon=True, name="agnes-serve-dispatch")
+
+    def _guard(self, loop) -> None:
+        """Exception containment for a loop thread: without it, a
+        runtime error mid-pump (XLA OOM, a densify bug) would kill
+        the daemon thread SILENTLY — submit would keep accepting work
+        nothing will ever dispatch.  Instead the first failure is
+        recorded, counted, and the whole host fails closed."""
+        try:
+            loop()
+        except BaseException as e:  # noqa: BLE001 — fail closed on ANY
+            if self.failure is None:
+                self.failure = e
+            self.service.metrics.count(SERVE_THREAD_FAILURES)
+            self._stop.set()
+            self.inbox.close()       # refuse producers immediately
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ThreadedVoteService":
+        if not self._started:
+            self._started = True
+            self._submit_t.start()
+            self._dispatch_t.start()
+        return self
+
+    # -- ingress (any thread; wait-free wrt device work) ---------------------
+
+    def submit(self, wire_bytes) -> bool:
+        """Hand a wire blob to the event loop.  Returns False (and
+        counts `serve_inbox_dropped`) when the inbox is full, closed
+        (draining or a loop thread died) — fail closed, never block:
+        backpressure surfaces to the network peer as a refusal, not a
+        stall.  The inbox is the ONE refusal authority, so its
+        `dropped` count and the metric cannot diverge."""
+        if not self.inbox.put(wire_bytes):
+            self.service.metrics.count(SERVE_INBOX_DROPPED)
+            return False
+        return True
+
+    # -- the loops -----------------------------------------------------------
+
+    def _submit_loop(self) -> None:
+        m = self.service.metrics
+        busy = 0.0
+        win_t0 = self._clock()
+        while not (self._stop.is_set() and self.inbox.depth == 0):
+            blob = self.inbox.get(timeout=self.idle_wait_s)
+            if blob is not None:
+                t0 = self._clock()
+                with self._admission:
+                    self.service.submit(blob)
+                busy += self._clock() - t0
+            now = self._clock()
+            if now - win_t0 >= self.gauge_interval_s:
+                m.gauge(SERVE_SUBMIT_BUSY_FRAC, busy / (now - win_t0))
+                m.gauge(SERVE_INBOX_DEPTH, self.inbox.depth)
+                busy, win_t0 = 0.0, now
+
+    def _dispatch_loop(self) -> None:
+        m = self.service.metrics
+        busy = 0.0
+        win_t0 = self._clock()
+        while True:
+            with self._admission:
+                batch = self.service._close_batch()
+            # pump when there is a closed batch OR builds staged by a
+            # previous tick wait for their dispatch (reading the FIFO's
+            # truthiness unlocked is benign: worst case one extra tick)
+            if batch is not None or self.service.pipeline._staged:
+                t0 = self._clock()
+                with self._device:
+                    self.service._pump_batch(batch)
+                busy += self._clock() - t0
+            elif self._stop.is_set():
+                break          # idle AND draining: nothing left to pump
+            else:
+                time.sleep(self.idle_wait_s)
+            now = self._clock()
+            if now - win_t0 >= self.gauge_interval_s:
+                m.gauge(SERVE_DISPATCH_BUSY_FRAC, busy / (now - win_t0))
+                busy, win_t0 = 0.0, now
+
+    # -- egress (calling thread) ----------------------------------------------
+
+    def poll_decisions(self) -> List:
+        """Newly latched decisions (VoteService.poll_decisions under
+        the device lock — serialized against the dispatch thread's
+        pipeline work, never against submit)."""
+        with self._device:
+            return self.service.poll_decisions()
+
+    # -- shutdown -------------------------------------------------------------
+
+    def drain(self, timeout_s: Optional[float] = 60.0) -> dict:
+        """Graceful shutdown: close intake, join both threads, flush
+        any inbox residue through admission, then run the service's
+        own drain (queue flush + held-vote re-entry + settle) and
+        return its final report (plus inbox accounting).
+
+        Loss-free for accepted work: `inbox.close()` atomically
+        orders every racing `submit` against the final flush — a
+        producer that slipped past the stop flag and appended after
+        the submit loop exited still gets its blob admitted here; a
+        producer arriving after the close gets False (counted).
+
+        `timeout_s` is HONEST: a thread that does not quiesce in time
+        (e.g. the dispatch thread inside a multi-minute XLA trace)
+        raises TimeoutError instead of silently blocking on the
+        device lock for however long the trace takes — retry with a
+        larger budget once the compile has had time to finish."""
+        self._stop.set()
+        self.inbox.close()
+        if self._started:
+            # ONE shared deadline across both joins, so the promised
+            # bound is timeout_s total, not per thread
+            t_end = (None if timeout_s is None
+                     else self._clock() + timeout_s)
+            for t in (self._submit_t, self._dispatch_t):
+                t.join(timeout=None if t_end is None
+                       else max(0.0, t_end - self._clock()))
+            stuck = [t.name for t in (self._submit_t, self._dispatch_t)
+                     if t.is_alive()]
+            if stuck:
+                raise TimeoutError(
+                    f"serve threads did not quiesce within "
+                    f"{timeout_s}s: {', '.join(stuck)} (an in-flight "
+                    f"XLA trace can hold the dispatch thread for "
+                    f"minutes; retry drain with a larger timeout_s)")
+        with self._admission, self._device:
+            try:
+                while True:     # TOCTOU residue (docstring)
+                    blob = self.inbox.get(timeout=0)
+                    if blob is None:
+                        break
+                    self.service.submit(blob)
+                report = self.service.drain()
+            except BaseException as e:  # noqa: BLE001
+                # the service drain re-dispatches queued work through
+                # the same driver a loop thread may have died on; for
+                # a FAILED host the promised contract is a report
+                # carrying thread_failure, not a second raise.  A
+                # healthy host's drain error is a real bug: re-raise.
+                if self.failure is None:
+                    raise
+                report = {"drain_error": repr(e),
+                          "metrics": self.service.metrics.snapshot()}
+        report["inbox"] = {"enqueued": self.inbox.enqueued,
+                           "dropped": self.inbox.dropped,
+                           "depth_at_drain": self.inbox.depth}
+        report["thread_failure"] = (repr(self.failure)
+                                    if self.failure is not None else None)
+        return report
+
+
+def threaded_service(driver, batcher, pubkeys=None, *,
+                     inbox_capacity: int = 1024,
+                     idle_wait_s: float = 0.0005,
+                     **service_kw) -> ThreadedVoteService:
+    """Convenience assembler: VoteService + ThreadedVoteService,
+    started.  `service_kw` passes through to VoteService (ladder,
+    capacity, window_predictor, donate, ...)."""
+    svc = VoteService(driver, batcher, pubkeys, **service_kw)
+    return ThreadedVoteService(svc, inbox_capacity=inbox_capacity,
+                               idle_wait_s=idle_wait_s).start()

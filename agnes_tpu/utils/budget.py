@@ -36,7 +36,7 @@ import math
 import os
 import signal
 import time
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Tuple
 
 # --- static operand-size math (int32 lane encoding, 20-limb field) ---------
 
@@ -207,6 +207,25 @@ def plan_lane_verify(n_lanes: int, n_blocks: int = 1,
         lanes_per_chunk=tile, resident_bytes=resident,
         chunk_bytes=tile * workspace_lane_bytes,
         hbm_bytes=hbm, safety=safety)
+
+
+def mesh_local_shape(mesh, n_instances: int, n_validators: int
+                     ) -> Tuple[int, int]:
+    """(instances, validators) as ONE device of `mesh` sees them — the
+    shape every per-device budget plan must bound (under shard_map the
+    verify and tally run on local cells).  `mesh=None` is the
+    single-device identity.  One source of truth shared by
+    DeviceDriver's chunk planning and the serve ShapeLadder's dense
+    planning, so the two can never disagree about what "per-device
+    slice of the budget" means."""
+    if mesh is None:
+        return int(n_instances), int(n_validators)
+    from agnes_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS, VAL_AXIS
+
+    shape = dict(mesh.shape)
+    n_data = shape.get(DATA_AXIS, 1) * shape.get(SLICE_AXIS, 1)
+    return (int(n_instances) // n_data,
+            int(n_validators) // shape.get(VAL_AXIS, 1))
 
 
 def device_hbm_bytes(device=None) -> int:

@@ -21,6 +21,7 @@ Two rate families, because they answer different questions:
 from __future__ import annotations
 
 import json
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
@@ -31,7 +32,16 @@ class Metrics:
     """Process-local metric registry.  Counters are monotonic;
     `rate(name)` derives lifetime per-second rates against the
     registry clock, `interval_rate(name)` windowed ones (see module
-    docstring)."""
+    docstring).
+
+    THREAD-SAFE: the serve plane's threaded host (serve/threaded.py)
+    has a submit thread and a dispatch thread feeding one registry,
+    and a scraper may read from a third.  Every read-modify-write
+    (`counters[name] = get + delta` is two bytecodes; first-touch
+    registration races the dict resize) runs under one registry lock —
+    an RLock so a locked snapshot may call the locked rate helpers.
+    Contention is nil in practice: the critical sections are a dict
+    op, nothing device-side ever holds the lock."""
 
     counters: Dict[str, int] = field(default_factory=dict)
     gauges: Dict[str, float] = field(default_factory=dict)
@@ -41,12 +51,16 @@ class Metrics:
     # key no counter can collide with
     _win: Dict[str, Tuple[int, float]] = field(default_factory=dict)
     _win_all: Optional[Tuple[Dict[str, int], float]] = None
+    _lock: threading.RLock = field(default_factory=threading.RLock,
+                                   repr=False, compare=False)
 
     def count(self, name: str, delta: int = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + delta
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + delta
 
     def gauge(self, name: str, value: float) -> None:
-        self.gauges[name] = value
+        with self._lock:
+            self.gauges[name] = value
 
     def elapsed(self) -> float:
         return time.perf_counter() - self._t0
@@ -55,7 +69,9 @@ class Metrics:
         """Lifetime average rate — see the module docstring for when
         this is the wrong tool."""
         dt = self.elapsed()
-        return self.counters.get(name, 0) / dt if dt > 0 else 0.0
+        with self._lock:
+            c = self.counters.get(name, 0)
+        return c / dt if dt > 0 else 0.0
 
     def interval_rate(self, name: str) -> float:
         """Per-second rate of `name` over the window since the LAST
@@ -63,10 +79,11 @@ class Metrics:
         reading it closes the window and opens the next one.  Each
         name keeps its own window, so independent scrapers of
         different counters don't shorten each other's intervals."""
-        now = time.perf_counter()
-        last_c, last_t = self._win.get(name, (0, self._t0))
-        c = self.counters.get(name, 0)
-        self._win[name] = (c, now)
+        with self._lock:
+            now = time.perf_counter()
+            last_c, last_t = self._win.get(name, (0, self._t0))
+            c = self.counters.get(name, 0)
+            self._win[name] = (c, now)
         dt = now - last_t
         return (c - last_c) / dt if dt > 0 else 0.0
 
@@ -75,22 +92,25 @@ class Metrics:
         deltas since the previous interval_rates() call, sharing one
         window (a consistent scrape line).  Does not disturb the
         per-name interval_rate windows."""
-        now = time.perf_counter()
-        base, last_t = self._win_all or ({}, self._t0)
-        dt = now - last_t
-        out = {}
-        for name, c in self.counters.items():
-            d = c - base.get(name, 0)
-            out[f"{name}_per_sec"] = round(d / dt, 2) if dt > 0 else 0.0
-        self._win_all = (dict(self.counters), now)
+        with self._lock:
+            now = time.perf_counter()
+            base, last_t = self._win_all or ({}, self._t0)
+            dt = now - last_t
+            out = {}
+            for name, c in self.counters.items():
+                d = c - base.get(name, 0)
+                out[f"{name}_per_sec"] = (round(d / dt, 2) if dt > 0
+                                          else 0.0)
+            self._win_all = (dict(self.counters), now)
         return out
 
     def snapshot(self) -> dict:
-        out = dict(self.counters)
-        out.update(self.gauges)
-        out["elapsed_s"] = round(self.elapsed(), 4)
-        for name in self.counters:
-            out[f"{name}_per_sec"] = round(self.rate(name), 2)
+        with self._lock:
+            out = dict(self.counters)
+            out.update(self.gauges)
+            out["elapsed_s"] = round(self.elapsed(), 4)
+            for name in self.counters:
+                out[f"{name}_per_sec"] = round(self.rate(name), 2)
         return out
 
     def json_line(self) -> str:

@@ -122,9 +122,17 @@ _PROBE_PROC = None         # in-flight probe child; reaped on any exit
 #: at a tiny shape on CPU, with the same crash-safe verdict contract —
 #: the sentinel then speaks in the smoke's headline metric
 _SERVE_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_SMOKE"))
-_SENTINEL_METRIC = ("pipeline_fused_votes_per_sec" if _SERVE_SMOKE
+#: mesh-serve-smoke mode (ci.sh gate, ISSUE 3): ONLY the mesh serve
+#: probe — threaded event-loop host + dense sharded dispatch — on a
+#: FAKED 2-device CPU mesh (--xla_force_host_platform_device_count),
+#: same crash-safe contract
+_SERVE_MESH_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_MESH_SMOKE"))
+_SENTINEL_METRIC = ("pipeline_serve_mesh_votes_per_sec"
+                    if _SERVE_MESH_SMOKE
+                    else "pipeline_fused_votes_per_sec" if _SERVE_SMOKE
                     else "pipeline_votes_per_sec")
-_SENTINEL_STAGE = ("bench_pipeline_serve" if _SERVE_SMOKE
+_SENTINEL_STAGE = ("bench_pipeline_serve_mesh" if _SERVE_MESH_SMOKE
+                   else "bench_pipeline_serve" if _SERVE_SMOKE
                    else "bench_pipeline")
 
 
@@ -481,9 +489,10 @@ if __name__ == "__main__":
           f"[bench] deadline: {_DEADLINE.source} (unbounded; no alarm)",
           file=sys.stderr, flush=True)
     try:
-        # serve-smoke is a CPU-only CI gate: no TPU claim, no lease, no
-        # probe — a hung-axon screen would only burn the smoke's budget
-        _reason = None if _SERVE_SMOKE else _backend_hung()
+        # serve smokes are CPU-only CI gates: no TPU claim, no lease,
+        # no probe — a hung-axon screen would only burn their budget
+        _reason = (None if (_SERVE_SMOKE or _SERVE_MESH_SMOKE)
+                   else _backend_hung())
     except SystemExit:
         raise
     except BaseException as e:  # noqa: BLE001 — the guard itself can
@@ -515,19 +524,26 @@ if __name__ == "__main__":
 # agnes_tpu/utils/compile_cache.py
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_cpu_parallel_codegen_split_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+    _flags = (_flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+# the mesh serve smoke fakes a multi-device platform out of host CPU
+# threads — the flag must land before ANY backend initialization
+if (_SERVE_MESH_SMOKE
+        and "xla_force_host_platform_device_count" not in _flags):
+    _n_fake = int(os.environ.get("AGNES_SERVE_MESH_SMOKE_DEVICES", "2"))
+    _flags = (_flags
+              + f" --xla_force_host_platform_device_count={_n_fake}")
+os.environ["XLA_FLAGS"] = _flags
 
-# serve-smoke runs on CPU by definition; env alone is not enough on
+# serve smokes run on CPU by definition; env alone is not enough on
 # this platform (sitecustomize forces jax_platforms="axon,cpu"), so
 # the in-process config override follows right after the import — the
 # same two-step tests/conftest.py uses
-if _SERVE_SMOKE:
+if _SERVE_SMOKE or _SERVE_MESH_SMOKE:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 
-if _SERVE_SMOKE:
+if _SERVE_SMOKE or _SERVE_MESH_SMOKE:
     jax.config.update("jax_platforms", "cpu")
 
 from agnes_tpu.utils.compile_cache import disable_persistent_cache
@@ -1061,6 +1077,111 @@ def _pipeline_serve(n_instances: int, n_validators: int,
     return 2 * n * heights / dt
 
 
+def _pipeline_serve_mesh(n_instances: int, n_validators: int,
+                         heights: int, n_data: int = 2,
+                         n_val: int = 1) -> float:
+    """CLOSED-LOOP through the serve plane ON A MESH (ISSUE 3): the
+    driver is built over a (data x val) device mesh, every batch
+    densifies through VoteBatcher's DENSE builder and dispatches the
+    shard_map-sharded fused signed step with donated buffers
+    (step_async's mesh path — each device verifies its local cells,
+    zero added collectives), and the host side is the FULL concurrent
+    production shape: ThreadedVoteService's inbox -> submit thread ->
+    bounded admission -> dispatch thread.  Feeding is height-paced —
+    wire for height h+1 is submitted once h's dispatch is QUEUED (the
+    window predictor must describe the batch being densified), which
+    serializes host feed with dispatch queueing but not with device
+    execution; collection stays deferred until the end."""
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+    from agnes_tpu.core import native
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.parallel import make_mesh
+    from agnes_tpu.serve import (
+        ShapeLadder,
+        ThreadedVoteService,
+        VoteService,
+    )
+    from agnes_tpu.utils.config import RunConfig
+
+    I, V = n_instances, n_validators
+    need = n_data * n_val
+    if len(jax.devices()) < need:
+        raise RuntimeError(
+            f"mesh serve probe needs {need} devices, "
+            f"have {len(jax.devices())}")
+    mesh = make_mesh(n_data, n_val, jax.devices()[:need])
+    seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(V)]
+    pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in seeds])
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True,
+                     mesh=mesh)
+    bat = RunConfig(n_validators=V, n_instances=I,
+                    n_slots=4).validate().make_batcher()
+    n = I * V
+    rung = 1 << (2 * n - 1).bit_length()       # one full tick's votes
+    cur = {"h": 0}
+    svc = VoteService(
+        d, bat, pubkeys, capacity=4 * n, target_votes=2 * n,
+        max_delay_s=1e9,                       # size-closed batches
+        ladder=ShapeLadder.plan_dense(I, V,
+                                      local_shape=d._local_shape(),
+                                      min_rung=rung),
+        window_predictor=lambda: (np.zeros(I, np.int64),
+                                  np.full(I, cur["h"], np.int64)))
+    tsvc = ThreadedVoteService(svc, idle_wait_s=1e-4).start()
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+
+    def wire_height(h, sigs_by_typ):
+        return b"".join(
+            pack_wire_votes(inst, val, np.full(n, h), np.zeros(n),
+                            np.full(n, typ), np.full(n, 7), sigs[val])
+            for typ, sigs in sigs_by_typ.items())
+
+    def feed(h, wire, spin_timeout_s=3600.0):
+        cur["h"] = h
+        # side-effecting calls stay STATEMENTS (never bare asserts —
+        # python -O would strip the submit and the gate would hang)
+        if not tsvc.submit(wire):
+            raise RuntimeError("inbox refused the height's wire")
+        want = 2 * n * (h + 1)
+        t_end = time.monotonic() + spin_timeout_s
+        while svc.pipeline.dispatched_votes < want:
+            if tsvc.failure is not None:
+                # a dead loop thread would otherwise stall the spin
+                # until the outer deadline and bury the real traceback
+                raise RuntimeError(
+                    f"serve loop thread died at height {h}"
+                ) from tsvc.failure
+            if time.monotonic() > t_end:
+                raise RuntimeError(
+                    f"mesh serve probe stalled at height {h}: "
+                    f"{svc.pipeline.dispatched_votes}/{want} votes "
+                    f"dispatched")
+            time.sleep(5e-4)
+
+    feed(0, wire_height(0, _sign_height_sigs(seeds, 0)))   # compile
+    warm_decisions = tsvc.poll_decisions()     # settles the warm height
+    assert len(warm_decisions) == I, warm_decisions
+    assert d.rejected_signature_device == 0
+
+    all_wire = [wire_height(h, _sign_height_sigs(seeds, h))
+                for h in range(1, heights + 1)]
+    t0 = time.perf_counter()
+    for h in range(1, heights + 1):
+        feed(h, all_wire[h - 1])
+    tsvc.poll_decisions()       # the one sync point: collect them all
+    dt = time.perf_counter() - t0
+    assert d.stats.decisions_total == I * (heights + 1), \
+        d.stats.decisions_total
+    rep = tsvc.drain()
+    assert rep["rejected_signature_device"] == 0
+    assert rep["offladder_builds"] == 0
+    assert rep["queue"]["rejected_overflow"] == 0
+    assert rep["inbox"]["dropped"] == 0
+    return 2 * n * heights / dt
+
+
 def bench_pipeline(n_instances: int = 1024, n_validators: int = 128,
                    heights: int = 6) -> float:
     """The flagship headline: end-to-end through the numpy bridge."""
@@ -1096,6 +1217,53 @@ def bench_pipeline_serve(n_instances: int = 1024, n_validators: int = 128,
     return _pipeline_serve(n_instances, n_validators, heights)
 
 
+def bench_pipeline_serve_mesh(n_instances: int = 1024,
+                              n_validators: int = 128,
+                              heights: int = 6) -> float:
+    """End-to-end through the serve plane on a 2-device mesh: threaded
+    event-loop host + dense-lane sharded fused dispatch (raises — and
+    reports -1 through the stage guard — on single-device backends)."""
+    return _pipeline_serve_mesh(n_instances, n_validators, heights)
+
+
+def _smoke_main(stage: str, metric: str, value_key: str, unit: str,
+                env_prefix: str, bench_fn, what: str) -> None:
+    """ONE crash-safe smoke entry shared by every ci.sh serve gate:
+    runs ONLY `bench_fn` at a tiny CPU shape (I/V/HEIGHTS from
+    `{env_prefix}_{I,V,HEIGHTS}`), then emits the gate's record —
+    stage naming, alarm/watchdog cancellation and the JSON verdict
+    structure live HERE so the deadline contract cannot drift between
+    smoke modes (each mode's sentinel metric is wired separately via
+    _SENTINEL_METRIC/_SENTINEL_STAGE at module scope, before any
+    stage can hang).  `metric` is the headline the gate parser
+    asserts on; `value_key` carries the measured rate under its own
+    name too (for the serve smoke the two differ — the historical
+    ISSUE-2 record shape)."""
+    global _STAGE, _EMITTED
+    _STAGE = stage
+    i = int(os.environ.get(f"{env_prefix}_I", "8"))
+    v = int(os.environ.get(f"{env_prefix}_V", "8"))
+    h = int(os.environ.get(f"{env_prefix}_HEIGHTS", "2"))
+    print(f"[bench] {what}: I={i} V={v} heights={h} on "
+          f"{len(jax.devices())} CPU device(s)",
+          file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    rate = round(bench_fn(i, v, h))
+    _RESULTS[stage] = rate
+    signal.alarm(0)
+    _cancel_deadline_watchdog()
+    print(json.dumps({
+        "metric": metric,
+        "value": rate,
+        "unit": unit,
+        "vs_baseline": round(rate / NORTH_STAR, 3) if rate > 0 else -1,
+        value_key: rate,
+        "note": (f"{what} at I={i} V={v} x{h} heights on CPU in "
+                 f"{time.perf_counter() - t0:.0f}s"),
+    }), flush=True)
+    _EMITTED = True
+
+
 def main_serve_smoke() -> None:
     """The ci.sh serve gate's entry: ONLY the closed-loop serve probe,
     tiny shape, CPU — proving the streaming plane drives the fused
@@ -1105,29 +1273,24 @@ def main_serve_smoke() -> None:
     number when the box beats the enclosing timeout's compile budget,
     else the -1 sentinel — either way a parseable record is the last
     stdout line."""
-    global _STAGE, _EMITTED
-    _STAGE = "bench_pipeline_serve"
-    i = int(os.environ.get("AGNES_SERVE_SMOKE_I", "8"))
-    v = int(os.environ.get("AGNES_SERVE_SMOKE_V", "8"))
-    h = int(os.environ.get("AGNES_SERVE_SMOKE_HEIGHTS", "2"))
-    print(f"[bench] serve smoke: I={i} V={v} heights={h} (CPU)",
-          file=sys.stderr, flush=True)
-    t0 = time.perf_counter()
-    rate = round(bench_pipeline_serve(i, v, h))
-    _RESULTS["bench_pipeline_serve"] = rate
-    signal.alarm(0)
-    _cancel_deadline_watchdog()
-    print(json.dumps({
-        "metric": "pipeline_fused_votes_per_sec",
-        "value": rate,
-        "unit": "votes/sec/chip",
-        "vs_baseline": round(rate / NORTH_STAR, 3) if rate > 0 else -1,
-        "pipeline_serve_votes_per_sec": rate,
-        "note": (f"serve smoke: closed-loop streaming plane at "
-                 f"I={i} V={v} x{h} heights on CPU in "
-                 f"{time.perf_counter() - t0:.0f}s"),
-    }), flush=True)
-    _EMITTED = True
+    _smoke_main("bench_pipeline_serve", "pipeline_fused_votes_per_sec",
+                "pipeline_serve_votes_per_sec", "votes/sec/chip",
+                "AGNES_SERVE_SMOKE", bench_pipeline_serve,
+                "serve smoke: closed-loop streaming plane")
+
+
+def main_serve_mesh_smoke() -> None:
+    """The ci.sh mesh-serve gate's entry (ISSUE 3): ONLY the mesh
+    serve probe — ThreadedVoteService event loop + dense sharded
+    dispatch — on a faked 2-device CPU mesh
+    (--xla_force_host_platform_device_count), under the same contract
+    as main_serve_smoke."""
+    _smoke_main("bench_pipeline_serve_mesh",
+                "pipeline_serve_mesh_votes_per_sec",
+                "pipeline_serve_mesh_votes_per_sec", "votes/sec",
+                "AGNES_SERVE_MESH_SMOKE", bench_pipeline_serve_mesh,
+                "mesh serve smoke: threaded host + dense sharded "
+                "dispatch")
 
 
 def main() -> None:
@@ -1156,6 +1319,9 @@ def main() -> None:
     pipeline_overlapped = guarded(bench_pipeline_overlapped)
     pipeline_fused = guarded(bench_pipeline_fused)
     pipeline_serve = guarded(bench_pipeline_serve)
+    # multichip serve: real number on >= 2-device backends, -1 (via
+    # the stage guard's exception containment) on a single chip
+    pipeline_serve_mesh = guarded(bench_pipeline_serve_mesh)
     tally = guarded(bench_tally)
     verifies = guarded(bench_verify)
     msm = guarded(bench_verify_msm)
@@ -1182,6 +1348,7 @@ def main() -> None:
         "pipeline_overlapped_votes_per_sec": pipeline_overlapped,
         "pipeline_fused_votes_per_sec": pipeline_fused,
         "pipeline_serve_votes_per_sec": pipeline_serve,
+        "pipeline_serve_mesh_votes_per_sec": pipeline_serve_mesh,
         "fused_tally_step_votes_per_sec": tally,
         "ed25519_verifies_per_sec": verifies,
         "ed25519_msm_verifies_per_sec": msm,
@@ -1194,7 +1361,8 @@ def main() -> None:
 
 if __name__ == "__main__":
     try:
-        main_serve_smoke() if _SERVE_SMOKE else main()
+        (main_serve_mesh_smoke() if _SERVE_MESH_SMOKE
+         else main_serve_smoke() if _SERVE_SMOKE else main())
     except BaseException as e:  # noqa: BLE001 — the contract: a
         # parseable record is the LAST stdout line no matter how this
         # process ends; stage exceptions are already contained by

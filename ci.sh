@@ -19,6 +19,9 @@
 #       forced-dead backend must exit 0 with a parseable -1 JSON
 #       record as its last stdout line (VERDICT r5 weak #1 — the
 #       crash-safe verdict contract, bench.py module docstring);
+#   3b. serve smoke gate (single-device streaming plane, CPU);
+#   3c. mesh serve smoke gate (ISSUE 3: threaded host + dense-lane
+#       sharded dispatch on a faked 2-device CPU mesh);
 #   4.  bench smoke (CI_BENCH=0 skips; the driver runs the real bench
 #       on TPU hardware at end of round).
 #
@@ -127,6 +130,36 @@ assert rec["value"] == -1 or rec["value"] > 0, rec
 kind = "-1 sentinel (deadline contract)" if rec["value"] == -1 \
     else f"{rec['value']:.0f} votes/s"
 print(f"serve smoke gate OK: {kind}")
+PY
+
+echo "=== [3c/4] mesh serve smoke gate (faked 2-device CPU mesh) ==="
+# ISSUE 3: the serve plane on a MESH — ThreadedVoteService event loop
+# + dense-lane sharded fused dispatch — on a 2-device CPU platform
+# faked via --xla_force_host_platform_device_count (bench.py sets the
+# flag itself from AGNES_BENCH_SERVE_MESH_SMOKE).  Same crash-safe
+# contract as the gates above: a real pipeline_serve_mesh_votes_per_sec
+# record or the -1 sentinel, rc 0 either way.
+MESH_DIR="$(mktemp -d)"
+MESH_RC=0
+AGNES_BENCH_SERVE_MESH_SMOKE=1 AGNES_TPU_LEASE_PATH="$MESH_DIR/tpu.lease" \
+  timeout -k 10 900 python bench.py > "$MESH_DIR/serve_mesh.json" \
+  2> "$MESH_DIR/serve_mesh.err" || MESH_RC=$?
+if [ "$MESH_RC" -ne 0 ]; then
+  echo "mesh serve smoke gate FAILED: bench exited rc=$MESH_RC"
+  tail -5 "$MESH_DIR/serve_mesh.err"
+  exit 1
+fi
+python - "$MESH_DIR/serve_mesh.json" <<'PY'
+import json, sys
+lines = [l for l in open(sys.argv[1]).read().strip().splitlines() if l]
+assert lines, "mesh serve smoke printed no stdout"
+rec = json.loads(lines[-1])
+assert rec["metric"] == "pipeline_serve_mesh_votes_per_sec", rec
+assert isinstance(rec["value"], (int, float)), rec
+assert rec["value"] == -1 or rec["value"] > 0, rec
+kind = "-1 sentinel (deadline contract)" if rec["value"] == -1 \
+    else f"{rec['value']:.0f} votes/s"
+print(f"mesh serve smoke gate OK: {kind}")
 PY
 
 echo "=== GATE SUMMARY: heavy isolated files ==="

@@ -184,6 +184,23 @@ def test_ladder_max_votes_clamp():
     assert lad.max_rung == 1024
 
 
+def test_ladder_plan_dense_validates_per_device_budget():
+    """Dense (mesh) mode: rungs only pace votes per batch — the budget
+    gate is the dense verify plan of the PER-DEVICE local shape, which
+    must fit at least chunked or the service fails at plan time."""
+    lad = ShapeLadder.plan_dense(1024, 1024, local_shape=(256, 512),
+                                 min_rung=256, hbm_bytes=16 * GIB)
+    assert lad.min_rung == 256 and lad.max_rung == 1 << 21  # 2*I*V
+    with pytest.raises(BudgetError):
+        ShapeLadder.plan_dense(1024, 1024, local_shape=(1024, 1024),
+                               hbm_bytes=GIB // 1024)       # 1 MiB
+    clamped = ShapeLadder.plan_dense(1024, 1024,
+                                     local_shape=(256, 512),
+                                     max_votes=4096, min_rung=256,
+                                     hbm_bytes=16 * GIB)
+    assert clamped.max_rung == 4096
+
+
 # -- micro-batcher ------------------------------------------------------------
 
 def _fake_clock():
@@ -354,13 +371,130 @@ def test_service_decision_decode_survives_height_advance():
     # value now claims slot 0
     svc.pipeline.window_predictor = lambda: (np.zeros(2, np.int64),
                                              np.array([1, 0], np.int64))
-    svc.pipeline._staged = None      # drop the stale staged build
+    svc.pipeline._staged.clear()     # drop the stale staged builds
     svc.pipeline._sync_window()
     bat.add_arrays([0], [1], [1], [0], [0], [99])
     bat.build_phases()
     assert bat.decode_slot(0, 0) == 99   # the live table moved on
     decs = svc.poll_decisions()
     assert len(decs) == 1 and decs[0].value_id == 42   # snapshot wins
+
+
+def test_pipeline_offladder_split_held_reentry():
+    """ISSUE 3 off-ladder fix: a held future-round burst re-entering
+    the window in the same tick as a full fresh batch must build
+    SEPARATELY (window-aware split), every build capped at the
+    ladder's top rung — `offladder_builds` stays 0 and no vote is
+    lost.  Dispatch is stubbed (the build/ladder logic under test is
+    host-side; the real dispatch path is covered by the slow suite),
+    so this runs with zero XLA compiles."""
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        validator_pubkeys,
+    )
+
+    I, V = 2, 8
+    seeds = deterministic_seeds(V)
+    pubkeys = validator_pubkeys(seeds)
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bat = VoteBatcher(I, V, n_slots=4)
+    box = {"base": 0}
+    ladder = ShapeLadder.plan(I, V, max_votes=16, min_rung=8)
+    assert ladder.max_rung == 16
+    svc = VoteService(
+        d, bat, pubkeys, capacity=64, target_votes=16, max_delay_s=0.0,
+        ladder=ladder,
+        window_predictor=lambda: (np.full(I, box["base"], np.int64),
+                                  np.zeros(I, np.int64)))
+    lanes_seen = []
+    d.step_async = (lambda phases, lanes=None, exts=None, donate=True:
+                    lanes_seen.append(lanes))
+
+    def wire(val_lo, round_):
+        """Both classes of a half-tick: validators [val_lo, val_lo+4)
+        vote 7 in `round_` for every instance (8 votes per class)."""
+        inst = np.repeat(np.arange(I), 4)
+        val = np.tile(np.arange(val_lo, val_lo + 4), I)
+        n = len(inst)
+        return b"".join(
+            pack_wire_votes(inst, val, np.zeros(n), np.full(n, round_),
+                            np.full(n, typ), np.full(n, 7))
+            for typ in (0, 1))
+
+    # tick 1: a 16-vote burst for round 4 — outside the W=4 window at
+    # base 0, so the batcher holds it back (a counted no-op tick)
+    assert svc.submit(wire(0, 4)).accepted == 16
+    svc.pump()
+    assert bat.held_votes == 16 and svc.pipeline.noop_ticks == 1
+
+    # tick 2: the window rotates to base 4 AND a full fresh batch for
+    # round 4 arrives — the old pipeline drained burst + batch into
+    # ONE 32-lane build above the top rung (offladder_builds == 1, a
+    # live compile stall); the split builds them separately
+    box["base"] = 4
+    assert svc.submit(wire(4, 4)).accepted == 16
+    svc.pump()                         # stages the split builds
+    svc.pump()                         # dispatches them
+    rep = svc.drain()
+
+    assert svc.pipeline.offladder_builds == 0
+    assert rep["dispatched_batches"] == 2
+    assert rep["dispatched_votes"] == 32           # no vote lost
+    assert bat.held_votes == 0
+    assert len(lanes_seen) == 2
+    for lanes in lanes_seen:
+        assert lanes is not None                   # device-eligible
+        assert int(lanes.pub.shape[0]) <= ladder.max_rung
+    assert sum(int(np.asarray(ln.real).sum()) for ln in lanes_seen) == 32
+
+
+def test_pipeline_dispatch_failure_restores_staged_builds():
+    """A dispatch that raises must put the failing build AND every
+    later staged build back on the FIFO — a caller that catches the
+    transient error and retries loses no staged vote."""
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        validator_pubkeys,
+    )
+
+    I, V = 2, 8
+    seeds = deterministic_seeds(V)
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bat = VoteBatcher(I, V, n_slots=4)
+    ladder = ShapeLadder.plan(I, V, max_votes=16, min_rung=8)
+    svc = VoteService(
+        d, bat, validator_pubkeys(seeds), capacity=64, target_votes=16,
+        max_delay_s=0.0, ladder=ladder,
+        window_predictor=lambda: (np.zeros(I, np.int64),
+                                  np.zeros(I, np.int64)))
+    calls = {"n": 0}
+
+    def flaky(phases, lanes=None, exts=None, donate=True):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient dispatch error")
+
+    d.step_async = flaky
+    # two half-tick submits -> one 32-vote batch... the cap splits it
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+    svc.submit(b"".join(
+        pack_wire_votes(inst, val, np.zeros(n), np.zeros(n),
+                        np.full(n, typ), np.full(n, 7))
+        for typ in (0, 1)))
+    # stage the WHOLE 32-vote tick at once: the max_rung=16 cap
+    # splits it into two staged builds
+    assert svc.pipeline.stage(svc.queue.drain())
+    assert len(svc.pipeline._staged) == 2
+    with pytest.raises(RuntimeError):
+        svc.pipeline.dispatch_staged()     # first dispatch raises
+    assert len(svc.pipeline._staged) == 2  # nothing lost
+    assert svc.pipeline.dispatched_votes == 0
+    assert svc.pipeline.dispatch_staged() == 32   # retry conserves all
+    assert svc.pipeline.dispatched_batches == 2
 
 
 def test_service_gauges_and_windowed_rates():

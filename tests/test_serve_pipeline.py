@@ -152,6 +152,115 @@ def test_serve_burst_overload_forged_and_drain():
 
 
 @pytest.mark.slow
+def test_serve_mesh_threaded_bit_identical_to_single_and_offline():
+    """ISSUE 3 acceptance: decisions served through ThreadedVoteService
+    on a >= 2-device (faked CPU) mesh — dense-lane sharded dispatch —
+    are BIT-identical to the single-device serve path and to the
+    offline step_seq_signed_dense path.  The offline reference runs on
+    the SAME mesh with donate=False, so it and the serve loop share
+    one memoized sharded jit entry (parallel/sharded._FACTORY_CACHE):
+    the mesh pair costs ONE sharded compile."""
+    import time as _time
+
+    import jax
+
+    from agnes_tpu.parallel import make_mesh
+    from agnes_tpu.serve import ThreadedVoteService
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device CPU mesh")
+    I2, V2 = 4, 4                      # shards (data=2, val=2)
+    N2 = I2 * V2
+    RUNG2 = 1 << (2 * N2 - 1).bit_length()
+    heights = 2
+    mesh = make_mesh(2, 2)
+
+    def wire_height2(h):
+        return b"".join(
+            pack_wire_votes(*full_mesh_cols(I2, V2, SEEDS, h, typ, 7))
+            for typ in (PV, PC))
+
+    # offline dense reference, on the mesh
+    dA = DeviceDriver(I2, V2, advance_height=True, defer_collect=True,
+                      mesh=mesh)
+    bA = VoteBatcher(I2, V2, n_slots=4)
+    for h in range(heights):
+        bA.sync_device(np.zeros(I2, np.int64), np.full(I2, h, np.int64))
+        for typ in (PV, PC):
+            bA.add_arrays(*full_mesh_cols(I2, V2, SEEDS, h, typ, 7))
+        phases, dense = bA.build_phases_device_dense(PUBKEYS)
+        assert dense is not None
+        dA.step_seq_signed_dense([dA.empty_phase()]
+                                 + [p for p, _ in phases], dense)
+    dA.block_until_ready()
+    assert dA.stats.decisions_total == I2 * heights
+
+    # the same wire traffic through the THREADED mesh serve plane
+    box = {"h": 0}
+    dB = DeviceDriver(I2, V2, advance_height=True, defer_collect=True,
+                      mesh=mesh)
+    bB = VoteBatcher(I2, V2, n_slots=4)
+    svcB = VoteService(
+        dB, bB, PUBKEYS, capacity=4 * 2 * N2, target_votes=2 * N2,
+        max_delay_s=1e9,
+        ladder=ShapeLadder.plan_dense(I2, V2,
+                                      local_shape=dB._local_shape(),
+                                      min_rung=RUNG2),
+        window_predictor=lambda: (np.zeros(I2, np.int64),
+                                  np.full(I2, box["h"], np.int64)),
+        donate=False)
+    assert svcB.pipeline.dense
+    tsvc = ThreadedVoteService(svcB, idle_wait_s=0.0005).start()
+    for h in range(heights):
+        box["h"] = h
+        assert tsvc.submit(wire_height2(h))
+        want = 2 * N2 * (h + 1)
+        t_end = _time.monotonic() + 900
+        while svcB.pipeline.dispatched_votes < want:
+            assert _time.monotonic() < t_end, \
+                f"mesh serve stalled at height {h}"
+            _time.sleep(0.005)
+    rep = tsvc.drain()
+    assert rep["decisions_total"] == I2 * heights
+    assert rep["offladder_builds"] == 0
+    assert rep["host_fallback_builds"] == 0
+    assert rep["rejected_signature_device"] == 0
+    assert rep["inbox"]["dropped"] == 0
+
+    # the same traffic through the SINGLE-device (packed-lane) serve
+    boxC = {"h": 0}
+    dC = DeviceDriver(I2, V2, advance_height=True, defer_collect=True)
+    bC = VoteBatcher(I2, V2, n_slots=4)
+    svcC = VoteService(
+        dC, bC, PUBKEYS, capacity=4 * 2 * N2, target_votes=2 * N2,
+        max_delay_s=0.0,
+        ladder=ShapeLadder.plan(I2, V2, min_rung=RUNG2),
+        window_predictor=lambda: (np.zeros(I2, np.int64),
+                                  np.full(I2, boxC["h"], np.int64)),
+        donate=False)
+    for h in range(heights):
+        boxC["h"] = h
+        svcC.submit(wire_height2(h))
+        svcC.pump()
+    repC = svcC.drain()
+    assert repC["decisions_total"] == I2 * heights
+
+    # bit-identity: mesh serve == offline dense == single-device serve
+    for tag, dX in (("offline-dense", dA), ("single-serve", dC)):
+        for a, b in zip(dX.state, dB.state):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=f"state vs {tag}")
+        for a, b in zip(dX.tally, dB.tally):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=f"tally vs {tag}")
+        np.testing.assert_array_equal(dX.stats.decision_value,
+                                      dB.stats.decision_value)
+        np.testing.assert_array_equal(dX.stats.decision_round,
+                                      dB.stats.decision_round)
+        np.testing.assert_array_equal(dX.stats.decided, dB.stats.decided)
+
+
+@pytest.mark.slow
 def test_serve_unsigned_equivocation_flood():
     """A byzantine equivocation flood through the queue on an UNSIGNED
     service: validator 0 double-votes in every instance, the batcher

@@ -1,0 +1,229 @@
+"""ThreadedVoteService host event loop — CHEAP side (tier-1): inbox
+bounds, concurrent submit conservation (no lost/duplicated votes or
+decisions across threads), clean drain, per-thread gauges, and the
+Metrics registry's thread-safety.  Device dispatch is STUBBED
+throughout — the machinery under test is the host threading layer;
+the real mesh dispatch path is covered by the slow differential in
+tests/test_serve_pipeline.py — so nothing here compiles."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from agnes_tpu.bridge import VoteBatcher
+from agnes_tpu.bridge.native_ingest import pack_wire_votes
+from agnes_tpu.harness.device_driver import DeviceDriver
+from agnes_tpu.serve import (
+    Inbox,
+    ShapeLadder,
+    ThreadedVoteService,
+    VoteService,
+)
+from agnes_tpu.serve.service import (
+    SERVE_DISPATCH_BUSY_FRAC,
+    SERVE_INBOX_DROPPED,
+    SERVE_SUBMIT_BUSY_FRAC,
+)
+from agnes_tpu.utils.metrics import Metrics
+
+
+def _wait(pred, timeout_s=20.0, what="condition"):
+    t_end = time.monotonic() + timeout_s
+    while not pred():
+        if time.monotonic() > t_end:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.002)
+
+
+def _stubbed_service(I=4, V=8, **kw):
+    """Unsigned service whose device dispatch is replaced by a
+    recording stub (votes counted off the phase masks — exactly what
+    the device would tally for honest unsigned traffic)."""
+    d = DeviceDriver(I, V)
+    bat = VoteBatcher(I, V, n_slots=4)
+    kw.setdefault("ladder", ShapeLadder.plan(I, V, min_rung=8))
+    kw.setdefault("capacity", 4 * I * V)
+    kw.setdefault("target_votes", 8)
+    kw.setdefault("max_delay_s", 0.0)
+    svc = VoteService(d, bat, None, **kw)
+    dispatched = []
+
+    def stub(phases, lanes=None, exts=None, donate=True):
+        dispatched.append(sum(int(np.asarray(p.mask).sum())
+                              for p in phases))
+
+    d.step_async = stub
+    return svc, d, dispatched
+
+
+# -- inbox --------------------------------------------------------------------
+
+def test_inbox_bounded_fifo_and_dropped():
+    box = Inbox(2)
+    assert box.put(b"a") and box.put(b"b")
+    assert not box.put(b"c")            # full: fail closed, counted
+    assert box.dropped == 1 and box.enqueued == 2
+    assert box.get() == b"a" and box.get() == b"b"   # FIFO
+    assert box.get(timeout=0.01) is None             # empty: timeout
+    box.close()
+    assert not box.put(b"d") and box.dropped == 2    # closed: refused
+    with pytest.raises(ValueError):
+        Inbox(0)
+
+
+def test_threaded_drain_flushes_inbox_residue():
+    """A blob the inbox ACCEPTED (put returned True) before the close
+    must reach admission even if no loop ever drained it — the
+    loss-free-drain contract that closes the submit/stop race (drain
+    flushes the residue itself after closing the inbox)."""
+    svc, d, _ = _stubbed_service()
+    tsvc = ThreadedVoteService(svc)           # threads never started
+    assert tsvc.submit(pack_wire_votes([0], [0], [0], [0], [0], [7]))
+    rep = tsvc.drain()
+    assert rep["dispatched_votes"] == 1       # accepted blob NOT lost
+    assert rep["inbox"]["depth_at_drain"] == 0
+    assert tsvc.inbox.closed
+    assert not tsvc.submit(b"\x00" * 96)      # after drain: refused
+
+
+# -- concurrent submit conservation -------------------------------------------
+
+def test_threaded_submit_no_lost_no_duplicated_votes():
+    """N submitter threads race the event loop; every admitted vote is
+    dispatched exactly once (conservation at the device boundary: the
+    sum of dispatched phase-mask cells equals the admitted count)."""
+    I, V = 4, 8
+    svc, d, dispatched = _stubbed_service(I, V)
+    tsvc = ThreadedVoteService(svc, idle_wait_s=0.0005,
+                               gauge_interval_s=0.01).start()
+    n_threads, per_thread = 4, 8       # 32 votes = one per (I, V) cell
+
+    def submitter(t):
+        for k in range(per_thread):
+            inst, val = (t * per_thread + k) // V, (t * per_thread + k) % V
+            w = pack_wire_votes([inst], [val], [0], [0], [0], [7])
+            assert tsvc.submit(w)
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    total = n_threads * per_thread
+    _wait(lambda: svc.pipeline.dispatched_votes >= total,
+          what="all votes dispatched")
+    rep = tsvc.drain()
+    assert rep["dispatched_votes"] == total
+    assert rep["inbox"]["enqueued"] == total
+    assert rep["inbox"]["dropped"] == 0
+    assert rep["metrics"]["serve_admitted"] == total
+    assert sum(dispatched) == total    # each vote dispatched EXACTLY once
+    assert svc.pipeline.offladder_builds == 0
+    assert d.stats.steps == 0          # the stub never touched XLA
+
+
+def test_threaded_poll_decisions_exactly_once():
+    """Decisions latched while the dispatch thread runs are reported
+    exactly once across concurrent-era polls and the final drain."""
+    I, V = 4, 8
+    svc, d, _ = _stubbed_service(I, V)
+    bat = svc.batcher
+
+    def deciding_stub(phases, lanes=None, exts=None, donate=True):
+        d.stats.decided[:] = True      # the device latched everyone
+        d.stats.decision_value[:] = 0
+        d.stats.decision_round[:] = 0
+        d.stats.decisions_total = I
+
+    d.driver_stub = deciding_stub
+    d.step_async = deciding_stub
+    tsvc = ThreadedVoteService(svc, idle_wait_s=0.0005).start()
+    inst = np.arange(I)
+    assert tsvc.submit(pack_wire_votes(inst, np.zeros(I), np.zeros(I),
+                                       np.zeros(I), np.zeros(I),
+                                       np.full(I, 7)))
+    _wait(lambda: svc.pipeline.dispatched_votes >= I,
+          what="the tick's dispatch")
+    decs = tsvc.poll_decisions()
+    assert len(decs) == I
+    assert all(dec.value_id == 7 for dec in decs)    # slot 0 -> 7
+    assert tsvc.poll_decisions() == []               # no duplicates
+    rep = tsvc.drain()
+    assert rep["final_decisions"] == []              # still none new
+    assert rep["decisions_total"] == I
+
+
+def test_threaded_drain_rejects_late_submits_and_reports_gauges():
+    svc, d, _ = _stubbed_service()
+    tsvc = ThreadedVoteService(svc, idle_wait_s=0.0005,
+                               gauge_interval_s=0.005).start()
+    assert tsvc.submit(pack_wire_votes([0], [0], [0], [0], [0], [7]))
+    _wait(lambda: svc.pipeline.dispatched_votes >= 1, what="dispatch")
+    time.sleep(0.03)                   # let a gauge window elapse
+    rep = tsvc.drain()
+    # fail closed after drain: the blob is refused and counted
+    assert not tsvc.submit(b"\x00" * 96)
+    assert svc.metrics.counters[SERVE_INBOX_DROPPED] >= 1
+    snap = rep["metrics"]
+    assert SERVE_SUBMIT_BUSY_FRAC in snap
+    assert SERVE_DISPATCH_BUSY_FRAC in snap
+    assert 0.0 <= snap[SERVE_DISPATCH_BUSY_FRAC] <= 1.0
+
+
+def test_threaded_loop_failure_fails_closed():
+    """A loop thread killed by a runtime error (XLA OOM, densify bug)
+    must not leave a zombie service silently accepting work: the
+    guard records the failure, refuses new submits, and drain
+    surfaces the exception in its report."""
+    svc, d, _ = _stubbed_service()
+
+    def boom(phases, lanes=None, exts=None, donate=True):
+        raise RuntimeError("synthetic XLA death")
+
+    d.step_async = boom
+    tsvc = ThreadedVoteService(svc, idle_wait_s=0.0005).start()
+    tsvc.submit(pack_wire_votes([0], [0], [0], [0], [0], [7]))
+    _wait(lambda: tsvc.failure is not None, what="loop failure")
+    assert not tsvc.submit(pack_wire_votes([1], [0], [0], [0], [0],
+                                           [7]))      # fail closed
+    rep = tsvc.drain()
+    assert rep["thread_failure"] is not None
+    assert "synthetic XLA death" in rep["thread_failure"]
+    assert rep["metrics"]["serve_thread_failures"] == 1
+
+
+# -- metrics thread-safety ----------------------------------------------------
+
+def test_metrics_concurrent_counts_are_exact():
+    """The ISSUE-3 satellite: submit and dispatch threads race one
+    registry — counter read-modify-writes and first-touch gauge
+    registration must be exact under concurrency."""
+    m = Metrics()
+    n_threads, per_thread = 8, 5000
+
+    def worker(t):
+        for k in range(per_thread):
+            m.count("x")
+            if k % 100 == 0:
+                m.gauge(f"g{t}", float(k))
+                m.count(f"c{t}")
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    # a concurrent scraper must never crash or corrupt the windows
+    for _ in range(20):
+        m.interval_rates()
+        m.snapshot()
+        time.sleep(0.001)
+    for th in threads:
+        th.join()
+    assert m.counters["x"] == n_threads * per_thread
+    for t in range(n_threads):
+        assert m.counters[f"c{t}"] == per_thread // 100
+    snap = m.snapshot()
+    assert snap["x"] == n_threads * per_thread
