@@ -1,0 +1,40 @@
+"""Static invariant analyzer (ISSUE 4 tentpole).
+
+The paper's throughput story rests on structural invariants of the
+fused path that nothing used to check mechanically — and that PR 3
+proved can break silently (every sharded entry compiled TWICE for two
+rounds, ~217s of hidden stall per entry, because an uncommitted first
+dispatch keyed a second jit cache entry).  This package is the gate
+that proves the invariants BEFORE a TPU round burns on them, all on
+CPU, all WITHOUT a single XLA compile:
+
+  jaxpr_audit.py  abstract-trace every registered jit entry
+                  (device/registry.py): donation honored in the
+                  lowered text, collective census (chunking adds zero
+                  collectives under shard_map), no host callbacks in
+                  hot-path jaxprs, dtype policy (no float64 / weak
+                  float leaks)
+  retrace.py      the recompile tripwire: a trace-count sentinel armed
+                  with the closed set of expected (entry,
+                  shape-signature) traces from the ShapeLadder +
+                  warmup plan; any trace outside the set fails loudly
+                  and bumps `retrace_unexpected`.  Catches the PR 3
+                  double-compile class (same shapes, different
+                  sharding) even unarmed.
+  lockcheck.py    AST lint of serve/threaded.py's two-lock discipline
+                  (+ a runtime instrumented-lock mode for the threaded
+                  tests)
+  lint.py         repo-wide AST rules: host syncs in serve hot paths,
+                  unregistered import-time jax.jit entries, unhashable
+                  static-argnum candidates
+
+CLI: scripts/agnes_lint.py (`--pass jaxpr|retrace|locks|lint|all`),
+gated in ci.sh before the test gates.
+"""
+
+from agnes_tpu.analysis.jaxpr_audit import Finding, audit  # noqa: F401
+from agnes_tpu.analysis.retrace import (  # noqa: F401
+    RetraceError,
+    RetraceSentinel,
+    signature,
+)

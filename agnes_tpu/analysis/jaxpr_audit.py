@@ -1,0 +1,491 @@
+"""Jaxpr auditor: abstract-trace every registered jit entry and assert
+the fused path's structural invariants — no compile, cheap on CPU.
+
+Four properties, each of which has already bitten (or would have):
+
+* **Donation honored.**  Every `*_donated` twin's LOWERED text must
+  carry one aliasing/donor attr per donated leaf (`tf.aliasing_output`
+  on plain jits, `jax.buffer_donor` through jit-of-shard_map).  A twin
+  registered as donated whose jit silently lost its donate_argnums
+  would double the serve plane's resident state/tally (320 MB of tally
+  alone at the north-star shape) without any test failing.
+* **Collective census.**  Count collective primitives (psum &c.) in
+  the sharded entries, and assert the count is INVARIANT in
+  `verify_chunk`: the chunk loop is a shard-local `lax.map`, so
+  chunking must add zero collectives per chunk (the
+  zero-added-collectives property parallel/sharded.py promises).
+* **No host callbacks.**  `pure_callback`/`debug_callback`/
+  `io_callback` in a hot-path jaxpr is a host round-trip per dispatch
+  — a silent serve-plane stall (a stray `jax.debug.print` is enough).
+* **Dtype policy.**  No float64/complex128 avals and no weakly-typed
+  float leaking through an entry: x64 is off by design, and a weak
+  float in the int-encoded consensus state means an accidental
+  promotion upstream.
+
+Heavy entries (anything containing the Ed25519 verify graph) cost
+~15-20s of pure tracing each on the 2-CPU CI box; `quick=True` skips
+them for the tier-1 test suite, the CLI default audits everything
+(budgeted < 120s, asserted by the ci.sh gate's timeout).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: audit shape dims — tiny on purpose: trace cost is graph-size bound,
+#: not shape bound, and the invariants are shape-independent
+AUDIT_DIMS = dict(I=2, V=4, P=2, Ps=1, R=4, S=4, N=8, H=2, NB=1)
+
+COLLECTIVES = frozenset({
+    "psum", "psum2", "all_reduce", "all_gather", "all_gather_invariant",
+    "reduce_scatter", "ppermute", "pshuffle", "all_to_all", "pmin",
+    "pmax", "pgather",
+})
+HOST_CALLBACKS = frozenset({
+    "pure_callback", "debug_callback", "io_callback", "callback",
+    "outside_call", "host_callback_call",
+})
+BANNED_DTYPES = ("float64", "complex128")
+
+#: non-donated twins share fn+statics with a donated twin the plan DOES
+#: trace — identical jaxpr by construction, so tracing both would just
+#: double the heavy-trace bill
+TWINS = {
+    "consensus_step_seq_signed": "consensus_step_seq_signed_donated",
+    "consensus_step_seq_signed_dense":
+        "consensus_step_seq_signed_dense_donated",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    pass_name: str
+    code: str
+    where: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.pass_name}:{self.code}] {self.where}: " \
+               f"{self.message}"
+
+
+@dataclasses.dataclass
+class EntryReport:
+    entry: str
+    collectives: Dict[str, int]
+    aliased: Optional[int] = None      # donor/alias attrs in lowering
+    heavy: bool = False
+
+
+@dataclasses.dataclass
+class AuditReport:
+    findings: List[Finding]
+    entries: List[EntryReport]
+    skipped: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+# -- example inputs -----------------------------------------------------------
+# Builders keyed by registry name.  They live HERE (not in the
+# registry) because example shapes are an audit concern; a HOT entry
+# registered without a builder is itself a finding (AUD000), so the
+# table cannot silently fall behind the registry.
+
+def _state_tally(d):
+    from agnes_tpu.device.encoding import DeviceState
+    from agnes_tpu.device.tally import TallyConfig, TallyState
+
+    cfg = TallyConfig(n_validators=d["V"], n_rounds=d["R"],
+                      n_slots=d["S"])
+    return DeviceState.new((d["I"],)), TallyState.new(d["I"], cfg)
+
+
+def _common(d):
+    import jax.numpy as jnp
+
+    from agnes_tpu.device.encoding import I32
+
+    powers = jnp.ones((d["V"],), I32)
+    total = jnp.asarray(d["V"], I32)
+    pf = jnp.ones((d["I"], d["R"]), bool)
+    pv = jnp.ones((d["I"],), I32)
+    return powers, total, pf, pv
+
+
+def _ext_phase(d, seq: bool):
+    import jax.numpy as jnp
+
+    from agnes_tpu.device.encoding import I32
+    from agnes_tpu.device.step import ExtEvent, VotePhase
+
+    lead = (d["P"],) if seq else ()
+    z = jnp.zeros(lead + (d["I"],), I32)
+    ext = ExtEvent(tag=z, round=z, value=z, pol_round=z)
+    phase = VotePhase(
+        round=z, typ=z,
+        slots=jnp.zeros(lead + (d["I"], d["V"]), I32),
+        mask=jnp.zeros(lead + (d["I"], d["V"]), bool),
+        height=z)
+    return ext, phase
+
+
+def _lanes(d):
+    import jax.numpy as jnp
+
+    from agnes_tpu.device.step import SignedLanes
+
+    n = d["N"]
+    z32 = jnp.int32
+    return SignedLanes(
+        pub=jnp.zeros((n, 32), z32), sig=jnp.zeros((n, 64), z32),
+        blocks=jnp.zeros((n, d["NB"], 32), jnp.uint32),
+        phase_idx=jnp.zeros(n, z32), inst=jnp.zeros(n, z32),
+        val=jnp.zeros(n, z32), real=jnp.zeros(n, bool))
+
+
+def _dense(d):
+    import jax.numpy as jnp
+
+    from agnes_tpu.device.step import DenseSignedPhases
+
+    return DenseSignedPhases(
+        pub=jnp.zeros((d["V"], 32), jnp.int32),
+        sig=jnp.zeros((d["Ps"], d["I"], d["V"], 64), jnp.int32),
+        blocks=jnp.zeros((d["Ps"], d["I"], d["V"], d["NB"], 32),
+                         jnp.uint32))
+
+
+def _step_args(d):
+    st, ta = _state_tally(d)
+    ext, ph = _ext_phase(d, seq=False)
+    return (st, ta, ext, ph) + _common(d)
+
+
+def _seq_args(d):
+    st, ta = _state_tally(d)
+    ext, ph = _ext_phase(d, seq=True)
+    return (st, ta, ext, ph) + _common(d)
+
+
+def _signed_args(d):
+    st, ta = _state_tally(d)
+    ext, ph = _ext_phase(d, seq=True)
+    return (st, ta, ext, ph, _lanes(d)) + _common(d)
+
+
+def _dense_args(d):
+    st, ta = _state_tally(d)
+    ext, ph = _ext_phase(d, seq=True)
+    return (st, ta, ext, ph, _dense(d)) + _common(d)
+
+
+def _honest_args(d):
+    import jax.numpy as jnp
+
+    from agnes_tpu.device.encoding import I32
+
+    st, ta = _state_tally(d)
+    slots = jnp.zeros((d["I"], d["V"]), I32)
+    mask = jnp.zeros((d["I"], d["V"]), bool)
+    return (st, ta, slots, mask) + _common(d)
+
+
+ARG_BUILDERS: Dict[str, Callable] = {
+    "consensus_step": _step_args,
+    "consensus_step_seq": _seq_args,
+    "consensus_step_seq_donated": _seq_args,
+    "consensus_step_seq_signed": _signed_args,
+    "consensus_step_seq_signed_donated": _signed_args,
+    "consensus_step_seq_signed_dense": _dense_args,
+    "consensus_step_seq_signed_dense_donated": _dense_args,
+    "honest_heights": _honest_args,
+    "sharded_step": _step_args,
+    "sharded_step_seq": _seq_args,
+    "sharded_step_seq_signed": _dense_args,
+    "sharded_honest_heights": _honest_args,
+}
+
+#: call-time statics per entry (unsharded) / factory statics (sharded)
+ENTRY_STATICS: Dict[str, dict] = {
+    "consensus_step": {"advance_height": False},
+    "consensus_step_seq": {"advance_height": False},
+    "consensus_step_seq_donated": {"advance_height": False},
+    "consensus_step_seq_signed": {"advance_height": False,
+                                  "verify_chunk": None},
+    "consensus_step_seq_signed_donated": {"advance_height": False,
+                                          "verify_chunk": None},
+    "consensus_step_seq_signed_dense": {"advance_height": False,
+                                        "verify_chunk": None},
+    "consensus_step_seq_signed_dense_donated": {
+        "advance_height": False, "verify_chunk": None},
+    "honest_heights": {"heights": 2},
+    "sharded_step": {"advance_height": False},
+    "sharded_step_seq": {"advance_height": False, "donate": True},
+    "sharded_step_seq_signed": {"advance_height": False,
+                                "verify_chunk": None, "donate": True},
+    "sharded_honest_heights": {"heights": 2},
+}
+
+#: entries whose trace contains the Ed25519 verify graph (~15-20s of
+#: tracing each on the CI box); quick mode skips them
+HEAVY = frozenset({
+    "consensus_step_seq_signed_donated",
+    "consensus_step_seq_signed_dense_donated",
+    "sharded_step_seq_signed",
+})
+
+
+# -- jaxpr traversal ----------------------------------------------------------
+
+def _sub_jaxprs(x):
+    """Yield every jaxpr reachable from a params value."""
+    vals = x if isinstance(x, (list, tuple)) else [x]
+    for v in vals:
+        if hasattr(v, "eqns"):                 # Jaxpr
+            yield v
+        elif hasattr(v, "jaxpr"):              # ClosedJaxpr
+            inner = v.jaxpr
+            if hasattr(inner, "eqns"):
+                yield inner
+
+
+def walk_eqns(jaxpr):
+    """Every eqn in `jaxpr` and all nested sub-jaxprs (scan bodies,
+    pjit/shard_map calls, cond branches, ...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from walk_eqns(sub)
+
+
+def primitive_census(jaxpr) -> Dict[str, int]:
+    acc: Dict[str, int] = {}
+    for eqn in walk_eqns(jaxpr):
+        acc[eqn.primitive.name] = acc.get(eqn.primitive.name, 0) + 1
+    return acc
+
+
+def collective_census(jaxpr) -> Dict[str, int]:
+    return {k: v for k, v in primitive_census(jaxpr).items()
+            if k in COLLECTIVES}
+
+
+def _dtype_findings(jaxpr, entry: str) -> List[Finding]:
+    import numpy as np
+
+    bad: Dict[str, int] = {}
+    weak: Dict[str, int] = {}
+    for eqn in walk_eqns(jaxpr):
+        for var in tuple(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is None:
+                continue
+            if str(dt) in BANNED_DTYPES:
+                bad[str(dt)] = bad.get(str(dt), 0) + 1
+            if (getattr(aval, "weak_type", False)
+                    and np.issubdtype(dt, np.floating)):
+                weak[str(dt)] = weak.get(str(dt), 0) + 1
+    out = []
+    if bad:
+        out.append(Finding("jaxpr", "AUD004", entry,
+                           f"banned dtypes in traced graph: {bad}"))
+    if weak:
+        out.append(Finding(
+            "jaxpr", "AUD005", entry,
+            f"weakly-typed float avals (promotion leak): {weak}"))
+    return out
+
+
+# -- tracing ------------------------------------------------------------------
+
+def _resolve(spec, statics, mesh):
+    """(callable, call_statics) for a spec: sharded entries build via
+    their factory (statics consumed there), unsharded jits take the
+    statics at call time."""
+    if spec.sharded:
+        return spec.factory(mesh, **statics), {}
+    return spec.jit, statics
+
+
+def trace_entry(spec, statics: dict, mesh=None, dims: dict = None):
+    """Abstractly trace one registered entry at the audit shape;
+    returns a jax Traced (``.jaxpr``/``.lower()``)."""
+    dims = dict(AUDIT_DIMS, **(dims or {}))
+    args = ARG_BUILDERS[spec.name](dims)
+    fn, call_statics = _resolve(spec, statics, mesh)
+    return fn.trace(*args, **call_statics)
+
+
+def donation_findings(traced, spec, statics: dict,
+                      donated_argnums: Tuple[int, ...],
+                      dims: dict = None) -> Tuple[List[Finding],
+                                                  Optional[int]]:
+    """Lower `traced` and assert one aliasing/donor attr per donated
+    leaf.  Returns (findings, attrs found)."""
+    import jax
+
+    dims = dict(AUDIT_DIMS, **(dims or {}))
+    args = ARG_BUILDERS[spec.name](dims)
+    expected = len(jax.tree_util.tree_leaves(
+        [args[i] for i in donated_argnums]))
+    txt = traced.lower().as_text()
+    found = txt.count("tf.aliasing_output") + txt.count("jax.buffer_donor")
+    if found != expected:
+        return [Finding(
+            "jaxpr", "AUD001", spec.name,
+            f"donation not honored: {found} aliasing/donor attrs in "
+            f"the lowered text, expected {expected} (one per donated "
+            f"state/tally leaf)")], found
+    return [], found
+
+
+def _audit_one(spec, statics, mesh, metrics, findings, reports,
+               dims=None) -> Optional[Dict[str, int]]:
+    """Trace + all per-entry checks; returns the collective census."""
+    traced = trace_entry(spec, statics, mesh, dims)
+    jaxpr = traced.jaxpr.jaxpr
+    prims = primitive_census(jaxpr)       # one walk serves both checks
+    census = {k: v for k, v in prims.items() if k in COLLECTIVES}
+    cbs = {k: v for k, v in prims.items() if k in HOST_CALLBACKS}
+    if cbs:
+        findings.append(Finding(
+            "jaxpr", "AUD003", spec.name,
+            f"host callbacks in hot-path jaxpr: {cbs} (a host "
+            f"round-trip per dispatch)"))
+    findings.extend(_dtype_findings(jaxpr, spec.name))
+    donated = spec.donated
+    if spec.sharded and statics.get("donate"):
+        donated = (0, 1)
+    aliased = None
+    if donated:
+        dn, aliased = donation_findings(traced, spec, statics, donated,
+                                        dims)
+        findings.extend(dn)
+    reports.append(EntryReport(entry=spec.name, collectives=census,
+                               aliased=aliased,
+                               heavy=spec.name in HEAVY))
+    if metrics is not None:
+        from agnes_tpu.utils.metrics import ANALYSIS_ENTRIES_AUDITED
+
+        metrics.count(ANALYSIS_ENTRIES_AUDITED)
+    return census
+
+
+def planned_names() -> List[str]:
+    """The entry names a full audit traces (registered, arg-covered,
+    not a twin) — the set any sharded/parallel execution of the audit
+    must jointly cover (see shard_coverage_findings)."""
+    from agnes_tpu.device import registry
+
+    specs = {s.name for s in registry.entries()}
+    return [n for n in ARG_BUILDERS if n in specs and n not in TWINS]
+
+
+def shard_coverage_findings(union_names) -> List[Finding]:
+    """Guard against a THIRD hand-maintained list drifting: a CLI (or
+    any parallel runner) that splits the audit plan into shards must
+    prove the shard union still covers the full plan — a registered
+    entry missing from every shard would silently never be traced."""
+    missing = sorted(set(planned_names()) - set(union_names))
+    if not missing:
+        return []
+    return [Finding(
+        "jaxpr", "AUD006", ",".join(missing),
+        "audit-planned entries missing from every worker shard — "
+        "update the shard table (scripts/agnes_lint.py) or derive it "
+        "from planned_names()")]
+
+
+def _audit_mesh():
+    """A small (data x val) mesh over the available devices, or None
+    when the backend has a single device (sharded entries skipped)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from agnes_tpu.parallel.mesh import DATA_AXIS, VAL_AXIS
+
+    devs = jax.devices()
+    if len(devs) >= 4:
+        grid = np.array(devs[:4]).reshape(2, 2)
+    elif len(devs) >= 2:
+        grid = np.array(devs[:2]).reshape(1, 2)
+    else:
+        return None
+    return Mesh(grid, (DATA_AXIS, VAL_AXIS))
+
+
+def audit(quick: bool = False, names: Optional[List[str]] = None,
+          mesh=None, metrics=None, dims: dict = None,
+          coverage: bool = True) -> AuditReport:
+    """Run the full jaxpr audit over the registered entries.
+
+    `quick` skips the HEAVY (Ed25519-bearing) entries — the tier-1
+    test-suite mode; the CLI runs everything (parallelized over
+    worker processes, agnes_lint.py).  `names` restricts to a subset
+    (tests, CLI workers); `coverage=False` skips the registry
+    coverage check (CLI workers run it in exactly one shard).
+    Sharded entries need >= 2 devices; on a single-device backend
+    they are reported in `skipped`."""
+    from agnes_tpu.device import registry
+
+    findings: List[Finding] = []
+    reports: List[EntryReport] = []
+    skipped: List[str] = []
+    specs = {s.name: s for s in registry.entries()}
+
+    # coverage: every HOT entry must be audit-planned (builder +
+    # statics), directly or via its identical twin
+    for s in specs.values() if coverage else ():
+        if not s.hot or s.name in TWINS:
+            continue
+        if s.name not in ARG_BUILDERS or s.name not in ENTRY_STATICS:
+            findings.append(Finding(
+                "jaxpr", "AUD000", s.name,
+                "hot jit entry registered without audit coverage "
+                "(add ARG_BUILDERS/ENTRY_STATICS in jaxpr_audit.py)"))
+
+    plan = [n for n in ARG_BUILDERS
+            if n in specs and n not in TWINS]
+    if names is not None:
+        plan = [n for n in plan if n in names]
+    if quick:
+        plan = [n for n in plan if n not in HEAVY]
+
+    if mesh is None:
+        mesh = _audit_mesh()
+    for name in plan:
+        spec = specs[name]
+        if spec.sharded and mesh is None:
+            skipped.append(name)
+            continue
+        _audit_one(spec, dict(ENTRY_STATICS[name]), mesh, metrics,
+                   findings, reports, dims)
+
+    # chunk invariance: chunking the sharded fused verify must add
+    # ZERO collectives (the chunk loop is shard-local)
+    name = "sharded_step_seq_signed"
+    if (name in plan and mesh is not None
+            and not any(f.where == name for f in findings)):
+        base = next(r.collectives for r in reports if r.entry == name)
+        statics = dict(ENTRY_STATICS[name], verify_chunk=1)
+        traced = trace_entry(specs[name], statics, mesh, dims)
+        chunked = collective_census(traced.jaxpr.jaxpr)
+        if chunked != base:
+            findings.append(Finding(
+                "jaxpr", "AUD002", name,
+                f"verify_chunk changes the collective census: "
+                f"unchunked {base} vs chunk=1 {chunked} (chunking "
+                f"must add zero collectives per chunk)"))
+        if metrics is not None:
+            from agnes_tpu.utils.metrics import ANALYSIS_ENTRIES_AUDITED
+
+            metrics.count(ANALYSIS_ENTRIES_AUDITED)
+    return AuditReport(findings=findings, entries=reports,
+                       skipped=skipped)

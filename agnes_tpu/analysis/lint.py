@@ -1,0 +1,266 @@
+"""Repo lint: AST rules for the mistakes that cost device time.
+
+  LINT001  host sync inside a serve/pipeline hot path —
+           ``.block_until_ready()``, ``np.asarray(...)`` or
+           ``float(...)`` in the functions that run between dispatches
+           forces a device fetch (or at best a host copy) on the path
+           whose whole point is to never wait on the device.  Known-
+           benign uses (host-built arrays, the documented fetch-mode
+           fallback) carry a ``# lint: allow`` pragma with the reason.
+  LINT002  import-time ``jax.jit`` outside the sanctioned registries —
+           a module-level jit entry that is NOT registered in
+           device/registry.py is an entry the jaxpr auditor cannot
+           enumerate and the retrace tripwire cannot name.  Checked by
+           IDENTITY against the live registry (import the module, look
+           the object up), so a registration in any form satisfies it.
+  LINT003  unhashable static-argnum candidate — a list/dict/set
+           literal passed to a known static argname at a call site
+           raises ``TypeError: unhashable`` only at runtime, usually
+           minutes into a TPU round; flag it at review time.
+
+Pragma: ``# lint: allow`` on the offending line (reason after the
+marker), mirroring lockcheck's.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from agnes_tpu.analysis.jaxpr_audit import Finding
+
+PRAGMA = "lint: allow"
+
+#: hot-path functions per file (repo-relative): the code that runs
+#: between device dispatches on the serve plane
+HOT_PATHS: Dict[str, Set[str]] = {
+    "agnes_tpu/serve/pipeline.py": {
+        "stage", "_build_all", "_build_one", "dispatch_staged", "pump",
+        "_sync_window", "_entry_phase",
+    },
+    "agnes_tpu/serve/service.py": {
+        "submit", "pump", "_close_batch", "_pump_batch",
+    },
+    "agnes_tpu/serve/threaded.py": {
+        "submit", "_submit_loop", "_dispatch_loop",
+    },
+    "agnes_tpu/harness/device_driver.py": {
+        "step_async",
+    },
+}
+
+#: static argnames across the registered entries (device/registry.py);
+#: call sites passing unhashable literals to these are LINT003
+STATIC_KWARGS = frozenset({
+    "axis_name", "advance_height", "verify_chunk", "heights", "donate",
+})
+
+#: modules sanctioned to DEFINE import-time jits; everything they
+#: define must still be registered (identity check)
+SANCTIONED_JIT_MODULES = ("agnes_tpu/device/step.py",
+                          "agnes_tpu/parallel/sharded.py")
+
+
+def _has_pragma(lines, lineno: int) -> bool:
+    return lineno - 1 < len(lines) and PRAGMA in lines[lineno - 1]
+
+
+# -- LINT001: host syncs in hot paths ----------------------------------------
+
+class _HotPathVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, source: str, hot: Set[str]):
+        self.relpath = relpath
+        self.lines = source.splitlines()
+        self.hot = hot
+        self.findings: List[Finding] = []
+        self._depth = 0                # inside a hot function?
+
+    def _find(self, node, what: str) -> None:
+        if _has_pragma(self.lines, node.lineno):
+            return
+        self.findings.append(Finding(
+            "lint", "LINT001", f"{self.relpath}:{node.lineno}",
+            f"{what} inside serve hot path — a host sync on the "
+            f"never-wait-on-device path (annotate `# {PRAGMA} "
+            f"(reason)` if provably host-side)"))
+
+    def visit_FunctionDef(self, node) -> None:
+        inside = node.name in self.hot
+        if inside:
+            self._depth += 1
+        self.generic_visit(node)
+        if inside:
+            self._depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._depth:
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "block_until_ready":
+                    self._find(node, ".block_until_ready()")
+                elif (f.attr == "asarray"
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id in ("np", "numpy")):
+                    self._find(node, "np.asarray(...)")
+            elif isinstance(f, ast.Name) and f.id == "float" \
+                    and node.args:
+                self._find(node, "float(...) on a possibly-device value")
+        self.generic_visit(node)
+
+
+def check_hot_paths(repo_root: str,
+                    hot_paths: Optional[Dict[str, Set[str]]] = None
+                    ) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, hot in (hot_paths or HOT_PATHS).items():
+        path = os.path.join(repo_root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path) as fh:
+            src = fh.read()
+        v = _HotPathVisitor(rel, src, hot)
+        v.visit(ast.parse(src, filename=rel))
+        findings.extend(v.findings)
+    return findings
+
+
+# -- LINT002: unregistered import-time jits ----------------------------------
+
+def _is_jit_call(node) -> bool:
+    """ast matches `jax.jit(...)` or `functools.partial(jax.jit, ...)`
+    / `partial(jax.jit, ...)`."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit" \
+            and isinstance(f.value, ast.Name) and f.value.id == "jax":
+        return True
+    is_partial = (isinstance(f, ast.Name) and f.id == "partial") or \
+        (isinstance(f, ast.Attribute) and f.attr == "partial")
+    if is_partial and node.args:
+        a = node.args[0]
+        return (isinstance(a, ast.Attribute) and a.attr == "jit"
+                and isinstance(a.value, ast.Name)
+                and a.value.id == "jax")
+    return False
+
+
+def _module_level_jits(tree) -> List[Tuple[str, int]]:
+    """(name, lineno) of import-time jit objects: module-level
+    `name = jax.jit(...)` assignments and `@jax.jit`-family decorated
+    module-level defs."""
+    out: List[Tuple[str, int]] = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.append((t.id, node.lineno))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_call(dec) or (
+                        isinstance(dec, ast.Attribute)
+                        and dec.attr == "jit"
+                        and isinstance(dec.value, ast.Name)
+                        and dec.value.id == "jax"):
+                    out.append((node.name, node.lineno))
+    return out
+
+
+def check_import_time_jits(repo_root: str,
+                           registered_check=None,
+                           importer=None) -> List[Finding]:
+    """Every module-level jit under agnes_tpu/ must be a REGISTERED
+    entry (identity against device/registry.py).  `registered_check`
+    and `importer` are injectable for fixtures; they default to the
+    live registry (after importing the canonical modules) and
+    importlib."""
+    import importlib
+
+    if registered_check is None:
+        from agnes_tpu.device import registry
+
+        registry.ensure_populated()
+        registered_check = registry.is_registered_jit
+    if importer is None:
+        importer = importlib.import_module
+
+    findings: List[Finding] = []
+    pkg_root = os.path.join(repo_root, "agnes_tpu")
+    for root, _, names in os.walk(pkg_root):
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, repo_root)
+            with open(path) as fh:
+                src = fh.read()
+            jits = _module_level_jits(ast.parse(src, filename=rel))
+            if not jits:
+                continue
+            mod_name = rel[:-3].replace(os.sep, ".")
+            try:
+                mod = importer(mod_name)
+            except Exception as e:  # noqa: BLE001 — unimportable module
+                findings.append(Finding(
+                    "lint", "LINT002", rel,
+                    f"module defines import-time jit(s) but failed to "
+                    f"import for registration check: {e!r}"))
+                continue
+            for jname, lineno in jits:
+                obj = getattr(mod, jname, None)
+                if obj is None or not registered_check(obj):
+                    findings.append(Finding(
+                        "lint", "LINT002", f"{rel}:{lineno}",
+                        f"import-time jit {jname!r} is not a "
+                        f"registered entry (device/registry.py) — the "
+                        f"jaxpr auditor cannot enumerate it"))
+    return findings
+
+
+# -- LINT003: unhashable static candidates -----------------------------------
+
+class _StaticKwVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.lines = source.splitlines()
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg in STATIC_KWARGS and isinstance(
+                    kw.value, (ast.List, ast.Dict, ast.Set)) \
+                    and not _has_pragma(self.lines, node.lineno):
+                self.findings.append(Finding(
+                    "lint", "LINT003",
+                    f"{self.relpath}:{node.lineno}",
+                    f"unhashable {type(kw.value).__name__.lower()} "
+                    f"literal passed to static argname "
+                    f"{kw.arg!r} — TypeError at trace time"))
+        self.generic_visit(node)
+
+
+def check_static_kwargs(repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    pkg_root = os.path.join(repo_root, "agnes_tpu")
+    for root, _, names in os.walk(pkg_root):
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, repo_root)
+            with open(path) as fh:
+                src = fh.read()
+            v = _StaticKwVisitor(rel, src)
+            v.visit(ast.parse(src, filename=rel))
+            findings.extend(v.findings)
+    return findings
+
+
+def check_repo(repo_root: str) -> List[Finding]:
+    """All three rules over the repo."""
+    return (check_hot_paths(repo_root)
+            + check_import_time_jits(repo_root)
+            + check_static_kwargs(repo_root))

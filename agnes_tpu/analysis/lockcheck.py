@@ -1,0 +1,263 @@
+"""Serve lock-order lint: the threaded host's two-lock discipline,
+checked at the AST (plus a runtime instrumented-lock mode for tests).
+
+serve/threaded.py promises `submit` is wait-free relative to in-flight
+XLA dispatch.  The whole promise is a lock discipline no test can see
+break until it deadlocks or stalls in production:
+
+  LOCK001  bare ``.acquire()``/``.release()`` — a raised exception
+           between the two leaks the lock forever; every acquisition
+           must be a ``with`` block
+  LOCK002  inconsistent order — the device lock acquired OUTSIDE an
+           admission acquisition anywhere means two call paths can
+           deadlock; the global order is admission -> device
+  LOCK003  admission lock held across a device dispatch / XLA call —
+           the exact stall the _close_batch/_pump_batch split removed:
+           a multi-second XLA call under the admission lock blocks
+           every producer
+  LOCK004  admission lock held across a device-lock ACQUISITION —
+           even in the right order, holding admission while waiting
+           on the device lock serializes submit behind device work
+
+Suppressions are explicit and greppable: a ``# lockcheck: allow``
+comment on the ``with`` line (reason after the marker).  The one
+sanctioned use is ThreadedVoteService.drain's quiescent section —
+both loop threads are joined before it runs, so holding both locks is
+deliberate (the pass SURFACED that hold; review concluded quiescence,
+and the pragma records it).
+
+Runtime mode: `InstrumentedLock` wraps the two locks with a per-thread
+held-stack that asserts the same order discipline on every real
+acquisition — the threaded tests run their concurrency scenarios over
+`instrument()`-ed services, so the static rule and the runtime
+behavior cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from agnes_tpu.analysis.jaxpr_audit import Finding
+
+#: attribute names of the two serve locks
+ADMISSION_LOCKS = frozenset({"_admission"})
+DEVICE_LOCKS = frozenset({"_device"})
+
+#: attribute calls that are (or directly wrap) device dispatch / XLA
+#: work — forbidden under the admission lock
+DISPATCH_CALLS = frozenset({
+    "step", "step_seq", "step_seq_signed", "step_seq_signed_dense",
+    "step_async", "run_heights_fused", "pump", "_pump_batch",
+    "dispatch_staged", "settle", "collect", "block_until_ready",
+    "warmup", "drain", "poll_decisions", "device_put",
+})
+
+PRAGMA = "lockcheck: allow"
+
+
+def _lock_name(node) -> Optional[str]:
+    """The lock attribute acquired by a with-item expression, if any."""
+    if isinstance(node, ast.Attribute) and \
+            node.attr in (ADMISSION_LOCKS | DEVICE_LOCKS):
+        return node.attr
+    return None
+
+
+def _has_pragma(source_lines, lineno: int) -> bool:
+    line = source_lines[lineno - 1] if lineno - 1 < len(source_lines) \
+        else ""
+    return PRAGMA in line
+
+
+class _LockVisitor(ast.NodeVisitor):
+    def __init__(self, filename: str, source: str):
+        self.filename = filename
+        self.lines = source.splitlines()
+        self.findings: List[Finding] = []
+        self.held: List[str] = []          # lock attrs held, outer first
+
+    def _find(self, code: str, node, msg: str) -> None:
+        self.findings.append(Finding(
+            "locks", code, f"{self.filename}:{node.lineno}", msg))
+
+    # -- bare acquire/release ------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("acquire",
+                                                       "release"):
+            if not _has_pragma(self.lines, node.lineno):
+                self._find(
+                    "LOCK001", node,
+                    f"bare .{f.attr}() — an exception between acquire "
+                    f"and release leaks the lock; use a `with` block")
+        self._check_dispatch(node)
+        self.generic_visit(node)
+
+    def _check_dispatch(self, node: ast.Call) -> None:
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in DISPATCH_CALLS):
+            return
+        admission_held = any(h in ADMISSION_LOCKS for h in self.held)
+        device_held = any(h in DEVICE_LOCKS for h in self.held)
+        if admission_held and not device_held \
+                and not _has_pragma(self.lines, node.lineno):
+            self._find(
+                "LOCK003", node,
+                f".{f.attr}() under the admission lock — a device/"
+                f"XLA call here blocks every producer for its whole "
+                f"duration (move it under the device lock; see "
+                f"VoteService._close_batch/_pump_batch)")
+
+    # -- with blocks ---------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = [n for n in
+                    (_lock_name(item.context_expr)
+                     for item in node.items) if n]
+        allow = _has_pragma(self.lines, node.lineno)
+        pushed = 0
+        for name in acquired:
+            if not allow:
+                self._order_check(name, node)
+            self.held.append(name)
+            pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - pushed:]
+
+    def _order_check(self, name: str, node) -> None:
+        admission_held = any(h in ADMISSION_LOCKS for h in self.held)
+        if name in ADMISSION_LOCKS and \
+                any(h in DEVICE_LOCKS for h in self.held):
+            self._find(
+                "LOCK002", node,
+                "admission lock acquired while holding the device "
+                "lock — inverts the global admission -> device order "
+                "(deadlock with any in-order path)")
+        if name in DEVICE_LOCKS and admission_held:
+            self._find(
+                "LOCK004", node,
+                "device lock acquired while holding the admission "
+                "lock — submit serializes behind device work for the "
+                "whole wait (quiescent shutdown sections may annotate "
+                f"`# {PRAGMA} (reason)`)")
+
+
+def check_source(source: str, filename: str = "<string>"
+                 ) -> List[Finding]:
+    tree = ast.parse(source, filename=filename)
+    v = _LockVisitor(filename, source)
+    v.visit(tree)
+    return v.findings
+
+
+def check_paths(paths) -> List[Finding]:
+    """Lint every .py file under the given files/directories."""
+    import os
+
+    findings: List[Finding] = []
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, names in os.walk(p):
+                files.extend(os.path.join(root, n) for n in names
+                             if n.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    for fpath in sorted(files):
+        with open(fpath, "r") as fh:
+            src = fh.read()
+        findings.extend(check_source(src, filename=fpath))
+    return findings
+
+
+def default_paths(repo_root: str) -> List[str]:
+    """The surfaces the two-lock discipline (and the no-bare-acquire
+    rule) applies to."""
+    import os
+
+    return [os.path.join(repo_root, "agnes_tpu", "serve"),
+            os.path.join(repo_root, "agnes_tpu", "utils",
+                         "metrics.py")]
+
+
+# -- runtime instrumented-lock mode -------------------------------------------
+
+@dataclass
+class LockOrderState:
+    """Shared recorder for a set of InstrumentedLocks: per-thread held
+    stack + violation log (thread-safe)."""
+
+    violations: List[str] = field(default_factory=list)
+    acquisitions: int = 0
+    _tls: threading.local = field(default_factory=threading.local)
+    _mu: threading.Lock = field(default_factory=threading.Lock)
+
+    def stack(self) -> List[Tuple[str, int]]:
+        if not hasattr(self._tls, "held"):
+            self._tls.held = []
+        return self._tls.held
+
+
+class InstrumentedLock:
+    """A threading.Lock that asserts the global acquisition order at
+    runtime.  `rank` orders the locks (admission=0 < device=1); an
+    acquisition while holding an equal-or-higher rank is a violation
+    — recorded, and raised when `strict`."""
+
+    def __init__(self, name: str, rank: int, state: LockOrderState,
+                 strict: bool = True):
+        self.name = name
+        self.rank = rank
+        self.state = state
+        self.strict = strict
+        self._lock = threading.Lock()
+
+    def __enter__(self):
+        held = self.state.stack()
+        bad = [n for n, r in held if r >= self.rank]
+        if bad:
+            msg = (f"lock order violation: acquiring {self.name!r} "
+                   f"(rank {self.rank}) while holding {bad}")
+            with self.state._mu:
+                self.state.violations.append(msg)
+            if self.strict:
+                raise AssertionError(msg)
+        self._lock.acquire()  # lockcheck: allow (the wrapper IS the with)
+        held.append((self.name, self.rank))
+        with self.state._mu:
+            self.state.acquisitions += 1
+        return self
+
+    def __exit__(self, *exc):
+        self.state.stack().remove((self.name, self.rank))
+        self._lock.release()  # lockcheck: allow (wrapper __exit__)
+        return False
+
+    # the bare-call API stays available for foreign code, but counts
+    # as a violation — the static rule LOCK001 made executable
+    def acquire(self, *a, **kw):
+        with self.state._mu:
+            self.state.violations.append(
+                f"bare acquire() on {self.name!r}")
+        return self._lock.acquire(*a, **kw)  # lockcheck: allow (delegate)
+
+    def release(self):
+        return self._lock.release()  # lockcheck: allow (delegate)
+
+
+def instrument(threaded_service, strict: bool = True) -> LockOrderState:
+    """Swap a ThreadedVoteService's two locks for instrumented ones
+    (BEFORE start()); returns the shared order state the test asserts
+    on."""
+    state = LockOrderState()
+    threaded_service._admission = InstrumentedLock(
+        "_admission", 0, state, strict)
+    threaded_service._device = InstrumentedLock(
+        "_device", 1, state, strict)
+    return state

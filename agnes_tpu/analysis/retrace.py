@@ -1,0 +1,226 @@
+"""Recompile tripwire: a trace-count sentinel for drivers/pipelines.
+
+The PR 3 incident: every sharded entry compiled TWICE for two rounds
+— the first dispatch passed fresh UNCOMMITTED host arrays, every later
+one the committed sharded outputs, and the jit cache (which keys on
+input shardings) built the same graph twice at ~217s per extra trace.
+Nothing failed; the stall just rode along.  This module turns that
+class of bug — plus the serve ladder's no-recompile invariant
+(`offladder_builds` asserted 0) — into one mechanically-checked
+property:
+
+* Every dispatch computes a cheap **shape signature** of its concrete
+  arguments: entry name + resolved statics + per-leaf (shape, dtype,
+  sharding key).  The sharding key normalizes through the HLO sharding
+  (NamedSharding and GSPMDSharding of the same placement agree), so
+  committed-vs-uncommitted is VISIBLE in the signature — exactly what
+  the jit cache sees.
+* **Unarmed (learning)**: signatures are recorded as the expected set.
+  Even unarmed, the sentinel fails loudly when one (entry, statics,
+  shapes) key shows up under TWO different sharding keys — the PR 3
+  double-compile, caught on the second dispatch instead of two rounds
+  later.
+* **Armed**: `ServePipeline.warmup()` registers the closed set of
+  expected traces from the ShapeLadder + warmup plan, then arms the
+  sentinel; ANY signature outside the set fails loudly and bumps the
+  `retrace_unexpected` counter (utils/metrics.py) — an off-ladder
+  shape, an unwarmed phase count, a sharding drift.
+
+Opt-in: `DeviceDriver(..., audit=True)` installs the sentinel on every
+dispatch path; `ServePipeline.warmup()` arms it when present.
+
+The pure-host half — `warmup_covers()` — is the static proof the CLI
+pass runs: every shape the serve plane can dispatch (builds capped at
+the top rung, lanes padded onto rungs, entry-prepend policy => P in
+{2, 3}) must be covered by the warmup plan, checked without building a
+single array.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class RetraceError(RuntimeError):
+    """An unexpected trace signature reached a dispatch entry."""
+
+
+def _sharding_key(x) -> object:
+    """Normalized sharding of one leaf — what the jit cache would key
+    on.  Host arrays (numpy/python) key as "host"; jax Arrays key by
+    (HLO sharding repr, device ids), which is stable across the
+    NamedSharding the driver places and the GSPMD sharding jit outputs
+    come back with."""
+    s = getattr(x, "sharding", None)
+    if s is None:
+        return "host"
+    try:
+        ndim = getattr(x, "ndim", 0)
+        hlo = s._to_xla_hlo_sharding(ndim)
+        devs = tuple(sorted(d.id for d in s.device_set))
+        return (repr(hlo), devs)
+    except Exception:  # noqa: BLE001 — exotic shardings: repr fallback
+        return str(s)
+
+
+def signature(args, statics: Tuple = ()) -> Tuple:
+    """Hashable shape signature of a dispatch's argument pytree."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(args)
+    return (tuple(statics),
+            tuple((tuple(getattr(x, "shape", ())),
+                   str(getattr(x, "dtype", type(x).__name__)),
+                   _sharding_key(x)) for x in leaves))
+
+
+def _shapes_only(sig: Tuple) -> Tuple:
+    statics, leaves = sig
+    return (statics, tuple((shape, dt) for shape, dt, _ in leaves))
+
+
+class RetraceSentinel:
+    """Trace-signature sentinel (module docstring).  Thread-safe: the
+    serve plane's dispatch thread and a caller's drain may observe
+    concurrently."""
+
+    def __init__(self, metrics=None, strict: bool = True):
+        from agnes_tpu.utils.metrics import Metrics
+
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.strict = strict
+        self.armed = False
+        self.expected: Set[Tuple] = set()
+        self.unexpected: List[Tuple] = []
+        #: (entry, statics+shapes) -> set of full signatures; >1 full
+        #: signature per key == same graph traced under two shardings
+        self._variants: Dict[Tuple, Set[Tuple]] = {}
+        self._observed: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- recording ----------------------------------------------------------
+
+    def expect(self, entry: str, sig: Tuple) -> None:
+        """Register one expected (entry, signature) — the warmup plan
+        calls this through observe() while unarmed."""
+        with self._lock:
+            self._expect_locked(entry, sig)
+
+    def _expect_locked(self, entry: str, sig: Tuple) -> None:
+        from agnes_tpu.utils.metrics import ANALYSIS_ENTRIES_AUDITED
+
+        if (entry, sig) not in self.expected:
+            self.expected.add((entry, sig))
+            # each distinct vetted signature is one audited entry
+            # shape — hardware rounds export this alongside
+            # retrace_unexpected so "the audit ran clean" is a
+            # recorded fact, not a vibe
+            self.metrics.count(ANALYSIS_ENTRIES_AUDITED)
+
+    def observe(self, entry: str, sig: Tuple) -> None:
+        """Record a dispatch signature; raise (and count
+        `retrace_unexpected`) on any trace outside the expected set
+        once armed, or on a sharding-variant duplicate at any time."""
+        from agnes_tpu.utils.metrics import RETRACE_UNEXPECTED
+
+        key = (entry, _shapes_only(sig))
+        with self._lock:
+            self._observed[entry] = self._observed.get(entry, 0) + 1
+            variants = self._variants.setdefault(key, set())
+            is_new_variant = sig not in variants and bool(variants)
+            variants.add(sig)
+            if is_new_variant:
+                self.unexpected.append((entry, sig))
+                self.metrics.count(RETRACE_UNEXPECTED)
+                if self.strict:
+                    raise RetraceError(
+                        f"entry {entry!r} dispatched with the SAME "
+                        f"shapes under {len(variants)} different "
+                        f"shardings — the same graph will trace/"
+                        f"compile once per variant (the PR 3 "
+                        f"double-compile class; commit the driver "
+                        f"state once, e.g. place_step_state)")
+                return
+            if not self.armed:
+                self._expect_locked(entry, sig)
+                return
+            if (entry, sig) not in self.expected:
+                self.unexpected.append((entry, sig))
+                self.metrics.count(RETRACE_UNEXPECTED)
+                if self.strict:
+                    raise RetraceError(
+                        f"unexpected trace: entry {entry!r} dispatched "
+                        f"with a signature outside the warmed set "
+                        f"({len(self.expected)} expected) — an "
+                        f"off-ladder shape or an unwarmed phase count "
+                        f"would compile LIVE on the serve path")
+
+    def arm(self) -> "RetraceSentinel":
+        """Close the expected set: every signature observed so far is
+        legal, anything else fails loudly."""
+        with self._lock:
+            self.armed = True
+        return self
+
+    # -- reporting ----------------------------------------------------------
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "armed": self.armed,
+                "entries_observed": dict(self._observed),
+                "expected_signatures": len(self.expected),
+                "unexpected": len(self.unexpected),
+            }
+
+
+# -- static warmup-coverage proof (CLI retrace pass) --------------------------
+
+def dispatchable_shapes(ladder, dense: bool = False,
+                        ) -> Set[Tuple[int, Optional[int]]]:
+    """Every (P, rung) shape the serve pipeline CAN dispatch on the
+    signed path, derived from its build policy without building
+    anything: builds are capped at the top rung and padded onto a
+    ladder rung (packed-lane mode; `lane_floor = min_rung`), and the
+    entry-prepend policy makes the step-sequence length P = 1 entry +
+    {1, 2} vote classes.  Dense mode's compile key is (P, I, V) — rung
+    is not part of it, so the rung slot is None."""
+    ps = (2, 3)
+    if dense:
+        return {(p, None) for p in ps}
+    return {(p, r) for p in ps for r in ladder.rungs}
+
+
+def warmup_shapes(ladder, n_phases=(2, 3), dense: bool = False,
+                  ) -> Set[Tuple[int, Optional[int]]]:
+    """The (P, rung) set ServePipeline.warmup(n_phases) precompiles
+    (mirrors its loop structure; see pipeline.warmup docstring)."""
+    if isinstance(n_phases, int):
+        n_phases = (n_phases,)
+    if dense:
+        return {(p, None) for p in n_phases}
+    return {(p, r) for p in n_phases for r in ladder.rungs}
+
+
+def warmup_covers(ladder, n_phases=(2, 3), dense: bool = False) -> bool:
+    """True iff every dispatchable signed shape is warmed — the
+    no-live-compile invariant, provable statically."""
+    return dispatchable_shapes(ladder, dense) <= warmup_shapes(
+        ladder, n_phases, dense)
+
+
+def coverage_findings(ladder, n_phases=(2, 3), dense: bool = False
+                      ) -> List:
+    """Finding list form of warmup_covers for the CLI."""
+    from agnes_tpu.analysis.jaxpr_audit import Finding
+
+    missing = dispatchable_shapes(ladder, dense) - warmup_shapes(
+        ladder, n_phases, dense)
+    if not missing:
+        return []
+    return [Finding(
+        "retrace", "RET001", "ServePipeline.warmup",
+        f"dispatchable signed shapes not covered by the warmup plan "
+        f"{tuple(n_phases)}: {sorted(missing)} — each would compile "
+        f"LIVE mid-service")]

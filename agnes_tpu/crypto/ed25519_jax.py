@@ -243,6 +243,12 @@ def verify_batch(pub: jnp.ndarray, sig: jnp.ndarray,
 
 verify_batch_jit = jax.jit(verify_batch)
 
+from agnes_tpu.device import registry as _registry  # noqa: E402
+
+_registry.register(_registry.EntrySpec(
+    name="verify_batch", fn=verify_batch, jit=verify_batch_jit,
+    hot=False))
+
 
 def pack_verify_inputs_host(pubs, msgs, sigs):
     """Host packer for tests/benchmarks: lists of (32B pub, bytes msg,

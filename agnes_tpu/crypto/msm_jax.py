@@ -285,6 +285,12 @@ def verify_batch_msm(pub: jnp.ndarray, sig: jnp.ndarray,
 
 verify_batch_msm_jit = jax.jit(verify_batch_msm)
 
+from agnes_tpu.device import registry as _registry  # noqa: E402
+
+_registry.register(_registry.EntrySpec(
+    name="verify_batch_msm", fn=verify_batch_msm,
+    jit=verify_batch_msm_jit, hot=False))
+
 
 def _pad_pow2(arr: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.pad(arr, [(0, n - arr.shape[0])]

@@ -273,3 +273,10 @@ def pow_p_pallas(x_limbs: jnp.ndarray, e: int, interpret: bool = False,
                  b_tile: int = B_TILE) -> jnp.ndarray:
     """Drop-in for field_jax.pow_p ([B, 20] layout)."""
     return _pow_pallas_impl(x_limbs, e, interpret, b_tile)
+
+
+from agnes_tpu.device import registry as _registry  # noqa: E402
+
+_registry.register(_registry.EntrySpec(
+    name="pallas_pow_p", fn=_pow_pallas_impl, jit=_pow_pallas_impl,
+    statics=("e", "interpret", "b_tile"), hot=False))

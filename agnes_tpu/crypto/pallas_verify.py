@@ -673,3 +673,10 @@ def _verify_jit(pub, sig, msg_blocks, interpret: bool, window: int = 4):
 
     ok = ok.reshape(b_pad)[:B] > 0
     return ok & ok_s
+
+
+from agnes_tpu.device import registry as _registry  # noqa: E402
+
+_registry.register(_registry.EntrySpec(
+    name="pallas_verify", fn=_verify_jit, jit=_verify_jit,
+    statics=("interpret", "window"), hot=False))

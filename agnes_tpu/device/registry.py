@@ -1,0 +1,154 @@
+"""Central registry of every jit entry the consensus planes dispatch.
+
+One name -> EntrySpec table replacing the hand-maintained entry lists
+that used to live in three places at once (DeviceDriver's import
+block, ServePipeline.warmup's import block, and whatever audit script
+was being written that week).  Three consumers:
+
+* **DeviceDriver / ServePipeline** resolve their dispatch entries here
+  (`jit_entry(name)`), so the driver, the serve warmup, and any audit
+  all agree on WHICH compiled object a name means — and tests can
+  `override()` an entry with a stub to exercise host-side machinery
+  with zero XLA compiles.
+* **The static analyzer** (`agnes_tpu/analysis/jaxpr_audit.py`)
+  enumerates `entries()` and abstractly traces each one: donation
+  honored, collective census, no host callbacks, dtype policy.  An
+  entry that is not registered is an entry the auditor cannot see —
+  which is why `analysis/lint.py` flags any import-time `jax.jit`
+  whose result is not registered here.
+* **The retrace tripwire** (`analysis/retrace.py`) keys its expected
+  (entry, shape-signature) sets by registry name.
+
+Registration happens at the DEFINING module's import time (step.py,
+parallel/sharded.py, device/tally.py, crypto/...), so the table is
+complete exactly when those modules are importable; `entries()`
+imports the canonical module list first so enumeration never depends
+on what the caller happened to import.
+
+This module is a leaf: it imports nothing from the rest of the
+package (the registered objects are passed IN), so any module may
+import it without cycles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+#: modules whose import populates the full registry (ordered; heavy
+#: crypto modules last).  entries()/ensure_populated() import these.
+CANONICAL_MODULES = (
+    "agnes_tpu.device.state_machine",
+    "agnes_tpu.device.tally",
+    "agnes_tpu.device.step",
+    "agnes_tpu.parallel.sharded",
+    "agnes_tpu.crypto.ed25519_jax",
+    "agnes_tpu.crypto.msm_jax",
+    "agnes_tpu.crypto.pallas_verify",
+    "agnes_tpu.crypto.pallas_ed25519",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EntrySpec:
+    """One registered jit entry (or sharded-entry factory).
+
+    `statics` names the entry's static argnames; `donated` the
+    donate_argnums its jit was built with (the auditor asserts the
+    LOWERED text actually carries the aliasing/donor attrs — a spec
+    that claims donation its jit does not implement is a finding).
+    `hot` marks serve/offline hot-path entries: the auditor requires
+    abstract-args coverage for them and the lint treats their call
+    sites as host-sync-sensitive.  `sharded` entries register the
+    FACTORY (mesh, **statics) -> jitted fn instead of a jit object."""
+
+    name: str
+    fn: Callable                       # the traceable python function
+    jit: Optional[Callable] = None     # jitted entry (None for sharded)
+    statics: Tuple[str, ...] = ()
+    donated: Tuple[int, ...] = ()
+    sharded: bool = False
+    factory: Optional[Callable] = None  # sharded: (mesh, **statics)
+    hot: bool = True                    # audited hot-path entry
+
+    def __post_init__(self):
+        if self.sharded:
+            assert self.factory is not None, self.name
+        else:
+            assert self.jit is not None, self.name
+
+
+_REGISTRY: Dict[str, EntrySpec] = {}
+
+
+def register(spec: EntrySpec) -> EntrySpec:
+    """Idempotent by name: re-importing a defining module re-registers
+    the same spec; a DIFFERENT spec under an existing name — any field
+    differing, including the jit/factory OBJECT identity — is a
+    programming error (two modules claiming one entry, or a reload
+    rebuilding a jit the auditor already vouched for)."""
+    old = _REGISTRY.get(spec.name)
+    if old is not None and old != spec:
+        raise ValueError(f"jit entry {spec.name!r} already registered "
+                         f"with a different spec")
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get(name: str) -> EntrySpec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown jit entry {name!r}; registered: "
+            f"{sorted(_REGISTRY)}") from None
+
+
+def jit_entry(name: str) -> Callable:
+    """The dispatchable object for `name` — the driver/pipeline seam
+    (tests override() this to stub device dispatch)."""
+    return get(name).jit
+
+
+def names() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def is_registered_jit(obj) -> bool:
+    """Identity check used by analysis/lint.py's import-time-jit rule:
+    a module-level jit object is sanctioned iff it IS some registered
+    entry's jit (or a registered factory's memoized product — those
+    are created inside functions, not at import, so only `jit` is
+    checked here)."""
+    return any(s.jit is obj for s in _REGISTRY.values())
+
+
+def ensure_populated() -> None:
+    """Import the canonical defining modules so enumeration is
+    complete regardless of caller import order."""
+    import importlib
+
+    for m in CANONICAL_MODULES:
+        importlib.import_module(m)
+
+
+def entries(hot_only: bool = False) -> Tuple[EntrySpec, ...]:
+    ensure_populated()
+    out = tuple(_REGISTRY[n] for n in sorted(_REGISTRY))
+    if hot_only:
+        out = tuple(s for s in out if s.hot)
+    return out
+
+
+@contextlib.contextmanager
+def override(name: str, **changes):
+    """Temporarily replace fields of a registered spec (tests stub
+    `jit=` to run pipeline/driver machinery with zero XLA compiles).
+    Restores the original spec on exit, always."""
+    old = get(name)
+    _REGISTRY[name] = dataclasses.replace(old, **changes)
+    try:
+        yield _REGISTRY[name]
+    finally:
+        _REGISTRY[name] = old

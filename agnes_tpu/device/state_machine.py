@@ -151,3 +151,8 @@ def apply_scalar(s: DeviceState, ev: DeviceEvent
 
 # Batched transition: one event per instance, [n] leaves.
 apply_batch = jax.jit(jax.vmap(apply_scalar))
+
+from agnes_tpu.device import registry as _registry  # noqa: E402
+
+_registry.register(_registry.EntrySpec(
+    name="apply_batch", fn=apply_scalar, jit=apply_batch, hot=False))

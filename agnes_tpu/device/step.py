@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from agnes_tpu.core.state_machine import EventTag, MsgTag, Step
+from agnes_tpu.device import registry as _registry
 from agnes_tpu.device.encoding import I32, DeviceEvent, DeviceMessage, DeviceState
 from agnes_tpu.device.state_machine import apply_scalar
 from agnes_tpu.device.tally import (
@@ -615,3 +616,40 @@ def honest_heights(state: DeviceState,
 
 honest_heights_jit = jax.jit(
     honest_heights, static_argnames=("heights", "axis_name"))
+
+
+# -- entry registry -----------------------------------------------------------
+# Every jit entry above is registered by name (device/registry.py) so
+# DeviceDriver/ServePipeline resolve ONE table, the static analyzer
+# (analysis/jaxpr_audit.py) can enumerate and abstractly trace every
+# entry, and the retrace tripwire (analysis/retrace.py) keys its
+# expected-trace sets.  Adding a jit entry without registering it is
+# caught by analysis/lint.py's import-time-jit rule.
+
+def _reg(name, fn, jit_fn, statics, donated=()):
+    _registry.register(_registry.EntrySpec(
+        name=name, fn=fn, jit=jit_fn, statics=tuple(statics),
+        donated=tuple(donated)))
+
+
+_STEP_STATICS = ("axis_name", "advance_height")
+_SIGNED_STATICS = ("advance_height", "verify_chunk")
+_DENSE_STATICS = ("axis_name", "advance_height", "verify_chunk")
+_reg("consensus_step", consensus_step, consensus_step_jit, _STEP_STATICS)
+_reg("consensus_step_seq", consensus_step_seq, consensus_step_seq_jit,
+     _STEP_STATICS)
+_reg("consensus_step_seq_donated", consensus_step_seq,
+     consensus_step_seq_donated_jit, _STEP_STATICS, donated=(0, 1))
+_reg("consensus_step_seq_signed", consensus_step_seq_signed,
+     consensus_step_seq_signed_jit, _SIGNED_STATICS)
+_reg("consensus_step_seq_signed_donated", consensus_step_seq_signed,
+     consensus_step_seq_signed_donated_jit, _SIGNED_STATICS,
+     donated=(0, 1))
+_reg("consensus_step_seq_signed_dense", consensus_step_seq_signed_dense,
+     consensus_step_seq_signed_dense_jit, _DENSE_STATICS)
+_reg("consensus_step_seq_signed_dense_donated",
+     consensus_step_seq_signed_dense,
+     consensus_step_seq_signed_dense_donated_jit, _DENSE_STATICS,
+     donated=(0, 1))
+_reg("honest_heights", honest_heights, honest_heights_jit,
+     ("heights", "axis_name"))

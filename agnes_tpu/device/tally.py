@@ -348,3 +348,8 @@ def rotate_window(tally: TallyState, new_base: jnp.ndarray) -> TallyState:
 
 
 add_votes_jit = jax.jit(add_votes)
+
+from agnes_tpu.device import registry as _registry  # noqa: E402
+
+_registry.register(_registry.EntrySpec(
+    name="add_votes", fn=add_votes, jit=add_votes_jit, hot=False))

@@ -29,24 +29,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from agnes_tpu.core.state_machine import MsgTag
+from agnes_tpu.device import registry as _registry
 from agnes_tpu.device.encoding import I32, DeviceState
-from agnes_tpu.device.step import (
+from agnes_tpu.device.step import (  # noqa: F401 — registers entries
     DenseSignedPhases,
     ExtEvent,
     NULL_EVENT,
     VotePhase,
-    consensus_step_jit,
-    consensus_step_seq_donated_jit,
-    consensus_step_seq_jit,
-    consensus_step_seq_signed_dense_donated_jit,
-    consensus_step_seq_signed_dense_jit,
-    consensus_step_seq_signed_donated_jit,
-    consensus_step_seq_signed_jit,
-    honest_heights_jit,
 )
 from agnes_tpu.device.tally import TallyConfig, TallyState
 from agnes_tpu.types import NIL_ID, VoteType
 from agnes_tpu.core.state_machine import EventTag
+
+# Dispatch entries resolve through the registry at call time (ONE
+# name -> jit table shared with ServePipeline.warmup, the jaxpr
+# auditor and the retrace tripwire; tests registry.override() a name
+# to stub device dispatch with zero compiles).
+_jit = _registry.jit_entry
 
 
 @dataclass
@@ -68,7 +67,8 @@ class DeviceDriver:
                  proposer_is_self: bool = True,
                  advance_height: bool = False,
                  mesh=None, defer_collect: bool = False,
-                 verify_chunk=None, hbm_budget_bytes: int = None):
+                 verify_chunk=None, hbm_budget_bytes: int = None,
+                 audit: bool = False):
         """With `mesh` (flat data x val or hierarchical
         slice x data x val, parallel/mesh.py) the closed loop runs the
         shard_map-sharded step with every argument placed per the
@@ -90,8 +90,22 @@ class DeviceDriver:
         tile from the device HBM budget (`hbm_budget_bytes` override,
         else memory_stats/16 GiB default) — on a mesh the plan is made
         on the per-device LOCAL shape.  Chunked and unchunked paths
-        are bit-identical (tests/test_step_signed.py)."""
+        are bit-identical (tests/test_step_signed.py).
+
+        `audit=True` (or a ready RetraceSentinel) installs the
+        recompile tripwire (analysis/retrace.py) on every dispatch
+        path: each call's (entry, shape-signature) is observed, the
+        PR3 same-shapes-different-sharding double compile fails
+        loudly immediately, and ServePipeline.warmup() arms the
+        closed expected-trace set on top."""
         self.I, self.V = n_instances, n_validators
+        if audit:
+            from agnes_tpu.analysis.retrace import RetraceSentinel
+
+            self.sentinel = (audit if isinstance(audit, RetraceSentinel)
+                             else RetraceSentinel())
+        else:
+            self.sentinel = None
         self.advance_height = advance_height
         self.defer_collect = defer_collect
         self.verify_chunk = verify_chunk
@@ -236,6 +250,19 @@ class DeviceDriver:
         plan = self._verify_plans[key]
         return plan.tile if plan.chunked else None
 
+    # -- retrace tripwire ----------------------------------------------------
+
+    def _observe(self, entry: str, args, statics=()) -> None:
+        """Feed one dispatch's (entry, shape-signature) to the
+        sentinel when auditing (analysis/retrace.py) — unarmed it
+        learns the expected set (and still catches sharding-variant
+        double compiles); armed, any signature outside the set fails
+        loudly and bumps `retrace_unexpected`."""
+        if self.sentinel is not None:
+            from agnes_tpu.analysis.retrace import signature
+
+            self.sentinel.observe(entry, signature(args, statics))
+
     # -- phase builders ------------------------------------------------------
 
     def empty_phase(self) -> VotePhase:
@@ -278,15 +305,20 @@ class DeviceDriver:
         phase = phase if phase is not None else self.empty_phase()
         if self.mesh is not None:
             from agnes_tpu.parallel import shard_step_args
-            out = self._sharded_step(*shard_step_args(
+            args = shard_step_args(
                 self.mesh, self.state, self.tally, ext, phase,
                 self.powers, self.total, self.proposer_flag,
-                self.propose_value))
+                self.propose_value)
+            self._observe("sharded_step", args,
+                          (self.advance_height,))
+            out = self._sharded_step(*args)
         else:
-            out = consensus_step_jit(self.state, self.tally, ext, phase,
-                                     self.powers, self.total,
-                                     self.proposer_flag, self.propose_value,
-                                     advance_height=self.advance_height)
+            args = (self.state, self.tally, ext, phase, self.powers,
+                    self.total, self.proposer_flag, self.propose_value)
+            self._observe("consensus_step", args,
+                          (self.advance_height,))
+            out = _jit("consensus_step")(
+                *args, advance_height=self.advance_height)
         self.state, self.tally = out.state, out.tally
         self.stats.steps += 1
         self.stats.votes_ingested += int(np.asarray(phase.mask).sum())
@@ -306,16 +338,17 @@ class DeviceDriver:
         exts = exts if exts is not None else [self.ext()] * P
         phases_st = jax.tree.map(lambda *xs: jnp.stack(xs), *phases)
         exts_st = jax.tree.map(lambda *xs: jnp.stack(xs), *exts)
+        args = (self.state, self.tally, exts_st, phases_st, self.powers,
+                self.total, self.proposer_flag, self.propose_value)
         if self.mesh is not None:
-            out = self._sharded_step_seq(self.state, self.tally, exts_st,
-                                         phases_st, self.powers,
-                                         self.total, self.proposer_flag,
-                                         self.propose_value)
+            self._observe("sharded_step_seq", args,
+                          (self.advance_height, False))
+            out = self._sharded_step_seq(*args)
         else:
-            out = consensus_step_seq_jit(
-                self.state, self.tally, exts_st, phases_st, self.powers,
-                self.total, self.proposer_flag, self.propose_value,
-                advance_height=self.advance_height)
+            self._observe("consensus_step_seq", args,
+                          (self.advance_height,))
+            out = _jit("consensus_step_seq")(
+                *args, advance_height=self.advance_height)
         self.state, self.tally = out.state, out.tally
         self.stats.steps += P
         self.stats.votes_ingested += int(
@@ -345,12 +378,15 @@ class DeviceDriver:
                 "build_phases_device_dense), which shards the lanes "
                 "with the phases")
         phases_st, exts_st, P = self._stack_seq(phases, exts)
-        out = consensus_step_seq_signed_jit(
-            self.state, self.tally, exts_st, phases_st, lanes,
-            self.powers, self.total, self.proposer_flag,
-            self.propose_value, advance_height=self.advance_height,
-            verify_chunk=self._resolve_lane_chunk(
-                int(lanes.pub.shape[0])))
+        chunk = self._resolve_lane_chunk(int(lanes.pub.shape[0]))
+        args = (self.state, self.tally, exts_st, phases_st, lanes,
+                self.powers, self.total, self.proposer_flag,
+                self.propose_value)
+        self._observe("consensus_step_seq_signed", args,
+                      (self.advance_height, chunk))
+        out = _jit("consensus_step_seq_signed")(
+            *args, advance_height=self.advance_height,
+            verify_chunk=chunk)
         # real lanes only (padding excluded); device rejects are
         # subtracted at settle time so the counter converges to
         # ACCEPTED votes — the same meaning the host-verified paths
@@ -403,7 +439,7 @@ class DeviceDriver:
             fn = self._dense_dispatch_fn(int(lanes.sig.shape[0]),
                                          donate=donate)
             out = fn(state, tally, exts_st, phases_st, lanes)
-            n_votes = int(sum(int(np.asarray(p.mask).sum())
+            n_votes = int(sum(int(np.asarray(p.mask).sum())  # lint: allow (host-built phases)
                               for p in phases))
             n_rejected = out.n_rejected
         elif lanes is not None:
@@ -412,29 +448,34 @@ class DeviceDriver:
                     "the packed-lane signed layout is single-device; "
                     "on a mesh feed step_async DenseSignedPhases "
                     "(VoteBatcher.build_phases_device_dense)")
-            fn = (consensus_step_seq_signed_donated_jit if donate
-                  else consensus_step_seq_signed_jit)
-            out = fn(state, tally, exts_st, phases_st, lanes,
-                     self.powers, self.total, self.proposer_flag,
-                     self.propose_value,
-                     advance_height=self.advance_height,
-                     verify_chunk=self._resolve_lane_chunk(
-                         int(lanes.pub.shape[0])))
-            n_votes = int(np.asarray(lanes.real).sum())
+            name = ("consensus_step_seq_signed_donated" if donate
+                    else "consensus_step_seq_signed")
+            chunk = self._resolve_lane_chunk(int(lanes.pub.shape[0]))
+            args = (state, tally, exts_st, phases_st, lanes,
+                    self.powers, self.total, self.proposer_flag,
+                    self.propose_value)
+            self._observe(name, args, (self.advance_height, chunk))
+            out = _jit(name)(*args, advance_height=self.advance_height,
+                             verify_chunk=chunk)
+            n_votes = int(np.asarray(lanes.real).sum())  # lint: allow (host-built lanes)
             n_rejected = out.n_rejected
         else:
+            args = (state, tally, exts_st, phases_st, self.powers,
+                    self.total, self.proposer_flag, self.propose_value)
             if self.mesh is not None:
+                self._observe("sharded_step_seq", args,
+                              (self.advance_height, donate))
                 fn = self._make_sharded_seq(
                     self.mesh, advance_height=self.advance_height,
                     donate=donate)
             else:
-                fn = partial(consensus_step_seq_donated_jit if donate
-                             else consensus_step_seq_jit,
+                name = ("consensus_step_seq_donated" if donate
+                        else "consensus_step_seq")
+                self._observe(name, args, (self.advance_height,))
+                fn = partial(_jit(name),
                              advance_height=self.advance_height)
-            out = fn(state, tally, exts_st, phases_st,
-                     self.powers, self.total, self.proposer_flag,
-                     self.propose_value)
-            n_votes = int(sum(int(np.asarray(p.mask).sum())
+            out = fn(*args)
+            n_votes = int(sum(int(np.asarray(p.mask).sum())  # lint: allow (host-built phases)
                               for p in phases))
         return self._finish_step(out, P, n_votes, n_rejected,
                                  force_defer=True)
@@ -495,16 +536,28 @@ class DeviceDriver:
                         self.mesh, advance_height=self.advance_height,
                         verify_chunk=chunk, donate=donate)
             fn = self._sharded_signed_cache[key]
-            # jit reshards the host-built arrays per the in_specs
-            return lambda st, ta, ex, ph, dn: fn(
-                st, ta, ex, ph, dn, self.powers, self.total,
-                self.proposer_flag, self.propose_value)
-        jitfn = (consensus_step_seq_signed_dense_donated_jit if donate
-                 else consensus_step_seq_signed_dense_jit)
-        return lambda st, ta, ex, ph, dn: jitfn(
-            st, ta, ex, ph, dn, self.powers, self.total,
-            self.proposer_flag, self.propose_value,
-            advance_height=self.advance_height, verify_chunk=chunk)
+
+            def dispatch(st, ta, ex, ph, dn):
+                args = (st, ta, ex, ph, dn, self.powers, self.total,
+                        self.proposer_flag, self.propose_value)
+                self._observe("sharded_step_seq_signed", args,
+                              (self.advance_height, chunk, donate))
+                # jit reshards the host-built arrays per the in_specs
+                return fn(*args)
+
+            return dispatch
+        name = ("consensus_step_seq_signed_dense_donated" if donate
+                else "consensus_step_seq_signed_dense")
+
+        def dispatch(st, ta, ex, ph, dn):
+            args = (st, ta, ex, ph, dn, self.powers, self.total,
+                    self.proposer_flag, self.propose_value)
+            self._observe(name, args, (self.advance_height, chunk))
+            return _jit(name)(
+                *args, advance_height=self.advance_height,
+                verify_chunk=chunk)
+
+        return dispatch
 
     def step_seq_signed_dense(self, phases, dense, exts=None
                               ) -> "jnp.ndarray":
@@ -607,20 +660,18 @@ class DeviceDriver:
         slots = jnp.where(voters[None, :], slot, -1).astype(I32) \
             * jnp.ones((self.I, 1), I32)
         mask = jnp.broadcast_to(voters[None, :], (self.I, self.V))
+        args = (self.state, self.tally, slots, mask, self.powers,
+                self.total, self.proposer_flag, self.propose_value)
         if self.mesh is not None:
             if n_heights not in self._sharded_honest:
                 from agnes_tpu.parallel import make_sharded_honest_heights
                 self._sharded_honest[n_heights] = \
                     make_sharded_honest_heights(self.mesh, n_heights)
-            out = self._sharded_honest[n_heights](
-                self.state, self.tally, slots, mask, self.powers,
-                self.total, self.proposer_flag, self.propose_value)
+            self._observe("sharded_honest_heights", args, (n_heights,))
+            out = self._sharded_honest[n_heights](*args)
         else:
-            out = honest_heights_jit(self.state, self.tally, slots, mask,
-                                     self.powers, self.total,
-                                     self.proposer_flag,
-                                     self.propose_value,
-                                     heights=n_heights)
+            self._observe("honest_heights", args, (n_heights,))
+            out = _jit("honest_heights")(*args, heights=n_heights)
         self.state, self.tally = out.state, out.tally
         self.stats.steps += 3 * n_heights
         self.stats.votes_ingested += 2 * n_heights * int(

@@ -283,6 +283,32 @@ def make_sharded_honest_heights(mesh: Mesh, heights: int):
     return _memo(("honest_heights", mesh, heights), build)
 
 
+# -- entry registry -----------------------------------------------------------
+# The sharded factories register alongside the single-device entries
+# (device/registry.py): the auditor builds each over a CPU mesh and
+# abstractly traces it (collective census + donation), and the driver
+# resolves the factories through one table.  Factory statics are the
+# keyword arguments each factory takes.
+
+from agnes_tpu.device import registry as _registry  # noqa: E402
+
+_registry.register(_registry.EntrySpec(
+    name="sharded_step", fn=consensus_step, factory=make_sharded_step,
+    statics=("advance_height",), sharded=True))
+_registry.register(_registry.EntrySpec(
+    name="sharded_step_seq", fn=consensus_step_seq,
+    factory=make_sharded_step_seq,
+    statics=("advance_height", "donate"), sharded=True))
+_registry.register(_registry.EntrySpec(
+    name="sharded_step_seq_signed", fn=consensus_step_seq_signed_dense,
+    factory=make_sharded_step_seq_signed,
+    statics=("advance_height", "verify_chunk", "donate"), sharded=True))
+_registry.register(_registry.EntrySpec(
+    name="sharded_honest_heights", fn=honest_heights,
+    factory=make_sharded_honest_heights,
+    statics=("heights",), sharded=True))
+
+
 def place_step_state(mesh: Mesh, state, tally):
     """Commit state/tally onto `mesh` per the layout table.  The jit
     cache keys on input shardings: a driver whose FIRST dispatch

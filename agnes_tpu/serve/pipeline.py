@@ -161,12 +161,12 @@ class ServePipeline:
         mode forces a host<->device sync (docstring)."""
         if self.window_predictor is not None:
             base, hts = self.window_predictor()
-            base = np.asarray(base, np.int64)
-            hts = np.asarray(hts, np.int64)
+            base = np.asarray(base, np.int64)  # lint: allow (host predictor output)
+            hts = np.asarray(hts, np.int64)  # lint: allow (host predictor output)
         else:
-            base = np.asarray(self.driver.tally.base_round,
+            base = np.asarray(self.driver.tally.base_round,  # lint: allow (documented fetch-mode fallback: correct, measurably slower)
                               ).astype(np.int64)
-            hts = np.asarray(self.driver.state.height).astype(np.int64)
+            hts = np.asarray(self.driver.state.height).astype(np.int64)  # lint: allow (documented fetch-mode fallback)
         for i in np.nonzero(hts > self.batcher.heights)[0]:
             if int(i) not in self.first_advance_decode:
                 self.first_advance_decode[int(i)] = {
@@ -335,7 +335,7 @@ class ServePipeline:
         done, self._inflight = self._inflight, []
         return done
 
-    def warmup(self, n_phases=(2, 3)) -> int:
+    def warmup(self, n_phases=(2, 3), arm: bool = True) -> int:
         """Precompile every fused-step shape the steady state will
         dispatch, so the first real batch of each is not a minutes-
         long trace stall mid-service.  Runs the EXACT runtime entry
@@ -352,17 +352,21 @@ class ServePipeline:
         rung); dense mode warms one per P — the dense compile key is
         (P, I, V), rung-independent.  Returns shapes warmed.  Signed
         deployments only (unsigned phase sequences have data-dependent
-        layer counts)."""
+        layer counts).
+
+        When the driver carries a retrace sentinel
+        (DeviceDriver(audit=True), analysis/retrace.py) every warmed
+        shape is observed into the sentinel's expected-trace set and
+        — with `arm` (default) — the set is CLOSED afterwards: any
+        serve dispatch whose (entry, shape-signature) was not warmed
+        fails loudly and bumps `retrace_unexpected`, instead of
+        stalling the service on a live multi-minute compile."""
         if self.pubkeys is None:
             return 0
         import jax
 
-        from agnes_tpu.device.step import (
-            DenseSignedPhases,
-            SignedLanes,
-            consensus_step_seq_signed_donated_jit,
-            consensus_step_seq_signed_jit,
-        )
+        from agnes_tpu.device import registry
+        from agnes_tpu.device.step import DenseSignedPhases, SignedLanes
 
         if isinstance(n_phases, int):
             n_phases = (n_phases,)
@@ -387,8 +391,9 @@ class ServePipeline:
                 jax.block_until_ready(out.state)
                 warmed += 1
                 continue
-            fn = (consensus_step_seq_signed_donated_jit if self.donate
-                  else consensus_step_seq_signed_jit)
+            name = ("consensus_step_seq_signed_donated" if self.donate
+                    else "consensus_step_seq_signed")
+            fn = registry.jit_entry(name)
             for r in self.ladder.rungs:
                 lanes = SignedLanes(
                     pub=jnp.zeros((r, 32), jnp.int32),
@@ -400,11 +405,15 @@ class ServePipeline:
                     real=jnp.zeros(r, bool))
                 state_c = jax.tree.map(lambda x: x.copy(), d.state)
                 tally_c = jax.tree.map(lambda x: x.copy(), d.tally)
-                out = fn(state_c, tally_c, exts_st, phases_st, lanes,
-                         d.powers, d.total, d.proposer_flag,
-                         d.propose_value,
-                         advance_height=d.advance_height,
-                         verify_chunk=d._resolve_lane_chunk(r))
+                chunk = d._resolve_lane_chunk(r)
+                args = (state_c, tally_c, exts_st, phases_st, lanes,
+                        d.powers, d.total, d.proposer_flag,
+                        d.propose_value)
+                d._observe(name, args, (d.advance_height, chunk))
+                out = fn(*args, advance_height=d.advance_height,
+                         verify_chunk=chunk)
                 jax.block_until_ready(out.state)
                 warmed += 1
+        if arm and getattr(d, "sentinel", None) is not None:
+            d.sentinel.arm()
         return warmed

@@ -215,7 +215,14 @@ class ThreadedVoteService:
                     f"{timeout_s}s: {', '.join(stuck)} (an in-flight "
                     f"XLA trace can hold the dispatch thread for "
                     f"minutes; retry drain with a larger timeout_s)")
-        with self._admission, self._device:
+        # Surfaced by analysis/lockcheck.py (LOCK004): holding the
+        # admission lock across the device-lock acquisition is exactly
+        # what the two-lock discipline forbids on the serve path.
+        # HERE it is deliberate and safe — both loop threads are
+        # joined (or were never started) by this point, so this is a
+        # quiescent section: nothing can contend, and the final flush
+        # + service drain NEED both domains atomically.
+        with self._admission, self._device:  # lockcheck: allow (quiescent: loops joined above)
             try:
                 while True:     # TOCTOU residue (docstring)
                     blob = self.inbox.get(timeout=0)

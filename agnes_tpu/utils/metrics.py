@@ -118,6 +118,12 @@ class Metrics:
 
 
 # the well-known counter names used across the harness/driver
+#: static-analysis plane (agnes_tpu/analysis): entries the jaxpr
+#: auditor abstractly traced, and dispatches the retrace sentinel saw
+#: outside its expected trace set (hardware rounds record both so a
+#: clean audit is part of the round artifact)
+ANALYSIS_ENTRIES_AUDITED = "analysis_entries_audited"
+RETRACE_UNEXPECTED = "retrace_unexpected"
 VOTES_INGESTED = "votes_ingested"
 VOTES_VERIFIED = "votes_verified"
 THRESHOLDS_CROSSED = "thresholds_crossed"

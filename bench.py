@@ -118,6 +118,31 @@ _EMITTED = False
 _LEASE = None
 _PROBE_PROC = None         # in-flight probe child; reaped on any exit
 
+#: retrace-audit counters accumulated by the serve probes (their
+#: drivers run with the recompile tripwire armed, ISSUE 4): distinct
+#: dispatch signatures vetted + traces outside the expected set.  The
+#: final verdict records carry both, so a hardware round's artifact
+#: states THAT the audit ran and that it ran clean.
+_ANALYSIS: dict = {"analysis_entries_audited": 0,
+                   "retrace_unexpected": 0}
+
+
+def _harvest_audit(driver) -> None:
+    """Fold a serve probe driver's sentinel counters into _ANALYSIS."""
+    sentinel = getattr(driver, "sentinel", None)
+    if sentinel is None:
+        return
+    from agnes_tpu.utils.metrics import (
+        ANALYSIS_ENTRIES_AUDITED,
+        RETRACE_UNEXPECTED,
+    )
+
+    counters = sentinel.metrics.counters
+    _ANALYSIS["analysis_entries_audited"] += counters.get(
+        ANALYSIS_ENTRIES_AUDITED, 0)
+    _ANALYSIS["retrace_unexpected"] += counters.get(
+        RETRACE_UNEXPECTED, 0)
+
 #: serve-smoke mode (ci.sh gate): run ONLY the closed-loop serve probe
 #: at a tiny shape on CPU, with the same crash-safe verdict contract —
 #: the sentinel then speaks in the smoke's headline metric
@@ -1029,7 +1054,8 @@ def _pipeline_serve(n_instances: int, n_validators: int,
     seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(V)]
     pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
                         for s in seeds])
-    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True,
+                     audit=True)
     bat = RunConfig(n_validators=V, n_instances=I,
                     n_slots=4).validate().make_batcher()
     n = I * V
@@ -1074,6 +1100,7 @@ def _pipeline_serve(n_instances: int, n_validators: int,
     assert d.rejected_signature_device == 0
     rep = svc.drain()
     assert rep["queue"]["rejected_overflow"] == 0
+    _harvest_audit(d)
     return 2 * n * heights / dt
 
 
@@ -1114,7 +1141,7 @@ def _pipeline_serve_mesh(n_instances: int, n_validators: int,
     pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
                         for s in seeds])
     d = DeviceDriver(I, V, advance_height=True, defer_collect=True,
-                     mesh=mesh)
+                     mesh=mesh, audit=True)
     bat = RunConfig(n_validators=V, n_instances=I,
                     n_slots=4).validate().make_batcher()
     n = I * V
@@ -1179,6 +1206,7 @@ def _pipeline_serve_mesh(n_instances: int, n_validators: int,
     assert rep["offladder_builds"] == 0
     assert rep["queue"]["rejected_overflow"] == 0
     assert rep["inbox"]["dropped"] == 0
+    _harvest_audit(d)
     return 2 * n * heights / dt
 
 
@@ -1260,6 +1288,7 @@ def _smoke_main(stage: str, metric: str, value_key: str, unit: str,
         value_key: rate,
         "note": (f"{what} at I={i} V={v} x{h} heights on CPU in "
                  f"{time.perf_counter() - t0:.0f}s"),
+        **_ANALYSIS,
     }), flush=True)
     _EMITTED = True
 
@@ -1355,6 +1384,7 @@ def main() -> None:
         "decisions_per_sec": decisions,
         "bridge_votes_per_sec": bridge,
         "value_flood_votes_per_sec": flood,
+        **_ANALYSIS,
     }), flush=True)
     _EMITTED = True        # real verdict delivered; sentinel stands down
 

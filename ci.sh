@@ -56,6 +56,30 @@ g++ -fsanitize=thread -O1 -g -std=c++17 -pthread -o "$TSAN_BIN" \
   agnes_tpu/core/native/capi.cpp
 TSAN_OPTIONS="halt_on_error=1" "$TSAN_BIN"
 
+echo "=== [1c/4] static invariant analyzer (abstract tracing, no XLA compiles) ==="
+# ISSUE 4: the four analysis passes — jaxpr audit (donation honored,
+# collective census + verify_chunk invariance, no host callbacks,
+# dtype policy), retrace warmup-coverage proof, serve lock-order lint,
+# repo lint — run BEFORE the test gates because they are the cheap
+# proof that a TPU round won't stall on a structural regression (the
+# PR 3 double-compile class).  Budget: < 120s of pure CPU tracing;
+# the enclosing timeout is head-room, not the target.
+LINT_JSON="$(mktemp -d)/agnes_lint.json"
+timeout -k 10 300 python scripts/agnes_lint.py --pass all \
+  > "$LINT_JSON" || {
+    echo "static analyzer FAILED:"; tail -5 "$LINT_JSON"; exit 1; }
+python - "$LINT_JSON" <<'PY'
+import json, sys
+rep = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert rep["ok"], rep["findings"]
+audited = rep["metrics"]["analysis_entries_audited"]
+assert audited > 0, rep["metrics"]
+per_pass = ", ".join(f"{k}:{v['seconds']}s"
+                     for k, v in rep["passes"].items())
+print(f"static analyzer OK: {audited} entries audited clean in "
+      f"{rep['seconds']}s ({per_pass})")
+PY
+
 echo "=== [2/4] full test suite (virtual 8-device CPU mesh) ==="
 # step 1 already ran the native differential + fuzz files under ASan
 # (a strict superset of the non-sanitized run) — skip them here; the

@@ -1,0 +1,492 @@
+"""Static invariant analyzer (agnes_tpu/analysis, ISSUE 4) — the
+analyzer ANALYZED: every pass must demonstrably catch its seeded
+negative fixture and run clean on the real repo.
+
+Everything here is CPU-cheap by construction: abstract tracing only
+(jax .trace()/.lower(), never .compile()), registry-stubbed device
+dispatch for the pipeline tests, and AST fixtures as source strings —
+the heavy Ed25519-bearing traces are exercised by the ci.sh analyzer
+gate (scripts/agnes_lint.py --pass all), not here."""
+
+import ast
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agnes_tpu.analysis import jaxpr_audit, lint, lockcheck, retrace
+from agnes_tpu.device import registry
+from agnes_tpu.device.encoding import I32, DeviceMessage
+from agnes_tpu.serve.batcher import ShapeLadder
+from agnes_tpu.utils.metrics import (
+    ANALYSIS_ENTRIES_AUDITED,
+    RETRACE_UNEXPECTED,
+    Metrics,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- registry -----------------------------------------------------------------
+
+def test_registry_enumerates_every_entry():
+    """The single name -> entry table the driver, warmup, auditor and
+    tripwire all share: the canonical entries are present, donated
+    twins declare their donate_argnums, sharded entries carry a
+    factory."""
+    specs = {s.name: s for s in registry.entries()}
+    for name in ("consensus_step", "consensus_step_seq",
+                 "consensus_step_seq_donated",
+                 "consensus_step_seq_signed",
+                 "consensus_step_seq_signed_donated",
+                 "consensus_step_seq_signed_dense",
+                 "consensus_step_seq_signed_dense_donated",
+                 "honest_heights", "sharded_step", "sharded_step_seq",
+                 "sharded_step_seq_signed", "sharded_honest_heights"):
+        assert name in specs, name
+    assert specs["consensus_step_seq_donated"].donated == (0, 1)
+    assert specs["consensus_step_seq"].donated == ()
+    assert specs["sharded_step_seq_signed"].sharded
+    assert specs["sharded_step_seq_signed"].factory is not None
+    # aux import-time jits are registered too (the LINT002 contract)
+    for name in ("add_votes", "apply_batch", "verify_batch",
+                 "verify_batch_msm", "pallas_verify"):
+        assert name in specs, name
+        assert not specs[name].hot
+
+
+def test_registry_override_restores():
+    stub = object()
+    orig = registry.get("consensus_step").jit
+    with registry.override("consensus_step", jit=stub):
+        assert registry.jit_entry("consensus_step") is stub
+    assert registry.jit_entry("consensus_step") is orig
+
+
+# -- jaxpr audit: donation ----------------------------------------------------
+
+def test_donation_audit_clean_on_donated_seq():
+    """The donated unsigned sequence entry lowers with one aliasing
+    attr per state/tally leaf (17)."""
+    rep = jaxpr_audit.audit(names=["consensus_step_seq_donated"])
+    assert rep.ok, [str(f) for f in rep.findings]
+    (entry,) = [e for e in rep.entries
+                if e.entry == "consensus_step_seq_donated"]
+    assert entry.aliased == 17
+
+
+def test_donation_audit_catches_undonated_twin():
+    """A twin REGISTERED as donated whose jit silently lost its
+    donate_argnums (here: deliberately swapped for the non-donated
+    jit) must be flagged — zero aliasing attrs in the lowered text."""
+    undonated = registry.get("consensus_step_seq").jit
+    with registry.override("consensus_step_seq_donated",
+                           jit=undonated):
+        rep = jaxpr_audit.audit(names=["consensus_step_seq_donated"])
+    assert not rep.ok
+    assert any(f.code == "AUD001" for f in rep.findings), \
+        [str(f) for f in rep.findings]
+
+
+# -- jaxpr audit: collective census ------------------------------------------
+
+def test_collective_census_counts_quorum_psums():
+    """The sharded step's only communication is the tally's quorum
+    reductions — a nonzero, known-small psum census over the val
+    axis."""
+    m = Metrics()
+    rep = jaxpr_audit.audit(names=["sharded_step"], metrics=m)
+    assert rep.ok, [str(f) for f in rep.findings]
+    (entry,) = rep.entries
+    assert sum(entry.collectives.values()) > 0
+    assert m.counters[ANALYSIS_ENTRIES_AUDITED] == 1
+
+
+def _evil_signed_factory(mesh, advance_height=False, verify_chunk=None,
+                         donate=False):
+    """A sharded-signed stand-in that ADDS a collective when chunked —
+    the exact regression AUD002 (zero-added-collectives per chunk)
+    exists to catch."""
+    from jax.sharding import PartitionSpec as P
+
+    from agnes_tpu.parallel.mesh import VAL_AXIS
+    from agnes_tpu.parallel.sharded import _shard_map
+
+    def inner(p):
+        s = jax.lax.psum(p, VAL_AXIS)
+        if verify_chunk:
+            s = s + jax.lax.psum(p * 2, VAL_AXIS)   # the injected one
+        return s
+
+    sm = _shard_map(inner, mesh=mesh, in_specs=P(VAL_AXIS),
+                    out_specs=P(), check_vma=False)
+
+    def fn(state, tally, exts, phases, dense, powers, total, pf, pv):
+        return sm(powers)
+
+    return jax.jit(fn)
+
+
+def test_census_catches_injected_collective(monkeypatch):
+    """Chunking the fused verify must add ZERO collectives; a factory
+    whose chunked build psums once more is flagged (AUD002)."""
+    monkeypatch.setitem(
+        jaxpr_audit.ENTRY_STATICS, "sharded_step_seq_signed",
+        {"advance_height": False, "verify_chunk": None,
+         "donate": False})
+    with registry.override("sharded_step_seq_signed",
+                           factory=_evil_signed_factory):
+        rep = jaxpr_audit.audit(names=["sharded_step_seq_signed"])
+    assert any(f.code == "AUD002" for f in rep.findings), \
+        [str(f) for f in rep.findings]
+
+
+# -- jaxpr audit: host callbacks + dtype policy -------------------------------
+
+def test_audit_catches_host_callback():
+    """A stray jax.debug.callback in a hot-path entry is a host
+    round-trip per dispatch — AUD003."""
+    def leaky(state, tally, ext, phase, powers, total, pf, pv,
+              axis_name=None, advance_height=False):
+        jax.debug.callback(lambda x: None, state.round)
+        return state
+
+    with registry.override("consensus_step",
+                           jit=jax.jit(leaky, static_argnames=(
+                               "axis_name", "advance_height"))):
+        rep = jaxpr_audit.audit(names=["consensus_step"])
+    assert any(f.code == "AUD003" for f in rep.findings), \
+        [str(f) for f in rep.findings]
+
+
+def test_audit_catches_float64_leak():
+    """A float64 aval anywhere in an entry's graph violates the dtype
+    policy (x64 is off by design; a wide float means an accidental
+    promotion upstream) — AUD004."""
+    from jax.experimental import enable_x64
+
+    def leaky(state, tally, ext, phase, powers, total, pf, pv,
+              axis_name=None, advance_height=False):
+        return state.round.astype(jnp.float64) * 2.0
+
+    with enable_x64(), registry.override(
+            "consensus_step",
+            jit=jax.jit(leaky, static_argnames=(
+                "axis_name", "advance_height"))):
+        rep = jaxpr_audit.audit(names=["consensus_step"])
+    assert any(f.code == "AUD004" for f in rep.findings), \
+        [str(f) for f in rep.findings]
+
+
+# -- retrace tripwire ---------------------------------------------------------
+
+def test_sentinel_armed_fires_on_unexpected_signature():
+    m = Metrics()
+    s = retrace.RetraceSentinel(metrics=m)
+    a = np.zeros((4, 2), np.int32)
+    sig = retrace.signature((a,), statics=(False, 8))
+    s.observe("e", sig)                 # learning: becomes expected
+    s.arm()
+    s.observe("e", sig)                 # expected: silent
+    off = retrace.signature((np.zeros((24, 2), np.int32),),
+                            statics=(False, 8))
+    with pytest.raises(retrace.RetraceError):
+        s.observe("e", off)
+    assert m.counters[RETRACE_UNEXPECTED] == 1
+    assert m.counters[ANALYSIS_ENTRIES_AUDITED] == 1
+    assert s.report()["unexpected"] == 1
+
+
+def test_sentinel_catches_sharding_variant_double_compile():
+    """The PR 3 class: SAME shapes dispatched under two different
+    shardings keys two jit cache entries for one graph.  The sentinel
+    fails on the second variant even UNARMED."""
+    m = Metrics()
+    s = retrace.RetraceSentinel(metrics=m)
+    host = np.zeros((4,), np.int32)          # sharding key "host"
+    dev = jnp.zeros((4,), jnp.int32)         # SingleDeviceSharding
+    s.observe("e", retrace.signature((host,)))
+    with pytest.raises(retrace.RetraceError) as ei:
+        s.observe("e", retrace.signature((dev,)))
+    assert "double-compile" in str(ei.value)
+    assert m.counters[RETRACE_UNEXPECTED] == 1
+
+
+def test_warmup_coverage_proof():
+    """Static no-live-compile proof: the default warmup plan (P in
+    {2, 3} x every rung) covers every dispatchable signed shape; a
+    plan missing P=2 (deadline-closed single-class batches) does
+    not."""
+    ladder = ShapeLadder.plan(4, 8, min_rung=8, max_votes=64)
+    assert retrace.warmup_covers(ladder, n_phases=(2, 3))
+    assert retrace.warmup_covers(ladder, n_phases=(2, 3), dense=True)
+    assert not retrace.warmup_covers(ladder, n_phases=(3,))
+    findings = retrace.coverage_findings(ladder, n_phases=(3,))
+    assert findings and findings[0].code == "RET001"
+
+
+def _stub_signed_jit(state, tally, exts, phases, lanes, powers, total,
+                     pf, pv, advance_height=False, verify_chunk=None):
+    """Shape-faithful stand-in for the fused signed step: returns the
+    carried state/tally untouched and all-NONE messages — zero XLA
+    compiles, so the retrace test runs inside the cheap tier."""
+    from agnes_tpu.device.step import N_STAGES, SignedStepOutputs
+
+    P, I = phases.mask.shape[:2]
+    z = jnp.zeros((P, N_STAGES, I), I32)
+    return SignedStepOutputs(
+        state=state, tally=tally,
+        msgs=DeviceMessage(tag=z, round=z, value=z, aux=z),
+        n_rejected=jnp.zeros((), I32))
+
+
+def test_retrace_silent_across_warmup_and_serve_tick():
+    """DeviceDriver(audit=True) + ServePipeline.warmup(): the armed
+    sentinel stays silent across a full warmup + a real serve tick
+    (every dispatched signature was warmed), then fires on an
+    off-ladder lane shape.  Dispatch is registry-stubbed: the
+    machinery under test is the signature discipline, not XLA."""
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+    from agnes_tpu.device.step import SignedLanes
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        validator_pubkeys,
+    )
+    from agnes_tpu.serve import VoteService
+
+    I, V = 2, 8
+    pubkeys = validator_pubkeys(deterministic_seeds(V))
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True,
+                     audit=True)
+    bat = VoteBatcher(I, V, n_slots=4)
+    ladder = ShapeLadder.plan(I, V, max_votes=16, min_rung=8)
+    svc = VoteService(
+        d, bat, pubkeys, capacity=64, target_votes=16, max_delay_s=0.0,
+        ladder=ladder,
+        window_predictor=lambda: (np.zeros(I, np.int64),
+                                  np.zeros(I, np.int64)))
+    with registry.override("consensus_step_seq_signed_donated",
+                           jit=_stub_signed_jit):
+        warmed = svc.pipeline.warmup()
+        assert warmed == 2 * len(ladder.rungs)     # P in {2,3} x rungs
+        assert d.sentinel.armed
+        expected = len(d.sentinel.expected)
+
+        # one real tick: 8 prevotes + 8 precommits -> ONE build
+        # (entry + both classes = P 3) padded onto rung 16 — warmed
+        inst = np.repeat(np.arange(I), 4)
+        val = np.tile(np.arange(4), I)
+        n = len(inst)
+        wire = b"".join(
+            pack_wire_votes(inst, val, np.zeros(n), np.zeros(n),
+                            np.full(n, typ), np.full(n, 7))
+            for typ in (0, 1))
+        assert svc.submit(wire).accepted == 16
+        svc.pump()                     # stages the build
+        svc.pump()                     # dispatches it — must be silent
+        assert svc.pipeline.dispatched_batches == 1
+        assert d.sentinel.report()["unexpected"] == 0
+        assert len(d.sentinel.expected) == expected  # nothing new
+
+        # off-ladder shape: 24 lanes is no rung — fails LOUDLY before
+        # any dispatch, and bumps the counter
+        r = 24
+        lanes = SignedLanes(
+            pub=jnp.zeros((r, 32), jnp.int32),
+            sig=jnp.zeros((r, 64), jnp.int32),
+            blocks=jnp.zeros((r, 1, 32), jnp.uint32),
+            phase_idx=jnp.full(r, 3, jnp.int32),
+            inst=jnp.zeros(r, jnp.int32), val=jnp.zeros(r, jnp.int32),
+            real=jnp.zeros(r, bool))
+        phases = [svc.pipeline._entry_phase(np.zeros(I, np.int64))] * 3
+        with pytest.raises(retrace.RetraceError):
+            d.step_async(phases, lanes)
+    assert d.sentinel.metrics.counters[RETRACE_UNEXPECTED] == 1
+
+
+# -- lockcheck ----------------------------------------------------------------
+
+def test_lockcheck_clean_on_repo():
+    findings = lockcheck.check_paths(lockcheck.default_paths(REPO))
+    assert findings == [], [str(f) for f in findings]
+
+
+_BARE_ACQUIRE = """
+import threading
+lock = threading.Lock()
+def f():
+    lock.acquire()
+    work()
+    lock.release()
+"""
+
+_INVERSION = """
+class S:
+    def good(self):
+        with self._admission:
+            close()
+        with self._device:
+            pump()
+    def bad(self):
+        with self._device:
+            with self._admission:      # device -> admission: inverted
+                close()
+"""
+
+_ADMISSION_DISPATCH = """
+class S:
+    def bad(self):
+        with self._admission:
+            self.driver.step_async(phases)
+"""
+
+_NESTED_HOLD = """
+class S:
+    def bad(self):
+        with self._admission:
+            with self._device:
+                pump()
+"""
+
+_NESTED_HOLD_PRAGMA = """
+class S:
+    def quiescent(self):
+        with self._admission, self._device:  # lockcheck: allow (threads joined)
+            pump()
+"""
+
+
+def test_lockcheck_flags_synthetic_fixtures():
+    codes = [f.code for f in lockcheck.check_source(_BARE_ACQUIRE)]
+    assert codes == ["LOCK001", "LOCK001"]
+    codes = [f.code for f in lockcheck.check_source(_INVERSION)]
+    assert codes == ["LOCK002"]
+    codes = [f.code for f in lockcheck.check_source(_ADMISSION_DISPATCH)]
+    assert codes == ["LOCK003"]
+    codes = [f.code for f in lockcheck.check_source(_NESTED_HOLD)]
+    assert codes == ["LOCK004"]
+    assert lockcheck.check_source(_NESTED_HOLD_PRAGMA) == []
+
+
+def test_instrumented_lock_order():
+    """Runtime twin of LOCK002/LOCK004: acquiring out of rank order
+    raises and records."""
+    st = lockcheck.LockOrderState()
+    adm = lockcheck.InstrumentedLock("adm", 0, st)
+    dev = lockcheck.InstrumentedLock("dev", 1, st)
+    with adm:
+        pass
+    with dev:                          # in isolation: fine
+        with pytest.raises(AssertionError):
+            with adm:                  # inversion: caught live
+                pass
+    assert len(st.violations) == 1
+    assert st.acquisitions == 2
+
+
+# -- repo lint ----------------------------------------------------------------
+
+def test_lint_clean_on_repo():
+    findings = lint.check_repo(REPO)
+    assert findings == [], [str(f) for f in findings]
+
+
+_HOT_SYNC = """
+class P:
+    def stage(self, batch):
+        x = np.asarray(self.driver.state.height)
+        self.driver.block_until_ready()
+        return float(x)
+    def cold(self):
+        return np.asarray(self.anything)    # not a hot function
+"""
+
+_HOT_SYNC_PRAGMA = """
+class P:
+    def stage(self, batch):
+        x = np.asarray(batch.cols)  # lint: allow (host-built columns)
+        return x
+"""
+
+
+def test_lint_hot_path_sync_fixture(tmp_path):
+    rel = "agnes_tpu/serve/pipeline.py"
+    target = tmp_path / rel
+    target.parent.mkdir(parents=True)
+    target.write_text(_HOT_SYNC)
+    findings = lint.check_hot_paths(str(tmp_path))
+    assert [f.code for f in findings] == ["LINT001"] * 3
+    target.write_text(_HOT_SYNC_PRAGMA)
+    assert lint.check_hot_paths(str(tmp_path)) == []
+
+
+_ROGUE_JIT = """
+import jax
+def f(x):
+    return x
+rogue_jit = jax.jit(f)
+"""
+
+
+def test_lint_catches_unregistered_import_time_jit(tmp_path):
+    pkg = tmp_path / "agnes_tpu"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(_ROGUE_JIT)
+
+    class FakeMod:
+        rogue_jit = object()
+
+    importer = lambda name: FakeMod()      # noqa: E731
+    findings = lint.check_import_time_jits(
+        str(tmp_path), registered_check=lambda obj: False,
+        importer=importer)
+    assert [f.code for f in findings] == ["LINT002"]
+    # the same jit, "registered": sanctioned
+    assert lint.check_import_time_jits(
+        str(tmp_path), registered_check=lambda obj: True,
+        importer=importer) == []
+
+
+_UNHASHABLE_STATIC = """
+def f():
+    return entry(x, verify_chunk=[1, 2])
+"""
+
+
+def test_lint_catches_unhashable_static_literal(tmp_path):
+    pkg = tmp_path / "agnes_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(_UNHASHABLE_STATIC)
+    findings = lint.check_static_kwargs(str(tmp_path))
+    assert [f.code for f in findings] == ["LINT003"]
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_locks_and_retrace_passes():
+    """scripts/agnes_lint.py end-to-end on its two cheap passes: exit
+    0, parseable JSON report, both marked clean."""
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "agnes_lint.py"),
+         "--pass", "locks", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-800:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rep["ok"] and rep["passes"]["locks"]["findings"] == 0
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "agnes_lint.py"),
+         "--pass", "retrace", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-800:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rep["ok"] and rep["passes"]["retrace"]["covered"]

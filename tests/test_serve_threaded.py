@@ -227,3 +227,33 @@ def test_metrics_concurrent_counts_are_exact():
         assert m.counters[f"c{t}"] == per_thread // 100
     snap = m.snapshot()
     assert snap["x"] == n_threads * per_thread
+
+
+# -- instrumented lock order (analysis/lockcheck.py runtime mode) -------------
+
+def test_threaded_lock_order_instrumented():
+    """The runtime twin of the static lock-order lint: run a real
+    concurrent submit/dispatch/poll/drain scenario over
+    InstrumentedLock-wrapped admission/device locks — every actual
+    acquisition must respect the global admission -> device order, and
+    none may be a bare acquire.  (The drain path's combined hold is
+    in-order, so it passes here too — the static pass needs its
+    quiescence pragma only because it cannot see that the loops are
+    joined.)"""
+    from agnes_tpu.analysis import lockcheck
+
+    I, V = 4, 8
+    svc, d, dispatched = _stubbed_service(I, V)
+    tsvc = ThreadedVoteService(svc, idle_wait_s=0.0005)
+    state = lockcheck.instrument(tsvc)         # BEFORE start()
+    tsvc.start()
+    n = I * V
+    for k in range(n):
+        w = pack_wire_votes([k // V], [k % V], [0], [0], [0], [7])
+        _wait(lambda: tsvc.submit(w), what="inbox accepts")
+    _wait(lambda: sum(dispatched) == n, what="all votes dispatched")
+    tsvc.poll_decisions()                      # caller-thread device lock
+    rep = tsvc.drain()
+    assert rep["thread_failure"] is None
+    assert state.violations == [], state.violations
+    assert state.acquisitions > 0
