@@ -178,49 +178,69 @@ class RetraceSentinel:
 # -- static warmup-coverage proof (CLI retrace pass) --------------------------
 
 def dispatchable_shapes(ladder, dense: bool = False,
-                        ) -> Set[Tuple[int, Optional[int]]]:
+                        dedup: bool = False,
+                        ) -> Set[Tuple]:
     """Every (P, rung) shape the serve pipeline CAN dispatch on the
     signed path, derived from its build policy without building
     anything: builds are capped at the top rung and padded onto a
     ladder rung (packed-lane mode; `lane_floor = min_rung`), and the
     entry-prepend policy makes the step-sequence length P = 1 entry +
     {1, 2} vote classes.  Dense mode's compile key is (P, I, V) — rung
-    is not part of it, so the rung slot is None."""
+    is not part of it, so the rung slot is None.
+
+    With `dedup` (ISSUE 5 split-rung dispatch) the pre-verified stream
+    additionally dispatches the UNSIGNED sequence entries — their
+    compile key carries no lane rung at all (dense [P, I, V] phases).
+    P in {2, 3} is a HARD bound, not a hope: pre-verified builds are
+    chunked to at most two vote phases per dispatch with the entry
+    phase prepended on every chunk
+    (ServePipeline._stage_preverified) — a cache-hit burst spanning
+    rounds or equivocation layers stages several chunks rather than
+    one long unwarmed sequence."""
     ps = (2, 3)
-    if dense:
-        return {(p, None) for p in ps}
-    return {(p, r) for p in ps for r in ladder.rungs}
+    out: Set[Tuple] = ({(p, None) for p in ps} if dense
+                       else {(p, r) for p in ps for r in ladder.rungs})
+    if dedup:
+        out |= {("unsigned", p) for p in ps}
+    return out
 
 
 def warmup_shapes(ladder, n_phases=(2, 3), dense: bool = False,
-                  ) -> Set[Tuple[int, Optional[int]]]:
+                  dedup: bool = False,
+                  ) -> Set[Tuple]:
     """The (P, rung) set ServePipeline.warmup(n_phases) precompiles
-    (mirrors its loop structure; see pipeline.warmup docstring)."""
+    (mirrors its loop structure; see pipeline.warmup docstring).  With
+    `dedup` the warmup also compiles the unsigned sequence entries,
+    one shape per P (the cache-enabled warmup loop)."""
     if isinstance(n_phases, int):
         n_phases = (n_phases,)
-    if dense:
-        return {(p, None) for p in n_phases}
-    return {(p, r) for p in n_phases for r in ladder.rungs}
+    out: Set[Tuple] = ({(p, None) for p in n_phases} if dense
+                       else {(p, r) for p in n_phases
+                             for r in ladder.rungs})
+    if dedup:
+        out |= {("unsigned", p) for p in n_phases}
+    return out
 
 
-def warmup_covers(ladder, n_phases=(2, 3), dense: bool = False) -> bool:
+def warmup_covers(ladder, n_phases=(2, 3), dense: bool = False,
+                  dedup: bool = False) -> bool:
     """True iff every dispatchable signed shape is warmed — the
     no-live-compile invariant, provable statically."""
-    return dispatchable_shapes(ladder, dense) <= warmup_shapes(
-        ladder, n_phases, dense)
+    return dispatchable_shapes(ladder, dense, dedup) <= warmup_shapes(
+        ladder, n_phases, dense, dedup)
 
 
-def coverage_findings(ladder, n_phases=(2, 3), dense: bool = False
-                      ) -> List:
+def coverage_findings(ladder, n_phases=(2, 3), dense: bool = False,
+                      dedup: bool = False) -> List:
     """Finding list form of warmup_covers for the CLI."""
     from agnes_tpu.analysis.jaxpr_audit import Finding
 
-    missing = dispatchable_shapes(ladder, dense) - warmup_shapes(
-        ladder, n_phases, dense)
+    missing = dispatchable_shapes(ladder, dense, dedup) - warmup_shapes(
+        ladder, n_phases, dense, dedup)
     if not missing:
         return []
     return [Finding(
         "retrace", "RET001", "ServePipeline.warmup",
         f"dispatchable signed shapes not covered by the warmup plan "
-        f"{tuple(n_phases)}: {sorted(missing)} — each would compile "
-        f"LIVE mid-service")]
+        f"{tuple(n_phases)}: {sorted(missing, key=repr)} — each would "
+        f"compile LIVE mid-service")]

@@ -38,7 +38,7 @@ instead; SURVEY §2.3 fix 2).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -96,15 +96,35 @@ class _Batch:
     typ: np.ndarray            # [N] int64
     value: np.ndarray          # [N] int64 (_NIL = nil)
     signature: Optional[np.ndarray]   # [N, 64] uint8 or None
+    # serve-plane dedup columns (ISSUE 5; None outside cache-enabled
+    # serving): `verified` marks cache-hit records whose exact bytes a
+    # settled device dispatch already verified (the pipeline routes
+    # them to the verify-free unsigned entries), `digest` the wire
+    # SHA-256 a clean device verify inserts into the cache
+    verified: Optional[np.ndarray] = None     # [N] bool
+    digest: Optional[np.ndarray] = None       # [N, 32] uint8
 
     def __len__(self) -> int:
         return len(self.instance)
 
     def take(self, idx: np.ndarray) -> "_Batch":
+        def opt(a):
+            return a[idx] if a is not None else None
+
         return _Batch(
             self.instance[idx], self.validator[idx], self.height[idx],
             self.round[idx], self.typ[idx], self.value[idx],
-            self.signature[idx] if self.signature is not None else None)
+            opt(self.signature), opt(self.verified), opt(self.digest))
+
+
+def _opt_concat(batches: List[_Batch], field: str, fill) -> Optional[np.ndarray]:
+    """Concat an optional column across batches (None where every
+    batch lacks it; `fill(n)` pads batches that do)."""
+    vals = [getattr(b, field) for b in batches]
+    if all(v is None for v in vals):
+        return None
+    return np.concatenate([v if v is not None else fill(len(b))
+                           for v, b in zip(vals, batches)])
 
 
 def _concat(batches: List[_Batch]) -> _Batch:
@@ -114,14 +134,14 @@ def _concat(batches: List[_Batch]) -> _Batch:
         # otherwise memcpy every column again); callers never mutate
         # batch columns in place (the nil normalization rebuilds)
         return batches[0]
-    sig = None
-    if any(b.signature is not None for b in batches):
-        sig = np.concatenate([
-            b.signature if b.signature is not None
-            else np.zeros((len(b), 64), np.uint8) for b in batches])
+    sig = _opt_concat(batches, "signature",
+                      lambda n: np.zeros((n, 64), np.uint8))
+    ver = _opt_concat(batches, "verified", lambda n: np.zeros(n, bool))
+    dig = _opt_concat(batches, "digest",
+                      lambda n: np.zeros((n, 32), np.uint8))
     return _Batch(*([np.concatenate([getattr(b, f) for b in batches])
                      for f in ("instance", "validator", "height", "round",
-                               "typ", "value")] + [sig]))
+                               "typ", "value")] + [sig, ver, dig]))
 
 
 def vote_messages_np(height: np.ndarray, round_: np.ndarray,
@@ -222,6 +242,13 @@ class VoteBatcher:
         # they extract (they carry them; slashing must anyway).
         self._dv_pubkeys: Optional[np.ndarray] = None
         self._emitted_lane_groups: List[_Batch] = []
+        # (digest [N,32], instance [N], height [N]) of the real lanes
+        # the LAST device-verify build emitted (None when the build had
+        # no digest column or fell back host-verified): the serve
+        # pipeline snapshots this per staged build and inserts the keys
+        # into the dedup cache once that dispatch's verify settles with
+        # zero rejected lanes (cache.py's poisoning-safety contract)
+        self.last_build_keys: Optional[Tuple] = None
         # per-_log-entry pubkey table: None = logged post-screen
         # (host-verified/unsigned build, nothing to re-check); an
         # array = the device-verify build's epoch table to re-verify
@@ -251,17 +278,25 @@ class VoteBatcher:
     # -- enqueue -------------------------------------------------------------
 
     def add_arrays(self, instance, validator, height, round_, typ, value,
-                   signatures: Optional[np.ndarray] = None) -> None:
+                   signatures: Optional[np.ndarray] = None,
+                   verified: Optional[np.ndarray] = None,
+                   digest: Optional[np.ndarray] = None) -> None:
         """Bulk enqueue: [N] integer arrays (+ optional [N, 64] uint8
         signatures).  value < 0 means nil.  This is the fast path — no
-        per-vote Python objects anywhere."""
+        per-vote Python objects anywhere.  `verified`/`digest` are the
+        serve dedup columns (queue.WireColumns): a [N] bool cache-hit
+        mask and the [N, 32] wire SHA-256s; they ride the pending/held
+        queues so the pipeline's split-rung dispatch can separate
+        pre-verified re-deliveries from fresh traffic."""
         self._pending.append(_Batch(
             np.asarray(instance, np.int64), np.asarray(validator, np.int64),
             np.asarray(height, np.int64), np.asarray(round_, np.int64),
             np.asarray(typ, np.int64),
             np.asarray(value, np.int64),
             np.asarray(signatures, np.uint8)
-            if signatures is not None else None))
+            if signatures is not None else None,
+            np.asarray(verified, bool) if verified is not None else None,
+            np.asarray(digest, np.uint8) if digest is not None else None))
 
     def add(self, vote: WireVote) -> None:
         if vote.signature is not None and len(vote.signature) != 64:
@@ -320,6 +355,35 @@ class VoteBatcher:
     def pending_votes(self) -> int:
         """Votes enqueued but not yet drained by a build."""
         return sum(len(b) for b in self._pending)
+
+    def split_pending_verified(self) -> List[_Batch]:
+        """Remove the PRE-VERIFIED rows (serve dedup-cache hits; the
+        `verified` column) from the pending queue and return them as
+        their own batch list, arrival order preserved within each
+        stream.  The serve pipeline's split-rung dispatch builds the
+        remaining fresh rows through the signed device-verify path,
+        then feeds the returned batches back via `adopt_pending` and
+        builds them UNSIGNED — the partition must happen here, at the
+        queue level, because held future-round votes re-enter
+        `_pending` carrying their flag and a fresh (unverified) vote
+        must never ride an unsigned build."""
+        pre: List[_Batch] = []
+        fresh: List[_Batch] = []
+        for b in self._pending:
+            v = b.verified
+            if v is None or not v.any():
+                fresh.append(b)
+            elif v.all():
+                pre.append(b)
+            else:
+                pre.append(b.take(np.nonzero(v)[0]))
+                fresh.append(b.take(np.nonzero(~v)[0]))
+        self._pending = fresh
+        return pre
+
+    def adopt_pending(self, batches: List[_Batch]) -> None:
+        """Re-queue batches returned by `split_pending_verified`."""
+        self._pending.extend(batches)
 
     # -- signature verification ----------------------------------------------
 
@@ -484,9 +548,7 @@ class VoteBatcher:
         # Rebuild rather than mutate: batch columns can alias caller
         # arrays (add_arrays is zero-copy) via _concat's 1-batch path.
         if (b.value < _NIL).any():
-            b = _Batch(b.instance, b.validator, b.height, b.round,
-                       b.typ, np.where(b.value < 0, _NIL, b.value),
-                       b.signature)
+            b = replace(b, value=np.where(b.value < 0, _NIL, b.value))
 
         # --- hold back future rounds BEFORE verification: they are
         # verified (and logged) once, when the window reaches them —
@@ -515,9 +577,8 @@ class VoteBatcher:
         self._dv_pubkeys = pubkeys if _device_verify else None
         if pubkeys is not None:
             if b.signature is None:
-                b = _Batch(b.instance, b.validator, b.height, b.round,
-                           b.typ, b.value,
-                           np.zeros((len(b), 64), np.uint8))
+                b = replace(b, signature=np.zeros((len(b), 64),
+                                                  np.uint8))
             if not _device_verify:
                 good = self._verify(b, pubkeys)
                 self.rejected_signature += int(len(b) - good.sum())
@@ -758,6 +819,13 @@ class VoteBatcher:
         burst must not be declared ineligible by traffic that builds
         separately after it)."""
         tail = self._defer_pending(max_votes)
+        self.last_build_keys = None
+        # digest integrity is all-or-none across the batches this
+        # build drains: _concat zero-fills a missing optional column,
+        # and a zero digest must NEVER become a "verified" cache key —
+        # fail closed by withholding keys from mixed builds
+        all_digests = bool(self._pending) and all(
+            b.digest is not None for b in self._pending)
         try:
             if (self.verify_mode != "lanes"
                     or not self._device_verify_eligible()):
@@ -773,6 +841,12 @@ class VoteBatcher:
             cat = _concat(groups)
             phase_idx = np.concatenate([np.full(len(g), i, np.int64)
                                         for i, g in enumerate(groups)])
+            if all_digests and cat.digest is not None:
+                # dedup-cache insertion keys for exactly the emitted
+                # real lanes (pre-padding): screened/stale/held rows
+                # never became lanes, so they never become cache keys
+                self.last_build_keys = (cat.digest, cat.instance,
+                                        cat.height)
             return phases, cat, phase_idx
         finally:
             if tail:

@@ -114,6 +114,12 @@ class DeviceDriver:
         self._deferred_msgs: list = []
         self._pending_rejects: list = []       # device-verify rejects
         self.rejected_signature_device = 0
+        # the LAST step_async dispatch's deferred rejected-lane count
+        # (a lazy device array; None for unsigned dispatches): the
+        # serve pipeline snapshots it per in-flight batch so settle()
+        # can gate dedup-cache insertion on "this dispatch's verify
+        # rejected nothing" (serve/cache.py poisoning safety)
+        self.last_step_rejects = None
         self.mesh = mesh
         if mesh is not None:
             from agnes_tpu.parallel import (
@@ -477,6 +483,7 @@ class DeviceDriver:
             out = fn(*args)
             n_votes = int(sum(int(np.asarray(p.mask).sum())  # lint: allow (host-built phases)
                               for p in phases))
+        self.last_step_rejects = n_rejected
         return self._finish_step(out, P, n_votes, n_rejected,
                                  force_defer=True)
 

@@ -31,9 +31,21 @@ added collectives — parallel/sharded.py).  threaded.py adds the host
 event loop above VoteService: a submit thread draining a socket-shaped
 Inbox into admission while a dispatch thread pumps ticks, with submit
 wait-free relative to in-flight XLA dispatch.
+
+cache.py (ISSUE 5 tentpole) adds the verified-vote dedup layer:
+gossip delivers each vote O(peers) times, and without it every
+re-delivery pays a device Ed25519 lane.  A bounded thread-safe
+`VerifiedCache` keyed by the wire record's SHA-256 is consulted at
+admission; hits are admitted pre-verified and the pipeline's
+SPLIT-RUNG dispatch routes them to the verify-free unsigned step
+entries while fresh traffic keeps the signed fused path (at a smaller
+rung).  Entries are inserted only after a dispatch's device verify
+settles with zero rejected lanes, so forged duplicates can never
+pre-populate the cache.
 """
 
 from agnes_tpu.serve.batcher import MicroBatcher, ShapeLadder  # noqa: F401
+from agnes_tpu.serve.cache import VerifiedCache  # noqa: F401
 from agnes_tpu.serve.pipeline import ServePipeline  # noqa: F401
 from agnes_tpu.serve.queue import (  # noqa: F401
     AdmissionQueue,

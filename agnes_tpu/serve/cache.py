@@ -1,0 +1,244 @@
+"""VerifiedCache: the serve plane's verified-vote dedup layer.
+
+In committee-based BFT the cost center is signature verification, and
+gossip delivers every vote O(peers) times — under realistic duplication
+factors of 8-32x most of the device's Ed25519 lanes re-verify bytes it
+already vouched for.  This module is the fix (ISSUE 5 tentpole): a
+bounded, thread-safe map keyed by the SHA-256 of the 96-byte wire
+record, consulted at ADMISSION (serve/queue.py):
+
+* **hit**  — the exact bytes were device-verified before: the record is
+  admitted *pre-verified* and later dispatched on the verify-free
+  unsigned step entries (``consensus_step_seq_*``; the split-rung
+  dispatch in serve/pipeline.py), skipping the Ed25519 lane entirely.
+* **miss** — the record flows to the fused device verify exactly as
+  before.
+
+Poisoning safety is the whole design:
+
+* Entries are inserted only AFTER the device verify of that dispatch
+  lands clean (`ServePipeline.settle`): a forged duplicate can never
+  pre-populate the cache, because its bytes only become a key once a
+  dispatch carrying them reported **zero** rejected lanes.  Granularity
+  is per dispatch — the device reports a rejected-lane *count*, not a
+  per-lane verdict, so a batch containing ANY rejected signature caches
+  nothing (counted in ``insert_skipped_rejected``).  Honest steady
+  state rejects nothing, so the cache fills; an adversary replaying a
+  *rejected* signature re-pays the device verify on every replay and
+  stays uncached forever.
+* A hit therefore proves "identical bytes passed the device verify" —
+  and verification is a pure function of the record's bytes (message,
+  signature and pubkey index all come from the record), so replaying
+  the hit through the unsigned step cannot change any verdict.
+
+Bounded two ways:
+
+* **LRU byte budget** (`max_bytes`): inserts evict least-recently-hit
+  entries first.  `ENTRY_BYTES` is the accounted per-entry cost (the
+  32-byte digest plus dict/tuple bookkeeping, rounded up).
+* **decided-height pruning** (`prune_decided`): a vote for a height an
+  instance has decided can never reach a verify lane again (the
+  batcher's stale-height screen drops it first), so its entry is dead
+  weight — the service prunes on its poll cadence.
+
+Pure stdlib + numpy, no jax; the internal mutex is a leaf lock held
+for dict operations only — admission (under the threaded host's
+admission lock) and settle (under the device lock) may touch the cache
+concurrently without ever ordering against each other.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+import numpy as np
+
+#: accounted bytes per entry: 32-byte digest key + dict slot + the
+#: (instance, height) value tuple — rounded up so the budget errs
+#: toward smaller, not larger, resident size
+ENTRY_BYTES = 128
+
+#: default budget: ~512k entries — a few full north-star ticks of
+#: distinct votes, far above any honest per-height working set
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+
+
+class VerifiedCache:
+    """Bounded thread-safe digest -> (instance, height) LRU map
+    (module docstring).  All arrays are host numpy; every method is a
+    short critical section under one leaf mutex."""
+
+    def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES):
+        if int(max_bytes) < ENTRY_BYTES:
+            raise ValueError(
+                f"max_bytes must hold at least one entry "
+                f"({ENTRY_BYTES}): {max_bytes}")
+        self.max_bytes = int(max_bytes)
+        self._mu = threading.Lock()
+        # digest bytes -> (instance, height); order = LRU (oldest first)
+        self._entries: "collections.OrderedDict[bytes, tuple]" = \
+            collections.OrderedDict()
+        # instance -> height -> set of keys: the pruning index, so
+        # dropping a decided height is O(entries pruned), never a full
+        # cache walk under the mutex (admission lookups share it)
+        self._by_inst: dict = {}
+        self.counters = {
+            "hits": 0, "misses": 0, "inserted": 0, "evicted": 0,
+            "pruned_height": 0, "insert_skipped_rejected": 0,
+            "insert_skipped_noverdict": 0,
+        }
+        self._last_prune: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return len(self._entries)      # len(dict) is atomic
+
+    @property
+    def bytes(self) -> int:
+        """Accounted resident size (ENTRY_BYTES per entry)."""
+        return len(self._entries) * ENTRY_BYTES
+
+    # -- admission-side -------------------------------------------------------
+
+    def lookup(self, digests: np.ndarray) -> np.ndarray:
+        """[N] bool hit mask for [N, 32] uint8 digests.  Hits refresh
+        LRU recency; hit/miss counters move per record.  Key bytes are
+        materialized BEFORE the mutex — the critical section is dict
+        ops only."""
+        n = len(digests)
+        out = np.zeros(n, bool)
+        if n == 0:
+            return out
+        keys = [digests[j].tobytes() for j in range(n)]
+        with self._mu:
+            entries = self._entries
+            for j, key in enumerate(keys):
+                if key in entries:
+                    entries.move_to_end(key)
+                    out[j] = True
+            hits = int(out.sum())
+            self.counters["hits"] += hits
+            self.counters["misses"] += n - hits
+        return out
+
+    # -- settle-side ----------------------------------------------------------
+
+    def insert(self, digests: np.ndarray, instances: np.ndarray,
+               heights: np.ndarray) -> int:
+        """Insert device-verified records (call ONLY after the dispatch
+        that carried them settled with zero rejected lanes — the
+        caller-side contract that keeps the cache poisoning-safe).
+        Returns entries newly inserted; evicts LRU past `max_bytes`."""
+        n = len(digests)
+        if n == 0:
+            return 0
+        budget = self.max_bytes // ENTRY_BYTES
+        # materialize keys/values outside the mutex (the numpy ->
+        # bytes/int conversions are the bulk of the per-record cost)
+        items = [(digests[j].tobytes(),
+                  (int(instances[j]), int(heights[j])))
+                 for j in range(n)]
+        with self._mu:
+            entries = self._entries
+            new = 0
+            for key, val in items:
+                old = entries.get(key)
+                if old is None:
+                    new += 1
+                elif old != val:
+                    self._index_discard(key, old)
+                entries[key] = val
+                entries.move_to_end(key)
+                if old is None or old != val:
+                    self._by_inst.setdefault(val[0], {}) \
+                        .setdefault(val[1], set()).add(key)
+            evicted = 0
+            while len(entries) > budget:
+                key, val = entries.popitem(last=False)
+                self._index_discard(key, val)
+                evicted += 1
+            self.counters["inserted"] += new
+            self.counters["evicted"] += evicted
+        return new
+
+    def _index_discard(self, key: bytes, val: tuple) -> None:
+        """Drop one key from the pruning index (mutex held)."""
+        hts = self._by_inst.get(val[0])
+        if hts is None:
+            return
+        bucket = hts.get(val[1])
+        if bucket is not None:
+            bucket.discard(key)
+            if not bucket:
+                del hts[val[1]]
+        if not hts:
+            del self._by_inst[val[0]]
+
+    def note_rejected_batch(self) -> None:
+        """Record that a settled dispatch carried rejected lanes and
+        its candidate entries were (all) discarded."""
+        with self._mu:
+            self.counters["insert_skipped_rejected"] += 1
+
+    def note_unverified_batch(self) -> None:
+        """Record that a settled signed dispatch carried NO reject
+        verdict (fail-closed skip: never insert on a missing
+        verdict)."""
+        with self._mu:
+            self.counters["insert_skipped_noverdict"] += 1
+
+    # -- pruning --------------------------------------------------------------
+
+    def prune_decided(self, heights: np.ndarray) -> int:
+        """Drop entries whose height is below their instance's current
+        height (stale-height screened: they can never reach a verify
+        lane again).  `heights` is the batcher's [I] per-instance
+        height view; out-of-range instances are left untouched.
+
+        O(entries pruned), never a full-cache walk: the per-instance
+        height index (`_by_inst`, maintained by insert/evict) names
+        exactly the dead buckets, and instances whose height did not
+        move since the last call are skipped entirely — callers may
+        prune on every poll/settle tick without blocking concurrent
+        admission lookups for more than the pruned entries' dict
+        ops."""
+        hts = np.asarray(heights)
+        n_inst = len(hts)
+        pruned = 0
+        with self._mu:
+            prev = self._last_prune
+            for inst, buckets in list(self._by_inst.items()):
+                if not 0 <= inst < n_inst:
+                    continue
+                h_now = int(hts[inst])
+                if prev is not None and inst < len(prev) \
+                        and int(prev[inst]) == h_now:
+                    continue                  # no advance: skip
+                for h in [h for h in buckets if h < h_now]:
+                    for key in buckets.pop(h):
+                        self._entries.pop(key, None)
+                        pruned += 1
+                if not buckets:
+                    del self._by_inst[inst]
+            self._last_prune = hts.copy()
+            self.counters["pruned_height"] += pruned
+        return pruned
+
+    # -- reporting ------------------------------------------------------------
+
+    @property
+    def hit_rate(self) -> float:
+        with self._mu:
+            h, m = self.counters["hits"], self.counters["misses"]
+        return h / (h + m) if h + m else 0.0
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            out = dict(self.counters)
+            out["entries"] = len(self._entries)
+        out["bytes"] = out["entries"] * ENTRY_BYTES
+        out["hit_rate"] = round(
+            out["hits"] / (out["hits"] + out["misses"]), 4) \
+            if out["hits"] + out["misses"] else 0.0
+        return out

@@ -67,6 +67,11 @@ class _StagedBatch:
     entry_heights: Optional[np.ndarray]
     n_votes: int
     t_first: float             # earliest admission instant
+    # dedup-cache insertion candidates of a signed (device-verify)
+    # build: (digest [N,32], instance [N], height [N]) of its real
+    # lanes, inserted at settle iff the dispatch rejected zero lanes
+    cache_keys: Optional[tuple] = None
+    preverified: bool = False  # unsigned build of dedup-cache hits
 
 
 @dataclass
@@ -74,6 +79,8 @@ class _Inflight:
     t_first: float
     n_votes: int
     t_dispatch: float
+    cache_keys: Optional[tuple] = None
+    rejects: object = None     # deferred device rejected-lane count
 
 
 class ServePipeline:
@@ -110,14 +117,26 @@ class ServePipeline:
                  window_predictor: Optional[Callable] = None,
                  donate: bool = True,
                  dense: Optional[bool] = None,
+                 cache=None,
                  tracer: Optional[Tracer] = None,
                  clock=time.monotonic):
+        """`cache` (serve/cache.VerifiedCache, shared with the
+        AdmissionQueue) enables the SPLIT-RUNG dispatch (ISSUE 5):
+        every tick's pending votes partition into a FRESH stream
+        (built signed, dispatched on the fused verify entries at a
+        now-smaller ladder rung) and a PRE-VERIFIED stream of
+        dedup-cache hits (built unsigned, dispatched on the verify-
+        free ``consensus_step_seq_*`` entries), interleaved under the
+        same double buffer; settle() inserts each signed dispatch's
+        wire digests into the cache iff its device verify rejected
+        zero lanes."""
         self.driver = driver
         self.batcher = batcher
         self.pubkeys = pubkeys          # None = unsigned deployment
         self.ladder = ladder
         self.window_predictor = window_predictor
         self.donate = donate
+        self.cache = cache
         self.dense = (dense if dense is not None
                       else getattr(driver, "mesh", None) is not None)
         self.tracer = tracer
@@ -137,6 +156,11 @@ class ServePipeline:
         self.dispatched_votes = 0
         self.noop_ticks = 0
         self.host_fallback_builds = 0
+        # split-rung dispatch accounting: builds/votes that rode the
+        # verify-free unsigned entries because every record was a
+        # dedup-cache hit (dispatched_* above count BOTH streams)
+        self.preverified_builds = 0
+        self.preverified_votes = 0
         # lane shapes above the ladder's top rung.  Historically: a
         # held future-round burst entering the window in the same
         # round as a full new batch drained into one build — a pow2
@@ -218,7 +242,9 @@ class ServePipeline:
                 self.batcher.add_arrays(batch.instance, batch.validator,
                                         batch.height, batch.round_,
                                         batch.typ, batch.value,
-                                        batch.signatures)
+                                        batch.signatures,
+                                        verified=batch.verified,
+                                        digest=batch.digest)
                 staged_any |= self._build_all(hts, batch.t_first)
         if not staged_any:
             self.noop_ticks += 1
@@ -229,19 +255,58 @@ class ServePipeline:
         `ladder.max_rung` votes per build (each build consumes its cap
         from the pending queue, so the loop strictly progresses even
         when a build densifies to nothing — held/stale votes leave
-        `pending` too)."""
+        `pending` too).
+
+        Split-rung dispatch (class docstring): on a signed deployment
+        the pending queue first partitions by the dedup-cache verified
+        flag — fresh rows build signed (smaller rungs once duplicates
+        are carved out), pre-verified rows build UNSIGNED afterwards
+        and ride the verify-free entries.  The partition lives in the
+        batcher (`split_pending_verified`) so held future-round votes
+        re-entering on a later tick keep their stream: a fresh vote can
+        never slip into an unsigned build."""
         staged = False
+        # gate on the CACHE, not merely a signed deployment: without
+        # one, no admission path ever sets the verified column, so the
+        # split would be a per-tick no-op walk — and a stray
+        # verified=True row fed directly to the batcher must not ride
+        # an unsigned build that no cache vouched for
+        pre = (self.batcher.split_pending_verified()
+               if self.cache is not None else [])
         while self.batcher.pending_votes > 0:
             before = self.batcher.pending_votes
             staged |= self._build_one(hts, t_first)
             if self.batcher.pending_votes >= before:  # defensive: a
                 break          # non-draining build must not spin
+        if pre:
+            # fail CLOSED on the security invariant: if the fresh loop
+            # exited via its defensive non-draining break, unverified
+            # rows are still pending — building "pre-verified" from
+            # that queue would drain them into an UNSIGNED dispatch.
+            # Re-park the verified rows instead (their flag survives;
+            # the next tick's split reclaims them) and only build when
+            # the queue holds nothing but cache hits.
+            leftover = self.batcher.pending_votes
+            self.batcher.adopt_pending(pre)
+            while leftover == 0 and self.batcher.pending_votes > 0:
+                before = self.batcher.pending_votes
+                staged |= self._build_one(hts, t_first,
+                                          preverified=True)
+                if self.batcher.pending_votes >= before:
+                    break
         return staged
 
-    def _build_one(self, hts: np.ndarray, t_first: float) -> bool:
+    def _build_one(self, hts: np.ndarray, t_first: float,
+                   preverified: bool = False) -> bool:
         """One capped build -> staged FIFO entry (False = densified to
-        nothing)."""
+        nothing).  `preverified` builds carry only dedup-cache hits:
+        identical bytes already device-verified, so they build through
+        the UNSIGNED phase path (no lanes, no verify) and dispatch on
+        the plain sequence entries."""
         cap = self.ladder.max_rung
+        keys = None
+        if preverified:
+            return self._stage_preverified(hts, t_first, cap)
         if self.pubkeys is not None:
             if self.dense:
                 phases, lanes = self.batcher.build_phases_device_dense(
@@ -250,6 +315,8 @@ class ServePipeline:
                 phases, lanes = self.batcher.build_phases_device(
                     self.pubkeys, phase_offset=1,
                     lane_floor=self.ladder.min_rung, max_votes=cap)
+            if self.cache is not None and lanes is not None:
+                keys = self.batcher.last_build_keys
         else:
             phases, lanes = self.batcher.build_phases(max_votes=cap), \
                 None
@@ -281,7 +348,36 @@ class ServePipeline:
         self._staged.append(_StagedBatch(
             phases=[p for p, _ in phases], lanes=lanes, entry=entry,
             entry_heights=hts if entry else None,
-            n_votes=n_votes, t_first=t_first))
+            n_votes=n_votes, t_first=t_first, cache_keys=keys))
+        return True
+
+    def _stage_preverified(self, hts: np.ndarray, t_first: float,
+                           cap: int) -> bool:
+        """Stage the pending PRE-VERIFIED rows (dedup-cache hits) as
+        unsigned builds, CHUNKED to at most two vote phases per staged
+        dispatch.  The chunking is the unsigned twin of the signed
+        path's eligibility gate: a cache-hit burst spanning several
+        rounds or equivocation layers densifies to one phase per
+        (round, class, layer), and an uncapped step sequence would
+        dispatch a P outside the warmed {2, 3} set — a live compile
+        stall (and, armed, a retrace failure) on exactly the path the
+        dedup layer exists to keep cheap.  Splitting a phase list
+        across sequential dispatches is semantics-preserving (a P-step
+        sequence IS P sequential steps), and every chunk dispatches
+        entry + <= 2 phases, a warmed shape.  The entry phase prepends
+        on every chunk: an extra empty step mid-round is a
+        state-machine no-op."""
+        groups = self.batcher.build_phases(max_votes=cap)
+        if not groups:
+            return False
+        for k in range(0, len(groups), 2):
+            chunk = groups[k:k + 2]
+            n_votes = sum(n for _, n in chunk)
+            self._entry_h = hts.copy()
+            self._staged.append(_StagedBatch(
+                phases=[p for p, _ in chunk], lanes=None, entry=True,
+                entry_heights=hts, n_votes=n_votes, t_first=t_first,
+                preverified=True))
         return True
 
     def dispatch_staged(self) -> int:
@@ -310,9 +406,17 @@ class ServePipeline:
                 raise
             self._inflight.append(_Inflight(
                 t_first=st.t_first, n_votes=st.n_votes,
-                t_dispatch=self._clock()))
+                t_dispatch=self._clock(), cache_keys=st.cache_keys,
+                rejects=getattr(self.driver, "last_step_rejects",
+                                None)))
             self.dispatched_batches += 1
             self.dispatched_votes += st.n_votes
+            if st.preverified:
+                # counted at DISPATCH (not staging): the metric's name
+                # promises dispatched votes, and a staged build can be
+                # requeued by a transient dispatch failure
+                self.preverified_builds += 1
+                self.preverified_votes += st.n_votes
             total += st.n_votes
         return total
 
@@ -329,10 +433,35 @@ class ServePipeline:
     def settle(self) -> List[_Inflight]:
         """Collect every queued message batch (the one host<->device
         sync point) and hand back the in-flight batch metadata so the
-        caller (service) can derive end-to-end latency."""
+        caller (service) can derive end-to-end latency.
+
+        Dedup-cache insertion happens HERE, after collect() has forced
+        every settled dispatch's outputs: a signed dispatch's wire
+        digests become cache entries iff its device verify rejected
+        ZERO lanes.  The device reports a rejected-lane count, not a
+        per-lane verdict, so a batch containing any forged signature
+        caches nothing — which is exactly what keeps an adversarial
+        replay of a REJECTED signature uncacheable forever."""
         with self._span("serve.collect"):
             self.driver.collect()
         done, self._inflight = self._inflight, []
+        if self.cache is not None:
+            for b in done:
+                if b.cache_keys is None:
+                    continue
+                if b.rejects is None:
+                    # no reject verdict for a signed dispatch (a
+                    # driver double that never set last_step_rejects):
+                    # the cache gate fails CLOSED — skip insertion
+                    # rather than assume the verify was clean
+                    self.cache.note_unverified_batch()
+                    continue
+                n_rej = int(np.asarray(b.rejects).sum())
+                if n_rej == 0:
+                    dig, inst, heights = b.cache_keys
+                    self.cache.insert(dig, inst, heights)
+                else:
+                    self.cache.note_rejected_batch()
         return done
 
     def warmup(self, n_phases=(2, 3), arm: bool = True) -> int:
@@ -372,6 +501,11 @@ class ServePipeline:
             n_phases = (n_phases,)
         d = self.driver
         zero_hts = np.zeros(d.I, np.int64)
+
+        def copies():
+            return (jax.tree.map(lambda x: x.copy(), d.state),
+                    jax.tree.map(lambda x: x.copy(), d.tally))
+
         warmed = 0
         for P in n_phases:
             phases = [self._entry_phase(zero_hts)] * P
@@ -385,33 +519,54 @@ class ServePipeline:
                     sig=jnp.zeros((Ps, d.I, d.V, 64), jnp.int32),
                     blocks=jnp.zeros((Ps, d.I, d.V, 1, 32), jnp.uint32))
                 fn = d._dense_dispatch_fn(Ps, donate=self.donate)
-                state_c = jax.tree.map(lambda x: x.copy(), d.state)
-                tally_c = jax.tree.map(lambda x: x.copy(), d.tally)
-                out = fn(state_c, tally_c, exts_st, phases_st, dense)
+                out = fn(*copies(), exts_st, phases_st, dense)
                 jax.block_until_ready(out.state)
                 warmed += 1
-                continue
-            name = ("consensus_step_seq_signed_donated" if self.donate
-                    else "consensus_step_seq_signed")
-            fn = registry.jit_entry(name)
-            for r in self.ladder.rungs:
-                lanes = SignedLanes(
-                    pub=jnp.zeros((r, 32), jnp.int32),
-                    sig=jnp.zeros((r, 64), jnp.int32),
-                    blocks=jnp.zeros((r, 1, 32), jnp.uint32),
-                    phase_idx=jnp.full(r, P, jnp.int32),     # dropped
-                    inst=jnp.zeros(r, jnp.int32),
-                    val=jnp.zeros(r, jnp.int32),
-                    real=jnp.zeros(r, bool))
-                state_c = jax.tree.map(lambda x: x.copy(), d.state)
-                tally_c = jax.tree.map(lambda x: x.copy(), d.tally)
-                chunk = d._resolve_lane_chunk(r)
-                args = (state_c, tally_c, exts_st, phases_st, lanes,
-                        d.powers, d.total, d.proposer_flag,
-                        d.propose_value)
-                d._observe(name, args, (d.advance_height, chunk))
-                out = fn(*args, advance_height=d.advance_height,
-                         verify_chunk=chunk)
+            else:
+                name = ("consensus_step_seq_signed_donated"
+                        if self.donate else "consensus_step_seq_signed")
+                fn = registry.jit_entry(name)
+                for r in self.ladder.rungs:
+                    lanes = SignedLanes(
+                        pub=jnp.zeros((r, 32), jnp.int32),
+                        sig=jnp.zeros((r, 64), jnp.int32),
+                        blocks=jnp.zeros((r, 1, 32), jnp.uint32),
+                        phase_idx=jnp.full(r, P, jnp.int32),  # dropped
+                        inst=jnp.zeros(r, jnp.int32),
+                        val=jnp.zeros(r, jnp.int32),
+                        real=jnp.zeros(r, bool))
+                    chunk = d._resolve_lane_chunk(r)
+                    args = (*copies(), exts_st, phases_st, lanes,
+                            d.powers, d.total, d.proposer_flag,
+                            d.propose_value)
+                    d._observe(name, args, (d.advance_height, chunk))
+                    out = fn(*args, advance_height=d.advance_height,
+                             verify_chunk=chunk)
+                    jax.block_until_ready(out.state)
+                    warmed += 1
+            if self.cache is not None:
+                # split-rung dispatch (ISSUE 5): pre-verified builds
+                # ride the UNSIGNED sequence entries — warm (and
+                # tripwire-arm) those at the same P, so a burst of
+                # dedup hits can never stall the service on a live
+                # unsigned-entry trace.  Their compile key carries no
+                # lane rung (phases are dense [P, I, V]): one shape
+                # per P, sharing this loop's stacked phases/exts.
+                args = (*copies(), exts_st, phases_st, d.powers,
+                        d.total, d.proposer_flag, d.propose_value)
+                if d.mesh is not None:
+                    d._observe("sharded_step_seq", args,
+                               (d.advance_height, self.donate))
+                    fn = d._make_sharded_seq(
+                        d.mesh, advance_height=d.advance_height,
+                        donate=self.donate)
+                    out = fn(*args)
+                else:
+                    name = ("consensus_step_seq_donated" if self.donate
+                            else "consensus_step_seq")
+                    d._observe(name, args, (d.advance_height,))
+                    out = registry.jit_entry(name)(
+                        *args, advance_height=d.advance_height)
                 jax.block_until_ready(out.state)
                 warmed += 1
         if arm and getattr(d, "sentinel", None) is not None:

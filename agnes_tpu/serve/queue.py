@@ -25,6 +25,17 @@ this queue is the system's overload valve and its first DoS surface:
   height staleness, signatures) stays with VoteBatcher/device, where
   it already exists — duplicating it would create two drifting
   truths.
+* **Verified-vote dedup** (ISSUE 5): with a `VerifiedCache`
+  (serve/cache.py) attached, every ADMITTED record's 96-byte wire
+  bytes are SHA-256'd and looked up — a hit (identical bytes already
+  device-verified in a settled dispatch) marks the record
+  *pre-verified*, and the pipeline's split-rung dispatch later routes
+  it to the verify-free unsigned step entries.  The lookup happens
+  here, at admission, because this is the last place the raw record
+  bytes exist (everything downstream carries columns); misses carry
+  their digest along so the pipeline can insert them once their
+  device verify lands.  Rejected records (overflow/fairness/
+  malformed) are never hashed or looked up.
 
 Pure numpy + stdlib; no jax anywhere on the admission path.
 """
@@ -32,6 +43,7 @@ Pure numpy + stdlib; no jax anywhere on the admission path.
 from __future__ import annotations
 
 import collections
+import hashlib
 import threading
 import time
 from dataclasses import dataclass
@@ -54,6 +66,7 @@ class AdmitResult(NamedTuple):
     rejected_fairness: int
     rejected_malformed: int
     evicted: int               # drop_oldest only: old records shed
+    pre_verified: int = 0      # dedup-cache hits among `accepted`
 
     @property
     def rejected(self) -> int:
@@ -71,25 +84,49 @@ class WireColumns(NamedTuple):
     typ: np.ndarray            # [N] int64
     value: np.ndarray          # [N] int64 (-1 = nil)
     signatures: np.ndarray     # [N, 64] uint8
+    verified: np.ndarray       # [N] bool — dedup-cache pre-verified
+    digest: Optional[np.ndarray]  # [N, 32] uint8 wire SHA-256s (cache
+    #                               attached) or None (dedup off)
     t_first: float             # earliest admission instant in the batch
 
     def __len__(self) -> int:
         return len(self.instance)
 
 
+def _record_digests(wire_bytes, idx: np.ndarray) -> np.ndarray:
+    """[len(idx), 32] uint8 SHA-256 of the selected whole 96-byte wire
+    records — the dedup cache key.  Hashed from the RAW bytes (not a
+    canonical re-pack), so the key means exactly "these bytes were
+    verified"; SHA-256 of 96 bytes is ~1us/record, admission-cheap."""
+    mv = memoryview(bytes(wire_bytes))
+    out = np.empty((len(idx), 32), np.uint8)
+    for j, k in enumerate(idx):
+        k = int(k)
+        out[j] = np.frombuffer(
+            hashlib.sha256(mv[k * REC_SIZE:(k + 1) * REC_SIZE]).digest(),
+            np.uint8)
+    return out
+
+
 @dataclass
 class _Chunk:
     """One admitted submit's (surviving) columns + admission time."""
 
-    cols: tuple                # 7 arrays, WireColumns order sans t_first
+    cols: tuple                # 8 arrays, WireColumns order sans
+    #                            digest/t_first
+    dig: Optional[np.ndarray]  # [N, 32] uint8 or None (dedup off)
     ts: float
 
     def __len__(self) -> int:
         return len(self.cols[0])
 
     def split(self, n: int):
-        head = _Chunk(tuple(c[:n] for c in self.cols), self.ts)
-        tail = _Chunk(tuple(c[n:] for c in self.cols), self.ts)
+        head = _Chunk(tuple(c[:n] for c in self.cols),
+                      self.dig[:n] if self.dig is not None else None,
+                      self.ts)
+        tail = _Chunk(tuple(c[n:] for c in self.cols),
+                      self.dig[n:] if self.dig is not None else None,
+                      self.ts)
         return head, tail
 
 
@@ -181,7 +218,11 @@ class AdmissionQueue:
     def __init__(self, n_instances: int, capacity: int,
                  instance_cap: Optional[int] = None,
                  policy: str = REJECT_NEWEST,
+                 cache=None,
                  clock=time.monotonic):
+        """`cache` is an optional serve/cache.VerifiedCache: admitted
+        records are digest-looked-up and hits marked pre-verified
+        (module docstring); None = dedup off, zero added work."""
         if capacity <= 0:
             raise ValueError(f"capacity must be positive: {capacity}")
         if policy not in (REJECT_NEWEST, DROP_OLDEST):
@@ -197,6 +238,7 @@ class AdmissionQueue:
             raise ValueError(
                 f"instance_cap must be positive: {instance_cap}")
         self.policy = policy
+        self.cache = cache
         self._clock = clock
         # deque: a realistic frontend submits a few records per peer
         # per call, so one micro-batch spans hundreds of chunks — a
@@ -269,9 +311,20 @@ class AdmissionQueue:
                     self.counters["evicted"] += evicted
 
         accepted = len(keep)
+        pre_verified = 0
         if accepted:
             sub = tuple(c[keep] for c in cols)
-            self._chunks.append(_Chunk(sub, self._clock()))
+            # dedup lookup LAST, on exactly the admitted records:
+            # rejects never pay the hash, and cache hit/miss counters
+            # add up to `admitted` (the accounting the metrics assert)
+            if self.cache is not None:
+                dig = _record_digests(wire_bytes, keep)
+                ver = self.cache.lookup(dig)
+                pre_verified = int(ver.sum())
+            else:
+                dig = None
+                ver = np.zeros(accepted, bool)
+            self._chunks.append(_Chunk(sub + (ver,), dig, self._clock()))
             self.depth += accepted
             np.add.at(self._inst_counts, sub[0], 1)
 
@@ -280,7 +333,8 @@ class AdmissionQueue:
         self.counters["rejected_fairness"] += rejected_fairness
         self.counters["rejected_malformed"] += malformed
         return AdmitResult(accepted, rejected_overflow,
-                           rejected_fairness, malformed, evicted)
+                           rejected_fairness, malformed, evicted,
+                           pre_verified)
 
     # -- drain ---------------------------------------------------------------
 
@@ -319,7 +373,12 @@ class AdmissionQueue:
         t_first = min(c.ts for c in chunks)
         if len(chunks) == 1:
             cols = chunks[0].cols
+            dig = chunks[0].dig
         else:
             cols = tuple(np.concatenate([c.cols[k] for c in chunks])
-                         for k in range(7))
-        return WireColumns(*cols, t_first=t_first)
+                         for k in range(8))
+            # cache attachment is per queue, so digests are all-or-none
+            # across chunks
+            dig = (np.concatenate([c.dig for c in chunks])
+                   if chunks[0].dig is not None else None)
+        return WireColumns(*cols, digest=dig, t_first=t_first)

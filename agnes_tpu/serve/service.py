@@ -57,6 +57,19 @@ SERVE_INFLIGHT = "serve_inflight"
 SERVE_E2E_LATENCY_S = "serve_e2e_latency_s"
 SERVE_ADMIT_RATE = "serve_admit_rate_per_sec_window"
 SERVE_DISPATCH_RATE = "serve_dispatch_rate_per_sec_window"
+#: verified-vote dedup layer (ISSUE 5, serve/cache.py): admission
+#: cache hits/misses (counters; hits + misses == admitted on a
+#: cache-enabled service), LRU evictions (counter, reconciled from the
+#: cache at settle), resident bytes (gauge), the WINDOWED hit-rate
+#: gauge (via Metrics.interval_rate — a lifetime rate would bury a
+#: traffic-pattern change), and votes dispatched on the verify-free
+#: unsigned entries (counter)
+SERVE_CACHE_HITS = "serve_cache_hits"
+SERVE_CACHE_MISSES = "serve_cache_misses"
+SERVE_CACHE_EVICTIONS = "serve_cache_evictions"
+SERVE_CACHE_BYTES = "serve_cache_bytes"                  # gauge
+SERVE_CACHE_HIT_RATE = "serve_cache_hit_rate_window"     # gauge
+SERVE_PREVERIFIED_DISPATCHED = "serve_preverified_votes_dispatched"
 #: threaded-host gauges (serve/threaded.py): per-thread depth and
 #: utilization — the inbox depth the submit thread drains, and each
 #: loop's busy fraction over its last gauge window
@@ -90,10 +103,33 @@ class VoteService:
                  ladder: Optional[ShapeLadder] = None,
                  window_predictor=None,
                  donate: bool = True,
+                 dedup_cache=None,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
                  clock=time.monotonic):
+        """`dedup_cache` enables the verified-vote dedup layer
+        (ISSUE 5): pass a serve/cache.VerifiedCache (or True for a
+        default-budget one).  Admission then digest-screens every
+        admitted record, cache hits dispatch on the verify-free
+        unsigned entries (split-rung dispatch), and settled clean
+        verifies populate the cache.  Off (None) by default: dedup is
+        a pure throughput optimization — decisions are bit-identical
+        either way (tests/test_serve_pipeline.py) — and an unsigned
+        deployment has nothing to dedup.  Requires `pubkeys`."""
         I, V = driver.I, driver.V
+        if dedup_cache is not None and dedup_cache is not False:
+            from agnes_tpu.serve.cache import VerifiedCache
+
+            if dedup_cache is True:
+                dedup_cache = VerifiedCache()
+            if pubkeys is None:
+                raise ValueError(
+                    "dedup_cache needs a signed deployment (pubkeys): "
+                    "unsigned services never verify, so there is "
+                    "nothing to dedup")
+        else:
+            dedup_cache = None
+        self.cache = dedup_cache
         if ladder is None:
             if getattr(driver, "mesh", None) is not None:
                 # dense dispatch mode: the compile shape is fixed by
@@ -109,14 +145,15 @@ class VoteService:
         capacity = capacity if capacity is not None else 4 * I * V
         self.queue = AdmissionQueue(I, capacity,
                                     instance_cap=instance_cap,
-                                    policy=overload_policy, clock=clock)
+                                    policy=overload_policy,
+                                    cache=self.cache, clock=clock)
         self.micro = MicroBatcher(self.queue, ladder,
                                   target_votes=target_votes,
                                   max_delay_s=max_delay_s, clock=clock)
         self.pipeline = ServePipeline(driver, batcher, pubkeys, ladder,
                                       window_predictor=window_predictor,
-                                      donate=donate, tracer=tracer,
-                                      clock=clock)
+                                      donate=donate, cache=self.cache,
+                                      tracer=tracer, clock=clock)
         self.driver = driver
         self.batcher = batcher
         self.metrics = metrics or Metrics()
@@ -153,6 +190,11 @@ class VoteService:
         m.count(SERVE_REJECTED_FAIRNESS, res.rejected_fairness)
         m.count(SERVE_REJECTED_MALFORMED, res.rejected_malformed)
         m.count(SERVE_EVICTED, res.evicted)
+        if self.cache is not None and res.accepted:
+            # hits + misses == admitted, per record, by construction
+            # (the queue looks up exactly the admitted set)
+            m.count(SERVE_CACHE_HITS, res.pre_verified)
+            m.count(SERVE_CACHE_MISSES, res.accepted - res.pre_verified)
         m.gauge(SERVE_QUEUE_DEPTH, self.queue.depth)
         return res
 
@@ -211,6 +253,28 @@ class VoteService:
         self.metrics.gauge(
             SERVE_DISPATCH_RATE,
             self.metrics.interval_rate(SERVE_VOTES_DISPATCHED))
+        if self.cache is not None:
+            m = self.metrics
+            # evictions happen inside the cache (insert-side): carry
+            # the delta into the registry so scrapes see one source
+            delta = (self.cache.counters["evicted"]
+                     - m.counters.get(SERVE_CACHE_EVICTIONS, 0))
+            if delta > 0:
+                m.count(SERVE_CACHE_EVICTIONS, delta)
+            delta = (self.pipeline.preverified_votes
+                     - m.counters.get(SERVE_PREVERIFIED_DISPATCHED, 0))
+            if delta > 0:
+                m.count(SERVE_PREVERIFIED_DISPATCHED, delta)
+            m.gauge(SERVE_CACHE_BYTES, self.cache.bytes)
+            # WINDOWED hit rate: both interval windows span the same
+            # stretch, so the per-second rates divide into a fraction
+            rh = m.interval_rate(SERVE_CACHE_HITS)
+            rm = m.interval_rate(SERVE_CACHE_MISSES)
+            m.gauge(SERVE_CACHE_HIT_RATE,
+                    rh / (rh + rm) if rh + rm > 0 else 0.0)
+            # decided heights can never reach a verify lane again:
+            # their entries are dead weight (poll-cadence prune)
+            self.cache.prune_decided(self.batcher.heights)
 
     def poll_decisions(self) -> List[Decision]:
         """Newly latched first-decisions since the last poll (under
@@ -259,6 +323,10 @@ class VoteService:
         #    window (forces the sync fetch; we are shutting down),
         #    then build + dispatch them through the pipeline's own
         #    stages so the report/metrics/latency accounting sees them
+        #    — stage() runs the same split-rung path as live ticks, so
+        #    flushed PRE-VERIFIED votes ride the verify-free unsigned
+        #    entries instead of paying a signed-rung dispatch at
+        #    shutdown (the ISSUE 5 drain fix)
         self.pipeline.window_predictor = None
         held_before = self.batcher.held_votes
         if held_before:
@@ -291,6 +359,10 @@ class VoteService:
             "offladder_builds": self.pipeline.offladder_builds,
             "dispatched_batches": self.pipeline.dispatched_batches,
             "dispatched_votes": self.pipeline.dispatched_votes,
+            "preverified_builds": self.pipeline.preverified_builds,
+            "preverified_votes": self.pipeline.preverified_votes,
+            "serve_cache": (self.cache.snapshot()
+                            if self.cache is not None else None),
             "metrics": self.metrics.snapshot(),
             "serve_rates_window": self.metrics.interval_rates(),
         }

@@ -25,6 +25,12 @@ WAIT-FREE relative to in-flight XLA dispatch:
 `submit()` itself takes NEITHER lock — it appends to the Inbox (its
 own nanosecond mutex).  So a socket thread can always hand bytes off,
 even while the dispatch thread sits inside a multi-second XLA call.
+The verified-vote dedup lookup (ISSUE 5, serve/cache.py) runs inside
+`queue.submit` on the SUBMIT thread under the admission lock — never
+under the device lock — and the cache's own leaf mutex is held for
+dict operations only, so dedup adds nothing to the wait-free story
+(settle-side insertion happens under the device lock, ordered against
+the cache only through that leaf mutex).
 
 Observability (per-thread depth/utilization, the ISSUE-3 satellite):
 `serve_inbox_depth`, `serve_submit_busy_frac` and

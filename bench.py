@@ -152,13 +152,31 @@ _SERVE_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_SMOKE"))
 #: FAKED 2-device CPU mesh (--xla_force_host_platform_device_count),
 #: same crash-safe contract
 _SERVE_MESH_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_MESH_SMOKE"))
+#: dedup-smoke mode (ci.sh gate, ISSUE 5): ONLY the duplicated-traffic
+#: serve probe — verified-vote dedup cache + split-rung dispatch — on
+#: CPU, same crash-safe contract.  AGNES_BENCH_SERVE_DUP sets the
+#: duplication factor (default 8)
+_SERVE_DEDUP_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_DEDUP_SMOKE"))
 _SENTINEL_METRIC = ("pipeline_serve_mesh_votes_per_sec"
                     if _SERVE_MESH_SMOKE
+                    else "pipeline_serve_dedup_votes_per_sec"
+                    if _SERVE_DEDUP_SMOKE
                     else "pipeline_fused_votes_per_sec" if _SERVE_SMOKE
                     else "pipeline_votes_per_sec")
 _SENTINEL_STAGE = ("bench_pipeline_serve_mesh" if _SERVE_MESH_SMOKE
+                   else "bench_pipeline_serve_dedup"
+                   if _SERVE_DEDUP_SMOKE
                    else "bench_pipeline_serve" if _SERVE_SMOKE
                    else "bench_pipeline")
+
+#: extra keys the in-flight stage wants on its final smoke record
+#: (e.g. the dedup probe's hit rate + dedup-off comparison); merged by
+#: _smoke_main at emit time
+_EXTRA_RECORD: dict = {}
+
+#: every serve smoke is a CPU-only CI gate (no TPU claim/lease/probe)
+_ANY_SERVE_SMOKE = (_SERVE_SMOKE or _SERVE_MESH_SMOKE
+                    or _SERVE_DEDUP_SMOKE)
 
 
 def _emit_sentinel(note: str) -> None:
@@ -516,7 +534,7 @@ if __name__ == "__main__":
     try:
         # serve smokes are CPU-only CI gates: no TPU claim, no lease,
         # no probe — a hung-axon screen would only burn their budget
-        _reason = (None if (_SERVE_SMOKE or _SERVE_MESH_SMOKE)
+        _reason = (None if _ANY_SERVE_SMOKE
                    else _backend_hung())
     except SystemExit:
         raise
@@ -563,12 +581,12 @@ os.environ["XLA_FLAGS"] = _flags
 # this platform (sitecustomize forces jax_platforms="axon,cpu"), so
 # the in-process config override follows right after the import — the
 # same two-step tests/conftest.py uses
-if _SERVE_SMOKE or _SERVE_MESH_SMOKE:
+if _ANY_SERVE_SMOKE:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 
-if _SERVE_SMOKE or _SERVE_MESH_SMOKE:
+if _ANY_SERVE_SMOKE:
     jax.config.update("jax_platforms", "cpu")
 
 from agnes_tpu.utils.compile_cache import disable_persistent_cache
@@ -1210,6 +1228,117 @@ def _pipeline_serve_mesh(n_instances: int, n_validators: int,
     return 2 * n * heights / dt
 
 
+def _pipeline_serve_dedup(n_instances: int, n_validators: int,
+                          heights: int, dup: Optional[int] = None
+                          ) -> float:
+    """CLOSED-LOOP through the serve plane under DUPLICATED traffic
+    (ISSUE 5): gossip delivers each vote O(peers) times, modeled here
+    as every height's prevote class arriving `dup` times — first copy
+    fresh (device-verified, then cached at settle), the re-deliveries
+    dedup-cache hits that the split-rung dispatch routes to the
+    verify-free unsigned entries.  Precommits arrive once and decide
+    the height (re-deliveries after a decision are stale-height drops
+    on EVERY path, so they model no verify work either way).
+
+    Measures dedup-ON, then replays the SAME traffic dedup-OFF in the
+    same process — every compiled shape is shared, so the second run
+    pays zero compiles and the speedup ratio is apples-to-apples.
+    Emits the comparison + hit rate via the smoke record's extra keys
+    (_EXTRA_RECORD)."""
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+    from agnes_tpu.core import native
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.serve import ShapeLadder, VerifiedCache, VoteService
+    from agnes_tpu.utils.config import RunConfig
+
+    dup = (int(os.environ.get("AGNES_BENCH_SERVE_DUP", "8"))
+           if dup is None else int(dup))
+    assert dup >= 2, f"duplication factor must be >= 2: {dup}"
+    I, V = n_instances, n_validators
+    seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(V)]
+    pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in seeds])
+    n = I * V
+    rung = 1 << (n - 1).bit_length()       # one vote CLASS per tick
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    PV, PC = int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)
+
+    def wire_class(h, typ, sigs):
+        return pack_wire_votes(inst, val, np.full(n, h), np.zeros(n),
+                               np.full(n, typ), np.full(n, 7),
+                               sigs[val])
+
+    all_wire = [
+        {typ: wire_class(h, typ, sigs)
+         for typ, sigs in _sign_height_sigs(seeds, h).items()}
+        for h in range(heights + 1)]
+
+    def run(dedup: bool):
+        d = DeviceDriver(I, V, advance_height=True, defer_collect=True,
+                         audit=True)
+        bat = RunConfig(n_validators=V, n_instances=I,
+                        n_slots=4).validate().make_batcher()
+        cur = {"h": 0}
+        svc = VoteService(
+            d, bat, pubkeys, capacity=4 * n, target_votes=n,
+            max_delay_s=1e9,                   # size-closed: one class
+            ladder=ShapeLadder.plan(I, V, min_rung=rung),
+            dedup_cache=VerifiedCache() if dedup else None,
+            window_predictor=lambda: (np.zeros(I, np.int64),
+                                      np.full(I, cur["h"], np.int64)))
+
+        def run_height(h):
+            cur["h"] = h
+            svc.submit(all_wire[h][PV])
+            svc.pump()              # densify the fresh prevotes
+            svc.pump()              # dispatch them
+            svc.poll_decisions()    # settle: clean verifies -> cache
+            for _ in range(dup - 1):         # gossip re-deliveries
+                svc.submit(all_wire[h][PV])
+                svc.pump()
+                svc.pump()
+            svc.submit(all_wire[h][PC])      # precommits decide h
+            svc.pump()
+            svc.pump()
+
+        run_height(0)                        # warmup + compiles
+        d.block_until_ready()
+        assert d.stats.decisions_total == I, d.stats.decisions_total
+        assert d.rejected_signature_device == 0
+
+        t0 = time.perf_counter()
+        for h in range(1, heights + 1):
+            run_height(h)
+        d.block_until_ready()
+        dt = time.perf_counter() - t0
+        assert d.stats.decisions_total == I * (heights + 1), \
+            d.stats.decisions_total
+        assert d.rejected_signature_device == 0
+        rep = svc.drain()
+        assert rep["queue"]["rejected_overflow"] == 0
+        assert rep["host_fallback_builds"] == 0
+        _harvest_audit(d)
+        # throughput = ADMITTED records over the steady heights: the
+        # duplication multiplier is the point — dedup absorbs the same
+        # offered load with a fraction of the verify lanes
+        return (dup + 1) * n * heights / dt, rep
+
+    rate_on, rep_on = run(dedup=True)
+    cache = rep_on["serve_cache"]
+    assert cache is not None and cache["hits"] > 0, cache
+    assert rep_on["preverified_votes"] > 0, rep_on
+    rate_off, _ = run(dedup=False)
+    _EXTRA_RECORD.update({
+        "serve_cache_hit_rate": cache["hit_rate"],
+        "serve_dedup_dup_factor": dup,
+        "pipeline_serve_dedup_off_votes_per_sec": round(rate_off),
+        "serve_dedup_speedup": (round(rate_on / rate_off, 2)
+                                if rate_off > 0 else -1),
+    })
+    return rate_on
+
+
 def bench_pipeline(n_instances: int = 1024, n_validators: int = 128,
                    heights: int = 6) -> float:
     """The flagship headline: end-to-end through the numpy bridge."""
@@ -1254,6 +1383,16 @@ def bench_pipeline_serve_mesh(n_instances: int = 1024,
     return _pipeline_serve_mesh(n_instances, n_validators, heights)
 
 
+def bench_pipeline_serve_dedup(n_instances: int = 1024,
+                               n_validators: int = 128,
+                               heights: int = 6) -> float:
+    """End-to-end through the serve plane under duplicated gossip
+    traffic (AGNES_BENCH_SERVE_DUP copies of each prevote, default 8):
+    verified-vote dedup cache + split-rung dispatch (ISSUE 5), with a
+    dedup-off replay of the same traffic for the speedup ratio."""
+    return _pipeline_serve_dedup(n_instances, n_validators, heights)
+
+
 def _smoke_main(stage: str, metric: str, value_key: str, unit: str,
                 env_prefix: str, bench_fn, what: str) -> None:
     """ONE crash-safe smoke entry shared by every ci.sh serve gate:
@@ -1288,6 +1427,7 @@ def _smoke_main(stage: str, metric: str, value_key: str, unit: str,
         value_key: rate,
         "note": (f"{what} at I={i} V={v} x{h} heights on CPU in "
                  f"{time.perf_counter() - t0:.0f}s"),
+        **_EXTRA_RECORD,
         **_ANALYSIS,
     }), flush=True)
     _EMITTED = True
@@ -1306,6 +1446,19 @@ def main_serve_smoke() -> None:
                 "pipeline_serve_votes_per_sec", "votes/sec/chip",
                 "AGNES_SERVE_SMOKE", bench_pipeline_serve,
                 "serve smoke: closed-loop streaming plane")
+
+
+def main_serve_dedup_smoke() -> None:
+    """The ci.sh dedup gate's entry (ISSUE 5): ONLY the duplicated-
+    traffic serve probe — dedup cache + split-rung dispatch, dedup-off
+    replay for the ratio — tiny shape, CPU, under the same crash-safe
+    contract.  The record carries serve_cache_hit_rate and the
+    dedup-off comparison via _EXTRA_RECORD."""
+    _smoke_main("bench_pipeline_serve_dedup",
+                "pipeline_serve_dedup_votes_per_sec",
+                "pipeline_serve_dedup_votes_per_sec", "votes/sec/chip",
+                "AGNES_SERVE_DEDUP_SMOKE", bench_pipeline_serve_dedup,
+                "dedup smoke: duplicated-traffic streaming plane")
 
 
 def main_serve_mesh_smoke() -> None:
@@ -1351,6 +1504,8 @@ def main() -> None:
     # multichip serve: real number on >= 2-device backends, -1 (via
     # the stage guard's exception containment) on a single chip
     pipeline_serve_mesh = guarded(bench_pipeline_serve_mesh)
+    # duplicated-traffic serve: dedup cache + split-rung dispatch
+    pipeline_serve_dedup = guarded(bench_pipeline_serve_dedup)
     tally = guarded(bench_tally)
     verifies = guarded(bench_verify)
     msm = guarded(bench_verify_msm)
@@ -1378,6 +1533,8 @@ def main() -> None:
         "pipeline_fused_votes_per_sec": pipeline_fused,
         "pipeline_serve_votes_per_sec": pipeline_serve,
         "pipeline_serve_mesh_votes_per_sec": pipeline_serve_mesh,
+        "pipeline_serve_dedup_votes_per_sec": pipeline_serve_dedup,
+        **_EXTRA_RECORD,
         "fused_tally_step_votes_per_sec": tally,
         "ed25519_verifies_per_sec": verifies,
         "ed25519_msm_verifies_per_sec": msm,
@@ -1392,6 +1549,7 @@ def main() -> None:
 if __name__ == "__main__":
     try:
         (main_serve_mesh_smoke() if _SERVE_MESH_SMOKE
+         else main_serve_dedup_smoke() if _SERVE_DEDUP_SMOKE
          else main_serve_smoke() if _SERVE_SMOKE else main())
     except BaseException as e:  # noqa: BLE001 — the contract: a
         # parseable record is the LAST stdout line no matter how this

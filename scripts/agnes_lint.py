@@ -137,7 +137,11 @@ def run_retrace(quick: bool, metrics):
 
     ladder = ShapeLadder.plan(64, 32, min_rung=256)
     findings = []
-    findings += retrace.coverage_findings(ladder, n_phases=(2, 3))
+    # the dedup=True shape set strictly contains the dedup=False one
+    # (ISSUE 5 split-rung dispatch: the pre-verified stream's unsigned
+    # sequence entries join the signed rungs), so one call covers both
+    findings += retrace.coverage_findings(ladder, n_phases=(2, 3),
+                                          dedup=True)
     findings += retrace.coverage_findings(ladder, n_phases=(2, 3),
                                           dense=True)
     detail = {"ladder_rungs": list(ladder.rungs),

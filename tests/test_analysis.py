@@ -226,6 +226,13 @@ def test_warmup_coverage_proof():
     assert not retrace.warmup_covers(ladder, n_phases=(3,))
     findings = retrace.coverage_findings(ladder, n_phases=(3,))
     assert findings and findings[0].code == "RET001"
+    # ISSUE 5 split-rung dispatch: a dedup-enabled service also
+    # dispatches the UNSIGNED sequence entries (one shape per P) for
+    # its pre-verified stream — covered by the cache-enabled warmup
+    assert retrace.warmup_covers(ladder, n_phases=(2, 3), dedup=True)
+    assert ("unsigned", 2) in retrace.dispatchable_shapes(ladder,
+                                                          dedup=True)
+    assert not retrace.warmup_covers(ladder, n_phases=(3,), dedup=True)
 
 
 def _stub_signed_jit(state, tally, exts, phases, lanes, powers, total,
@@ -307,6 +314,78 @@ def test_retrace_silent_across_warmup_and_serve_tick():
         with pytest.raises(retrace.RetraceError):
             d.step_async(phases, lanes)
     assert d.sentinel.metrics.counters[RETRACE_UNEXPECTED] == 1
+
+
+def _stub_seq_jit(state, tally, exts, phases, powers, total, pf, pv,
+                  advance_height=False, axis_name=None):
+    """Shape-faithful stand-in for the UNSIGNED fused sequence (the
+    split-rung dispatch's pre-verified entry) — zero XLA compiles."""
+    from agnes_tpu.device.step import N_STAGES, StepOutputs
+
+    P, I = phases.mask.shape[:2]
+    z = jnp.zeros((P, N_STAGES, I), I32)
+    return StepOutputs(
+        state=state, tally=tally,
+        msgs=DeviceMessage(tag=z, round=z, value=z, aux=z))
+
+
+def test_retrace_dedup_warmup_arms_unsigned_entries():
+    """ISSUE 5 acceptance (static half): a dedup-enabled service's
+    warmup precompiles AND tripwire-arms the unsigned sequence
+    entries alongside the signed rungs, so a burst of dedup-cache
+    hits (pre-verified ticks riding `consensus_step_seq_donated`)
+    dispatches inside the armed expected set — silently.  Registry-
+    stubbed: zero compiles."""
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        validator_pubkeys,
+    )
+    from agnes_tpu.serve import VerifiedCache, VoteService
+
+    I, V = 2, 8
+    pubkeys = validator_pubkeys(deterministic_seeds(V))
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True,
+                     audit=True)
+    bat = VoteBatcher(I, V, n_slots=4)
+    ladder = ShapeLadder.plan(I, V, max_votes=16, min_rung=8)
+    svc = VoteService(
+        d, bat, pubkeys, capacity=64, target_votes=16, max_delay_s=0.0,
+        ladder=ladder, dedup_cache=VerifiedCache(),
+        window_predictor=lambda: (np.zeros(I, np.int64),
+                                  np.zeros(I, np.int64)))
+    with registry.override("consensus_step_seq_signed_donated",
+                           jit=_stub_signed_jit), \
+            registry.override("consensus_step_seq_donated",
+                              jit=_stub_seq_jit):
+        warmed = svc.pipeline.warmup()
+        # signed P in {2,3} x rungs PLUS unsigned P in {2,3}
+        assert warmed == 2 * len(ladder.rungs) + 2
+        assert d.sentinel.armed
+
+        inst = np.repeat(np.arange(I), 4)
+        val = np.tile(np.arange(4), I)
+        n = len(inst)
+        wire = b"".join(
+            pack_wire_votes(inst, val, np.zeros(n), np.zeros(n),
+                            np.full(n, typ), np.full(n, 7))
+            for typ in (0, 1))
+        # fresh tick: signed dispatch (warmed), then settle -> cached
+        assert svc.submit(wire).accepted == 16
+        svc.pump()
+        svc.pump()
+        svc.poll_decisions()
+        assert len(svc.cache) == 16
+        # the gossip re-delivery: pre-verified tick on the UNSIGNED
+        # entry — in the armed set, so the sentinel stays silent
+        assert svc.submit(wire).pre_verified == 16
+        svc.pump()
+        svc.pump()
+        assert svc.pipeline.preverified_builds == 1
+        assert d.sentinel.report()["unexpected"] == 0
+    assert d.sentinel.metrics.counters.get(RETRACE_UNEXPECTED, 0) == 0
 
 
 # -- lockcheck ----------------------------------------------------------------

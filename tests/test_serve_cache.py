@@ -1,0 +1,360 @@
+"""Verified-vote dedup layer (ISSUE 5): VerifiedCache unit behavior
+(insert-after-verify only, rejected batches never cached, decided-
+height pruning, LRU byte bound, N-thread conservation) and the serve
+plane's split-rung dispatch — admission marks cache hits pre-verified,
+the pipeline builds them UNSIGNED while fresh traffic keeps the signed
+fused path, and settle() populates the cache only from clean device
+verifies.  Dispatch is stubbed throughout (the machinery under test is
+host-side), so everything here runs with ZERO XLA compiles (tier-1
+cheap; the dispatching differential lives in tests/test_serve_pipeline
+.py, slow-marked)."""
+
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+from agnes_tpu.bridge import VoteBatcher
+from agnes_tpu.bridge.native_ingest import REC_SIZE, pack_wire_votes
+from agnes_tpu.serve import (
+    AdmissionQueue,
+    ShapeLadder,
+    VerifiedCache,
+    VoteService,
+)
+from agnes_tpu.serve.cache import ENTRY_BYTES
+
+
+def _digests(wire: bytes) -> np.ndarray:
+    n = len(wire) // REC_SIZE
+    out = np.empty((n, 32), np.uint8)
+    for k in range(n):
+        out[k] = np.frombuffer(hashlib.sha256(
+            wire[k * REC_SIZE:(k + 1) * REC_SIZE]).digest(), np.uint8)
+    return out
+
+
+# -- cache unit ---------------------------------------------------------------
+
+def test_cache_insert_then_hit_and_counters():
+    c = VerifiedCache()
+    dig = np.arange(3 * 32, dtype=np.uint8).reshape(3, 32)
+    assert not c.lookup(dig).any()             # nothing cached yet
+    c.insert(dig[:2], np.array([0, 1]), np.array([5, 5]))
+    hits = c.lookup(dig)
+    np.testing.assert_array_equal(hits, [True, True, False])
+    assert len(c) == 2 and c.bytes == 2 * ENTRY_BYTES
+    assert c.counters["hits"] == 2 and c.counters["misses"] == 4
+    assert c.counters["inserted"] == 2
+    assert 0 < c.hit_rate < 1
+    snap = c.snapshot()
+    assert snap["entries"] == 2 and snap["hit_rate"] == round(2 / 6, 4)
+
+
+def test_cache_lru_byte_bound_evicts_oldest():
+    c = VerifiedCache(max_bytes=4 * ENTRY_BYTES)
+    dig = np.random.default_rng(0).integers(
+        0, 256, (6, 32)).astype(np.uint8)
+    c.insert(dig[:4], np.zeros(4), np.zeros(4))
+    c.lookup(dig[:1])                   # refresh entry 0 -> MRU
+    c.insert(dig[4:], np.zeros(2), np.zeros(2))   # evicts 2 LRU (1, 2)
+    assert len(c) == 4
+    assert c.counters["evicted"] == 2
+    hits = c.lookup(dig)
+    np.testing.assert_array_equal(
+        hits, [True, False, False, True, True, True])
+    with pytest.raises(ValueError):
+        VerifiedCache(max_bytes=1)
+
+
+def test_cache_prune_decided_heights():
+    c = VerifiedCache()
+    dig = np.arange(4 * 32, dtype=np.uint8).reshape(4, 32)
+    #                    inst    height
+    c.insert(dig, np.array([0, 0, 1, 1]), np.array([3, 5, 3, 5]))
+    pruned = c.prune_decided(np.array([5, 4]))   # inst0 at h5, inst1 h4
+    assert pruned == 2                  # (0, h3) and (1, h3) die
+    hits = c.lookup(dig)
+    np.testing.assert_array_equal(hits, [False, True, False, True])
+    assert c.counters["pruned_height"] == 2
+
+
+def test_cache_thread_conservation():
+    """N threads hammering lookup/insert: counters conserve (every
+    lookup row lands in hits or misses), size respects the budget, no
+    deadlock."""
+    budget_entries = 64
+    c = VerifiedCache(max_bytes=budget_entries * ENTRY_BYTES)
+    rng = np.random.default_rng(7)
+    keyspace = rng.integers(0, 256, (128, 32)).astype(np.uint8)
+    lookups = {"n": 0}
+    mu = threading.Lock()
+
+    def worker(seed):
+        r = np.random.default_rng(seed)
+        total = 0
+        for _ in range(50):
+            idx = r.integers(0, len(keyspace), 8)
+            sub = keyspace[idx]
+            c.lookup(sub)
+            total += len(sub)
+            c.insert(sub, idx, np.zeros(len(sub)))
+        with mu:
+            lookups["n"] += total
+
+    ts = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.counters["hits"] + c.counters["misses"] == lookups["n"]
+    assert len(c) <= budget_entries
+    assert c.bytes <= c.max_bytes
+
+
+# -- admission integration ----------------------------------------------------
+
+def _wire(inst, value=7, height=0, round_=0, typ=0):
+    inst = np.asarray(inst, np.int64)
+    n = len(inst)
+    return pack_wire_votes(inst, np.arange(n) % 4, np.full(n, height),
+                           np.full(n, round_), np.full(n, typ),
+                           np.full(n, value))
+
+
+def test_queue_marks_cache_hits_pre_verified():
+    cache = VerifiedCache()
+    q = AdmissionQueue(4, capacity=16, cache=cache)
+    wire = _wire([0, 1, 2])
+    res = q.submit(wire)
+    assert res.accepted == 3 and res.pre_verified == 0
+    b = q.drain()
+    assert b.digest is not None and not b.verified.any()
+    np.testing.assert_array_equal(b.digest, _digests(wire))
+    # simulate the settle-side insertion, then re-deliver
+    cache.insert(b.digest, b.instance, b.height)
+    res = q.submit(wire)
+    assert res.accepted == 3 and res.pre_verified == 3
+    assert q.drain().verified.all()
+    # hits + misses == admitted: rejected records are never hashed
+    full = AdmissionQueue(4, capacity=2, cache=VerifiedCache())
+    r = full.submit(_wire([0, 1, 2]))
+    assert r.accepted == 2
+    assert (full.cache.counters["hits"]
+            + full.cache.counters["misses"]) == 2
+
+
+def test_queue_without_cache_has_no_digest_column():
+    q = AdmissionQueue(4, capacity=16)
+    q.submit(_wire([0, 1]))
+    b = q.drain()
+    assert b.digest is None and not b.verified.any()
+
+
+# -- split-rung dispatch through the (stubbed) service ------------------------
+
+def _service(I=2, V=4, cache=True, **kw):
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        validator_pubkeys,
+    )
+
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bat = VoteBatcher(I, V, n_slots=4)
+    kw.setdefault("ladder", ShapeLadder.plan(I, V, min_rung=16))
+    kw.setdefault("capacity", 256)
+    kw.setdefault("max_delay_s", 0.0)
+    kw.setdefault("window_predictor",
+                  lambda: (np.zeros(I, np.int64), np.zeros(I, np.int64)))
+    svc = VoteService(d, bat, validator_pubkeys(deterministic_seeds(V)),
+                      dedup_cache=VerifiedCache() if cache else None,
+                      **kw)
+    dispatches = []
+
+    def stub(phases, lanes=None, exts=None, donate=True):
+        dispatches.append(lanes)
+        # mimic the real entry: rejected-lane handle per dispatch
+        # (None for unsigned), overridable via d._forced_rejects
+        d.last_step_rejects = (None if lanes is None
+                               else getattr(d, "_forced_rejects",
+                                            np.zeros((), np.int64)))
+
+    d.step_async = stub
+    return svc, d, bat, dispatches
+
+
+def _honest_wire(I, V, typ=0, round_=0):
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+    return pack_wire_votes(inst, val, np.zeros(n), np.full(n, round_),
+                           np.full(n, typ), np.full(n, 7))
+
+
+def test_split_dispatch_duplicates_ride_unsigned_entries():
+    """The tentpole behavior, host-side: a fresh tick dispatches
+    signed (lanes != None); after settle its digests are cached; the
+    SAME bytes re-delivered admit pre-verified and dispatch on the
+    unsigned entries (lanes None) — and insertion strictly follows the
+    device verify (a pre-settle duplicate still goes signed)."""
+    I, V = 2, 4
+    n = I * V
+    svc, d, bat, dispatches = _service(I, V)
+    wire = _honest_wire(I, V)
+
+    assert svc.submit(wire).pre_verified == 0
+    svc.pump()                          # stage fresh build
+    svc.pump()                          # dispatch: signed
+    assert len(dispatches) == 1 and dispatches[0] is not None
+
+    # insert-after-verify ONLY: nothing settled yet, so an immediate
+    # duplicate is NOT a cache hit and dispatches signed again
+    assert svc.submit(wire).pre_verified == 0
+    svc.pump()
+    svc.pump()
+    assert len(dispatches) == 2 and dispatches[1] is not None
+
+    svc.poll_decisions()                # settle: clean verify -> cache
+    assert len(svc.cache) == n
+    res = svc.submit(wire)
+    assert res.pre_verified == n
+    svc.pump()
+    svc.pump()
+    assert len(dispatches) == 3 and dispatches[2] is None   # unsigned!
+    assert svc.pipeline.preverified_builds == 1
+    assert svc.pipeline.preverified_votes == n
+    assert svc.pipeline.host_fallback_builds == 0
+    assert svc.pipeline.offladder_builds == 0
+
+    rep = svc.drain()
+    assert rep["dispatched_votes"] == 3 * n     # both streams counted
+    assert rep["preverified_votes"] == n
+    assert rep["serve_cache"]["hits"] == n
+    snap = rep["metrics"]
+    assert snap["serve_cache_hits"] == n
+    assert snap["serve_cache_misses"] == 2 * n
+    assert snap["serve_preverified_votes_dispatched"] == n
+    assert snap["serve_cache_bytes"] > 0
+
+
+def test_rejected_dispatch_never_populates_cache():
+    """Poisoning safety: a dispatch whose device verify rejected ANY
+    lane caches nothing, so an adversarial replay of a rejected
+    signature stays a cache miss (and re-pays the signed path)
+    forever."""
+    I, V = 2, 4
+    svc, d, bat, dispatches = _service(I, V)
+    d._forced_rejects = np.asarray(1, np.int64)   # device saw a forgery
+    wire = _honest_wire(I, V)
+    svc.submit(wire)
+    svc.pump()
+    svc.pump()
+    svc.poll_decisions()                # settle: rejects > 0 -> skip
+    assert len(svc.cache) == 0
+    assert svc.cache.counters["insert_skipped_rejected"] == 1
+    # the replay misses and dispatches signed again
+    assert svc.submit(wire).pre_verified == 0
+    svc.pump()
+    svc.pump()
+    assert len(dispatches) == 2
+    assert all(ln is not None for ln in dispatches)
+    assert svc.pipeline.preverified_builds == 0
+
+
+def test_held_preverified_votes_build_unsigned_on_reentry():
+    """Held future-round votes keep their pre-verified flag through
+    the hold-back queue: when the window rotates them in (the same
+    path VoteService.drain's held-vote flush takes), they build
+    UNSIGNED instead of paying a signed-rung dispatch."""
+    I, V = 2, 4
+    n = I * V
+    box = {"base": 0}
+    svc, d, bat, dispatches = _service(
+        I, V, window_predictor=lambda: (np.full(I, box["base"],
+                                                np.int64),
+                                        np.zeros(I, np.int64)))
+    wire = _honest_wire(I, V, round_=4)           # outside W=4 at base 0
+    # pre-populate the cache as a settled verify of these bytes would
+    svc.cache.insert(_digests(wire), np.repeat(np.arange(I), V),
+                     np.zeros(n))
+    assert svc.submit(wire).pre_verified == n
+    svc.pump()
+    assert bat.held_votes == n                    # held, still verified
+    box["base"] = 4                               # window rotates in
+    # a fresh tick triggers the sync that re-enters the held burst:
+    # the held (pre-verified) rows build UNSIGNED, the fresh precommit
+    # class builds signed — the window-aware split per stream
+    svc.submit(_honest_wire(I, V, typ=1, round_=4))
+    svc.pump()                                    # re-enter + stage
+    svc.pump()                                    # dispatch both
+    assert bat.held_votes == 0
+    assert len(dispatches) == 2
+    assert dispatches[0] is None                  # held burst: unsigned
+    assert dispatches[1] is not None              # fresh tick: signed
+    assert svc.pipeline.preverified_votes == n
+
+
+def test_preverified_multi_round_burst_chunks_to_warmed_shapes():
+    """A cache-hit burst spanning several rounds densifies to one
+    phase per (round, class) — an uncapped unsigned dispatch would
+    carry a step-sequence length outside the warmed {2, 3} set (a
+    live compile stall).  _stage_preverified chunks to <= 2 vote
+    phases per dispatch, entry prepended on each."""
+    I, V = 2, 2
+    n = I * V
+    svc, d, bat, _ = _service(I, V)
+    shapes = []
+    d.step_async = (lambda phases, lanes=None, exts=None, donate=True:
+                    shapes.append((len(phases), lanes)))
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    wire = b"".join(
+        pack_wire_votes(inst, val, np.zeros(n), np.full(n, r),
+                        np.zeros(n), np.full(n, 7))
+        for r in (0, 1, 2))             # 3 rounds, all in the window
+    svc.cache.insert(_digests(wire), np.tile(inst, 3), np.zeros(3 * n))
+    assert svc.submit(wire).pre_verified == 3 * n
+    svc.pump()
+    svc.pump()
+    # 3 phase groups -> chunks of (2, 1) vote phases, each + entry
+    assert [p for p, _ in shapes] == [3, 2]
+    assert all(lanes is None for _, lanes in shapes)
+    assert svc.pipeline.preverified_builds == 2
+    assert svc.pipeline.preverified_votes == 3 * n
+
+
+def test_dedup_cache_requires_signed_deployment():
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    d = DeviceDriver(2, 4)
+    bat = VoteBatcher(2, 4, n_slots=4)
+    with pytest.raises(ValueError):
+        VoteService(d, bat, None, dedup_cache=True,
+                    ladder=ShapeLadder.plan(2, 4, min_rung=16))
+
+
+def test_cache_pruned_on_poll_cadence():
+    """_settle prunes entries for heights the instances have left
+    (their records are stale-height drops on every path)."""
+    I, V = 2, 4
+    n = I * V
+    heights = np.zeros(I, np.int64)
+    svc, d, bat, dispatches = _service(
+        I, V, window_predictor=lambda: (np.zeros(I, np.int64),
+                                        heights.copy()))
+    wire = _honest_wire(I, V)
+    svc.submit(wire)
+    svc.pump()
+    svc.pump()
+    svc.poll_decisions()
+    assert len(svc.cache) == n
+    heights[:] = 1                      # instances advance to height 1
+    # a fresh height-1 tick syncs the batcher onto the new heights
+    svc.submit(pack_wire_votes([0], [0], [1], [0], [0], [7]))
+    svc.pump()
+    svc.pump()
+    svc.poll_decisions()                # poll-cadence prune
+    assert svc.cache.counters["pruned_height"] == n
+    assert len(svc.cache) == 1          # only the height-1 record left

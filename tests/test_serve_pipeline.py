@@ -261,6 +261,111 @@ def test_serve_mesh_threaded_bit_identical_to_single_and_offline():
 
 
 @pytest.mark.slow
+def test_serve_dedup_bit_identical_and_rejected_replay():
+    """ISSUE 5 acceptance: decisions served with the verified-vote
+    dedup cache ON are BIT-identical — state/tally leaf-for-leaf and
+    identical decision stats — to a dedup-OFF run and to the offline
+    fused path, on traffic that includes gossip re-deliveries AND an
+    adversarial replay of a REJECTED signature.
+
+    Per height: fresh prevotes (validator 0's signature FORGED at
+    height 0), a settle, then the exact same prevote wire re-delivered
+    (height 0: the forged batch cached nothing, so the replay — forged
+    record included — re-pays the signed path and is rejected again;
+    height 1: a clean cache hit riding the verify-free unsigned
+    entry), then fresh precommits deciding the height.  donate=False
+    everywhere so the three runs share each jit entry."""
+    from agnes_tpu.serve import VerifiedCache
+
+    heights = 2
+    RUNG1 = 1 << (N - 1).bit_length()      # single-class ticks
+
+    def wire_class(h, typ, forge=None):
+        return pack_wire_votes(*full_mesh_cols(
+            I, V, SEEDS, h, typ, 7, forge_validator=forge))
+
+    def forge_for(h):
+        return 0 if h == 0 else None
+
+    # offline fused reference: the same three ticks per height, built
+    # and dispatched by hand (no cache — offline IS dedup-off)
+    dA = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bA = VoteBatcher(I, V, n_slots=4)
+    for h in range(heights):
+        bA.sync_device(np.zeros(I, np.int64), np.full(I, h, np.int64))
+        for typ, forge in ((PV, forge_for(h)), (PV, forge_for(h)),
+                           (PC, None)):
+            bA.add_arrays(*full_mesh_cols(I, V, SEEDS, h, typ, 7,
+                                          forge_validator=forge))
+            phases, lanes = bA.build_phases_device(
+                PUBKEYS, phase_offset=1, lane_floor=RUNG1)
+            dA.step_seq_signed(
+                [dA.empty_phase()] + [p for p, _ in phases], lanes)
+    dA.block_until_ready()
+    assert dA.stats.decisions_total == I * heights
+    assert dA.rejected_signature_device == 2 * I    # forged tick + replay
+
+    def run_serve(dedup):
+        box = {"h": 0}
+        d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+        bat = VoteBatcher(I, V, n_slots=4)
+        svc = VoteService(
+            d, bat, PUBKEYS, capacity=4 * 2 * N, target_votes=N,
+            max_delay_s=0.0,
+            ladder=ShapeLadder.plan(I, V, min_rung=RUNG1),
+            dedup_cache=VerifiedCache() if dedup else None,
+            window_predictor=lambda: (np.zeros(I, np.int64),
+                                      np.full(I, box["h"], np.int64)),
+            donate=False)
+        for h in range(heights):
+            box["h"] = h
+            svc.submit(wire_class(h, PV, forge_for(h)))   # fresh
+            svc.pump()
+            svc.pump()
+            svc.poll_decisions()       # settle: clean verifies cache
+            svc.submit(wire_class(h, PV, forge_for(h)))   # re-delivery
+            svc.pump()
+            svc.pump()
+            svc.submit(wire_class(h, PC))                 # decide h
+            svc.pump()
+            svc.pump()
+        rep = svc.drain()
+        return d, rep
+
+    dON, repON = run_serve(dedup=True)
+    dOFF, repOFF = run_serve(dedup=False)
+
+    # the dedup layer did real work — and only the safe part of it
+    cache = repON["serve_cache"]
+    assert cache["hits"] == N                # height-1 replay only
+    assert cache["insert_skipped_rejected"] == 2   # h0 forged + replay
+    assert repON["preverified_votes"] == N
+    assert repOFF["preverified_votes"] == 0 and repOFF["serve_cache"] is None
+    # the adversarial replay of the rejected signature re-paid the
+    # device verify in BOTH modes: forged tick + its replay, per mode
+    for rep in (repON, repOFF):
+        assert rep["rejected_signature_device"] == 2 * I
+        assert rep["decisions_total"] == I * heights
+        assert rep["host_fallback_builds"] == 0
+        assert rep["offladder_builds"] == 0
+
+    # bit-identity: dedup-on == dedup-off == offline fused
+    for tag, dX in (("offline", dA), ("dedup-off", dOFF)):
+        for a, b in zip(dX.state, dON.state):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=f"state vs {tag}")
+        for a, b in zip(dX.tally, dON.tally):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=f"tally vs {tag}")
+        np.testing.assert_array_equal(dX.stats.decision_value,
+                                      dON.stats.decision_value)
+        np.testing.assert_array_equal(dX.stats.decision_round,
+                                      dON.stats.decision_round)
+        np.testing.assert_array_equal(dX.stats.decided,
+                                      dON.stats.decided)
+
+
+@pytest.mark.slow
 def test_serve_unsigned_equivocation_flood():
     """A byzantine equivocation flood through the queue on an UNSIGNED
     service: validator 0 double-votes in every instance, the batcher
