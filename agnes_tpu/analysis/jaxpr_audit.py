@@ -398,7 +398,7 @@ def shard_coverage_findings(union_names) -> List[Finding]:
     return [Finding(
         "jaxpr", "AUD006", ",".join(missing),
         "audit-planned entries missing from every worker shard — "
-        "update the shard table (scripts/agnes_lint.py) or derive it "
+        "update the shard table (analysis/lint_cli.py) or derive it "
         "from planned_names()")]
 
 

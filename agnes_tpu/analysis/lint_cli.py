@@ -1,0 +1,230 @@
+"""agnes_lint CLI: the static invariant analyzer's entry point.
+
+Runs the four analysis passes over the repo — all CPU, ZERO XLA
+compiles (abstract tracing only) — and exits non-zero on any finding:
+
+  jaxpr    abstract-trace every registered jit entry: donation
+           honored, collective census + verify_chunk invariance, no
+           host callbacks, dtype policy
+  retrace  static warmup-coverage proof: every signed shape the serve
+           plane can dispatch is covered by the warmup plan (the
+           no-live-compile invariant; the runtime half is
+           DeviceDriver(audit=True))
+  locks    serve/threaded.py two-lock discipline + no bare
+           .acquire()/.release() anywhere in serve//utils.metrics
+  lint     serve hot-path host syncs, unregistered import-time jits,
+           unhashable static-argnum candidates
+
+Invoked as `scripts/agnes_lint.py` (the repo shim) or the installed
+`agnes-lint` console script (pyproject [project.scripts]).  The CLI
+logic lives HERE, inside the package, so the entry point resolves
+without shipping a top-level `scripts` package; the backend env setup
+(CPU platform, virtual devices, single-threaded codegen) runs at the
+top of `main()` — before any jax import in this process, and inherited
+by the spawned audit workers.
+
+The full `--pass all` budget is < 120s on the 2-CPU CI box (the heavy
+traces are the Ed25519-bearing entries at ~15-20s of tracing each);
+ci.sh bounds it with an enclosing timeout regardless.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+PASSES = ("jaxpr", "retrace", "locks", "lint")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def setup_backend_env() -> None:
+    """Backend config BEFORE jax import (same dance as
+    tests/conftest.py): this environment's sitecustomize registers an
+    axon TPU backend; the analyzer must trace on CPU, with enough
+    virtual devices for a (data x val) audit mesh, and without the
+    racy parallel codegen.  Must run before anything imports jax —
+    call it first in main(); the repo shim also runs it at import."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    if "xla_cpu_parallel_codegen_split_count" not in flags:
+        flags = (flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _jaxpr_worker(task):
+    """One audit shard in its own interpreter (spawned): tracing is
+    pure-python and the heavy Ed25519 graphs are independent, so the
+    shards parallelize across cores; fresh processes also sidestep
+    this box's XLA:CPU after-many-operations fragility."""
+    names, coverage, union = task
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from agnes_tpu.utils.compile_cache import disable_persistent_cache
+
+    disable_persistent_cache()
+    import dataclasses
+
+    from agnes_tpu.analysis import jaxpr_audit
+    from agnes_tpu.utils.metrics import ANALYSIS_ENTRIES_AUDITED, Metrics
+
+    m = Metrics()
+    rep = jaxpr_audit.audit(names=names, metrics=m, coverage=coverage)
+    if coverage and union is not None:
+        # the shard split itself must cover the full audit plan — a
+        # registered entry in no shard would silently never be traced
+        rep.findings.extend(
+            jaxpr_audit.shard_coverage_findings(union))
+    return ([dataclasses.asdict(f) for f in rep.findings],
+            [dataclasses.asdict(e) for e in rep.entries],
+            rep.skipped,
+            m.counters.get(ANALYSIS_ENTRIES_AUDITED, 0))
+
+
+#: audit shards balanced by trace weight: the chunk-invariance pair
+#: (sharded signed, traced twice) in one, the two single-device
+#: Ed25519-bearing twins in another, everything cheap in a third
+_JAXPR_SHARDS = (
+    ["sharded_step_seq_signed"],
+    ["consensus_step_seq_signed_donated",
+     "consensus_step_seq_signed_dense_donated"],
+    ["consensus_step", "consensus_step_seq",
+     "consensus_step_seq_donated", "honest_heights", "sharded_step",
+     "sharded_step_seq", "sharded_honest_heights"],
+)
+
+
+def run_jaxpr(quick: bool, metrics):
+    from agnes_tpu.utils.metrics import ANALYSIS_ENTRIES_AUDITED
+
+    union = sorted(set().union(*_JAXPR_SHARDS))
+    if quick:
+        tasks = [(_JAXPR_SHARDS[2], True, None)]
+    else:
+        tasks = [(names, i == 0, union if i == 0 else None)
+                 for i, names in enumerate(_JAXPR_SHARDS)]
+    if len(tasks) == 1:
+        results = [_jaxpr_worker(tasks[0])]
+    else:
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")     # no forked-jax state
+        with ctx.Pool(processes=min(len(tasks),
+                                    max(2, os.cpu_count() or 2))) as p:
+            results = p.map(_jaxpr_worker, tasks)
+    from agnes_tpu.analysis.jaxpr_audit import Finding
+
+    findings, entries, skipped = [], [], []
+    for f_dicts, e_dicts, skip, audited in results:
+        findings.extend(Finding(**d) for d in f_dicts)
+        entries.extend(e_dicts)
+        skipped.extend(skip)
+        metrics.count(ANALYSIS_ENTRIES_AUDITED, audited)
+    detail = {
+        "entries": [{"entry": e["entry"],
+                     "collectives": e["collectives"],
+                     "aliased": e["aliased"]} for e in entries],
+        "skipped": skipped,
+    }
+    return findings, detail
+
+
+def run_retrace(quick: bool, metrics):
+    # static proof only — no arrays, no jax: the serve build policy's
+    # dispatchable (P, rung) set vs the warmup plan, checked at a
+    # representative deployment shape AND the warmup default
+    from agnes_tpu.analysis import retrace
+    from agnes_tpu.serve.batcher import ShapeLadder
+
+    ladder = ShapeLadder.plan(64, 32, min_rung=256)
+    findings = []
+    # the dedup=True shape set strictly contains the dedup=False one
+    # (ISSUE 5 split-rung dispatch: the pre-verified stream's unsigned
+    # sequence entries join the signed rungs), so one call covers both
+    findings += retrace.coverage_findings(ladder, n_phases=(2, 3),
+                                          dedup=True)
+    findings += retrace.coverage_findings(ladder, n_phases=(2, 3),
+                                          dense=True)
+    detail = {"ladder_rungs": list(ladder.rungs),
+              "covered": not findings}
+    return findings, detail
+
+
+def run_locks(quick: bool, metrics):
+    from agnes_tpu.analysis import lockcheck
+
+    findings = lockcheck.check_paths(lockcheck.default_paths(_REPO))
+    return findings, {"paths": lockcheck.default_paths(_REPO)}
+
+
+def run_lint(quick: bool, metrics):
+    from agnes_tpu.analysis import lint
+
+    return lint.check_repo(_REPO), {}
+
+
+RUNNERS = {"jaxpr": run_jaxpr, "retrace": run_retrace,
+           "locks": run_locks, "lint": run_lint}
+
+
+def main(argv=None) -> int:
+    setup_backend_env()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pass", dest="passes", default="all",
+                    choices=PASSES + ("all",),
+                    help="which analysis pass to run (default: all)")
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the Ed25519-heavy jaxpr traces")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
+    args = ap.parse_args(argv)
+    selected = PASSES if args.passes == "all" else (args.passes,)
+
+    from agnes_tpu.utils.metrics import (
+        ANALYSIS_ENTRIES_AUDITED,
+        RETRACE_UNEXPECTED,
+        Metrics,
+    )
+
+    metrics = Metrics()
+    report = {"passes": {}, "findings": []}
+    t_all = time.perf_counter()
+    for name in selected:
+        t0 = time.perf_counter()
+        findings, detail = RUNNERS[name](args.quick, metrics)
+        dt = time.perf_counter() - t0
+        report["passes"][name] = {
+            "findings": len(findings), "seconds": round(dt, 1),
+            **detail,
+        }
+        report["findings"].extend(
+            {"pass": f.pass_name, "code": f.code, "where": f.where,
+             "message": f.message} for f in findings)
+        if not args.json:
+            status = "CLEAN" if not findings else \
+                f"{len(findings)} finding(s)"
+            print(f"[agnes_lint] {name}: {status} ({dt:.1f}s)",
+                  file=sys.stderr, flush=True)
+            for f in findings:
+                print(f"  {f}", file=sys.stderr, flush=True)
+    report["seconds"] = round(time.perf_counter() - t_all, 1)
+    report["metrics"] = {
+        ANALYSIS_ENTRIES_AUDITED:
+            metrics.counters.get(ANALYSIS_ENTRIES_AUDITED, 0),
+        RETRACE_UNEXPECTED:
+            metrics.counters.get(RETRACE_UNEXPECTED, 0),
+    }
+    report["ok"] = not report["findings"]
+    print(json.dumps(report, sort_keys=True), flush=True)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1007 @@
+"""Exhaustive bounded model checker for the consensus core (ISSUE 6).
+
+The reference's whole design argument is that the consensus core is
+pure and I/O-free precisely so its logic can be checked without
+networking or signatures (README.md:8-14) — yet until this module the
+only guard on the *semantics* was a 100-random-seed fuzz
+(tests/test_cross_plane.py).  TOB-SVD (arXiv 2310.11331) catalogues
+exactly the class of adversarial participation/schedule corners that
+sampled fuzzing misses.  This checker closes the gap for SMALL SCOPES:
+it exhaustively enumerates every delivery/timeout/partition schedule of
+the host plane (harness/simulator.py step mode) within explicit bounds
+and checks spec-level property monitors on every reachable state.
+
+Soundness envelope — what "exhaustive" means here
+-------------------------------------------------
+
+Exhaustive WITHIN the bounds of an `MCConfig`, nothing beyond them:
+
+  * N nodes with a fixed behavior assignment (honest / silent /
+    equivocator / nil_flood — the simulator's fault models), one
+    optional partition/heal cycle;
+  * schedule length <= `depth` actions;
+  * rounds <= `max_round` (rounds only advance off TIMEOUT_PRECOMMIT
+    fires, which the action enumerator caps);
+  * heights <= `max_height` (states where every node has advanced past
+    the bound stop expanding).
+
+Within that envelope every interleaving is covered: the explorer is a
+depth-bounded DFS over the step-mode transition system with
+
+  * canonical state hashing (`Network.mc_digest` over int-only
+    canonical forms — deadline-free timers, dead-timer erasure, history
+    erasure) so converging interleavings merge, and
+  * partial-order reduction: deliveries/timeouts targeting DISTINCT
+    nodes commute (they touch disjoint node state and disjoint channel
+    heads), so after exploring independent action `a` from a state, the
+    lower-ordered independent siblings already explored from that state
+    are put to sleep in `a`'s subtree — the pruned interleaving's
+    successor is exactly the state the sibling-first branch reaches.
+    Partition/heal are global (never slept).  `por=False` disables the
+    reduction; tests assert por/no-por reach the SAME state set.
+
+Property monitors (checked on every new state / transition):
+
+  agreement      no two nodes decide different values at a height
+                 (every node runs honest executor logic — byzantine
+                 behaviors are router policies — so ALL nodes count)
+  validity       every decided value was carried by some WireProposal
+                 of that height
+  quorum         every decision's DecisionCert (core/executor.py)
+                 shows +2/3 precommit weight — no decide without quorum
+  monotonic      per node, (height, round, step) never decreases
+  evidence       every schedule-injected equivocation pair that was
+                 delivered-and-counted is surfaced by round_votes
+                 (`all_equivocations`)
+
+Any violation is delta-debug-minimized (`minimize`) to a short
+schedule; `run_schedule` skips not-enabled actions, which is what makes
+arbitrary ddmin subsets replayable.  A minimized counterexample is
+serialized as a corpus entry (tests/corpus/*.json) and can be replayed
+through the PRODUCTION device plane (`device_replay_entry`:
+VoteBatcher -> fused step via harness/replay.py) so a semantic
+counterexample immediately becomes a cross-plane differential case.
+
+The checker itself is pure CPU, ZERO jax imports, ZERO XLA compiles —
+it runs in the same pre-test ci.sh gate slot as agnes_lint, with the
+same frontier-sharded spawn-worker parallelism (`run_scope`) and the
+same deadline-bounded real-value-or-sentinel contract.
+
+Mutation self-test (`self_test` / `--self-test`): two doctored
+executors — one that decides without quorum, one that drops
+equivocation evidence — must each be caught, minimized, and must
+vanish when the same schedule replays on the honest executor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from agnes_tpu.core.executor import ConsensusExecutor
+from agnes_tpu.core import state_machine as sm
+from agnes_tpu.harness.simulator import Network, NodeSpec
+from agnes_tpu.types import VoteType
+
+PROPERTIES = ("agreement", "validity", "quorum", "monotonic", "evidence")
+
+
+# ---------------------------------------------------------------------------
+# Configuration
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MCConfig:
+    """One bounded-exploration task: a behavior assignment plus the
+    exhaustiveness envelope.  JSON-able (spawn workers, corpus files)."""
+
+    name: str
+    n: int = 4
+    behaviors: Tuple[str, ...] = ("honest",) * 4
+    depth: int = 10
+    max_round: int = 1
+    max_height: int = 0
+    partition: Optional[Tuple[Tuple[int, ...], ...]] = None
+    get_value_base: int = 100
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["behaviors"] = list(self.behaviors)
+        d["partition"] = None if self.partition is None else \
+            [list(g) for g in self.partition]
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "MCConfig":
+        d = dict(d)
+        d["behaviors"] = tuple(d["behaviors"])
+        if d.get("partition") is not None:
+            d["partition"] = tuple(tuple(g) for g in d["partition"])
+        return cls(**d)
+
+
+def build_network(cfg: MCConfig,
+                  executor_cls: Optional[type] = None,
+                  sign: bool = False,
+                  verify: Optional[bool] = None,
+                  start: bool = True) -> Network:
+    """A step-mode Network for `cfg`.  The checker runs unsigned +
+    unverified (crypto is differential-tested elsewhere; the schedule
+    space is about consensus logic); corpus replay rebuilds the SAME
+    config signed + verifying for production parity (sign=True)."""
+    base = cfg.get_value_base
+    net = Network(
+        n=cfg.n,
+        specs=[NodeSpec(behavior=b) for b in cfg.behaviors],
+        get_value=lambda h: base + h,
+        verify_signatures=sign if verify is None else verify,
+        sign_messages=sign,
+        executor_cls=executor_cls or ConsensusExecutor)
+    net.enable_step_mode(partition_groups=cfg.partition,
+                         max_height=cfg.max_height)
+    if start:
+        net.mc_start()
+    return net
+
+
+# ---------------------------------------------------------------------------
+# Property monitors
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    property: str
+    node: int                  # -1 for global properties
+    detail: str
+
+
+def _edge_snapshot(net: Network) -> list:
+    """The per-node facts the transition monitors compare across one
+    action: position, decision/cert counts."""
+    return [((nd.height, nd.state.round, int(nd.state.step)),
+             len(nd.decisions), len(nd.decision_certs))
+            for nd in net.nodes]
+
+
+def _edge_violations(net: Network, snap: list) -> List[Violation]:
+    """Monotonicity + quorum certificates, checked on the transition
+    from the state `snap` was taken in to `net`'s current state."""
+    out: List[Violation] = []
+    for j, nd in enumerate(net.nodes):
+        pos0, n_dec0, _n_cert0 = snap[j]
+        pos = (nd.height, nd.state.round, int(nd.state.step))
+        if pos < pos0:
+            out.append(Violation(
+                "monotonic", j,
+                f"(height, round, step) went {pos0} -> {pos}"))
+        for i in range(n_dec0, len(nd.decisions)):
+            d = nd.decisions[i]
+            if i >= len(nd.decision_certs):
+                out.append(Violation(
+                    "quorum", j,
+                    f"decision {d} recorded without a quorum "
+                    f"certificate"))
+                continue
+            c = nd.decision_certs[i]
+            if (c.height, c.round, c.value) != (d.height, d.round,
+                                                d.value):
+                out.append(Violation(
+                    "quorum", j,
+                    f"certificate {c} does not match decision {d}"))
+            elif not 3 * c.weight > 2 * c.total:
+                out.append(Violation(
+                    "quorum", j,
+                    f"decided {d.value} at (h={d.height}, r={d.round}) "
+                    f"on precommit weight {c.weight}/{c.total} "
+                    f"(< +2/3)"))
+    return out
+
+
+def _state_violations(net: Network) -> List[Violation]:
+    """Agreement, validity, evidence completeness — state predicates."""
+    out: List[Violation] = []
+    by_height: Dict[int, Dict[int, int]] = {}
+    for j, nd in enumerate(net.nodes):
+        for h, d in nd.decided.items():
+            by_height.setdefault(h, {})[j] = d.value
+    for h, m in sorted(by_height.items()):
+        if len(set(m.values())) > 1:
+            out.append(Violation(
+                "agreement", -1,
+                f"height {h} decided as {sorted(m.items())}"))
+        proposed = net._proposed.get(h, ())
+        for j, v in sorted(m.items()):
+            if v not in proposed:
+                out.append(Violation(
+                    "validity", j,
+                    f"node {j} decided unproposed value {v} at "
+                    f"height {h} (proposed: {sorted(proposed)})"))
+    for j, nd in enumerate(net.nodes):
+        expected = net._expected_ev[j]
+        if not expected:
+            continue
+        have = {(e.validator, e.height, e.round, int(e.typ))
+                for e in nd.all_equivocations()}
+        missing = expected - have
+        if missing:
+            out.append(Violation(
+                "evidence", j,
+                f"node {j} counted conflicting vote pairs "
+                f"{sorted(missing)} but surfaced no equivocation "
+                f"evidence for them"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The explorer
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Counterexample:
+    config: MCConfig
+    violation: Violation
+    schedule: List[tuple]          # action tuples from the initial state
+    minimized: Optional[List[tuple]] = None
+
+    def to_json(self) -> dict:
+        sched = self.minimized if self.minimized is not None \
+            else self.schedule
+        return {
+            "config": self.config.to_json(),
+            "property": self.violation.property,
+            "node": self.violation.node,
+            "detail": self.violation.detail,
+            "schedule": [Network.action_to_json(a) for a in sched],
+            "schedule_unminimized":
+                [Network.action_to_json(a) for a in self.schedule],
+        }
+
+
+@dataclasses.dataclass
+class Report:
+    config: MCConfig
+    states: int = 0
+    transitions: int = 0
+    violations: List[Counterexample] = dataclasses.field(
+        default_factory=list)
+    near_misses: Dict[str, list] = dataclasses.field(default_factory=dict)
+    complete: bool = True
+    deepest: int = 0
+    seconds: float = 0.0
+    # filled only when explore(collect_digests=True): the exact visited
+    # canonical-state set, for the POR-soundness equivalence tests
+    digests: Optional[set] = None
+
+    def to_json(self) -> dict:
+        return {
+            "config": self.config.name,
+            "states": self.states,
+            "transitions": self.transitions,
+            "violations": [c.to_json() for c in self.violations],
+            "near_misses": {k: [Network.action_to_json(a) for a in v]
+                            for k, v in self.near_misses.items()},
+            "complete": self.complete,
+            "deepest": self.deepest,
+            "seconds": round(self.seconds, 1),
+        }
+
+
+def _target(act: tuple) -> Optional[int]:
+    """The node an action mutates, None for global actions."""
+    if act[0] == "d":
+        return act[2]
+    if act[0] == "t":
+        return act[1]
+    return None
+
+
+def _indep(a: tuple, b: tuple) -> bool:
+    ta, tb = _target(a), _target(b)
+    return ta is not None and tb is not None and ta != tb
+
+
+class _Frame:
+    __slots__ = ("net", "digest", "depth", "snap", "todo", "idx", "sleep")
+
+    def __init__(self, net, digest, depth, todo, sleep):
+        self.net = net
+        self.digest = digest
+        self.depth = depth
+        self.snap = _edge_snapshot(net)
+        self.todo = todo
+        self.idx = 0
+        self.sleep = sleep
+
+
+def _expandable(net: Network, cfg: MCConfig) -> bool:
+    """Height bound: stop once EVERY node is past max_height (partial
+    advancement keeps exploring — laggards must still be deliverable)."""
+    return any(nd.height <= cfg.max_height for nd in net.nodes)
+
+
+def explore(cfg: MCConfig,
+            executor_cls: Optional[type] = None,
+            por: bool = True,
+            deadline_at: Optional[float] = None,
+            max_states: Optional[int] = None,
+            stop_on_violation: bool = True,
+            collect_digests: bool = False) -> Report:
+    """Depth-bounded exhaustive DFS over `cfg`'s schedule space.
+
+    `deadline_at` is an absolute time.time() instant: exploration past
+    it stops cleanly with `complete=False` (the gate's sentinel half).
+    Returns on the first violation (minimized by the caller)."""
+    t0 = time.perf_counter()
+    rep = Report(config=cfg)
+    root = build_network(cfg, executor_cls)
+    viols = _state_violations(root)
+    if viols:
+        rep.violations.append(Counterexample(cfg, viols[0], []))
+        rep.states = 1
+        rep.complete = False        # truncated at the root
+        rep.seconds = time.perf_counter() - t0
+        return rep
+
+    # digest -> [min_depth_seen, explored action set]
+    visited: Dict[bytes, list] = {}
+    path: List[tuple] = []
+
+    def make_frame(net, digest, depth, sleep):
+        enabled = net.mc_enabled(max_round=cfg.max_round)
+        rec = visited.get(digest)
+        if rec is None:
+            rec = visited[digest] = [depth, set()]
+        elif depth < rec[0]:
+            # shallower re-visit: the earlier subtree had less depth
+            # budget — re-explore everything from here
+            rec[0] = depth
+            rec[1] = set()
+        todo = [a for a in enabled
+                if a not in sleep and a not in rec[1]]
+        rec[1].update(todo)
+        return _Frame(net, digest, depth, todo, sleep), enabled
+
+    root_digest = root.mc_digest()
+    frame, _ = make_frame(root, root_digest, 0, frozenset())
+    stack = [frame]
+    check_tick = 0
+
+    while stack:
+        f = stack[-1]
+        if f.idx >= len(f.todo) or f.depth >= cfg.depth \
+                or not _expandable(f.net, cfg):
+            stack.pop()
+            if path:
+                path.pop()
+            continue
+        act = f.todo[f.idx]
+        f.idx += 1
+
+        check_tick += 1
+        if deadline_at is not None and check_tick % 256 == 0 \
+                and time.time() > deadline_at:
+            rep.complete = False
+            break
+        if max_states is not None and len(visited) >= max_states:
+            rep.complete = False
+            break
+
+        child = f.net.mc_clone()
+        applied = child.mc_apply(act)
+        assert applied, (act, "enabled action failed to apply")
+        rep.transitions += 1
+        depth = f.depth + 1
+        rep.deepest = max(rep.deepest, depth)
+        sched = path + [act]
+
+        for v in _edge_violations(child, f.snap):
+            rep.violations.append(Counterexample(cfg, v, sched))
+        digest = child.mc_digest()
+        rec = visited.get(digest)
+        new_state = rec is None
+        if new_state:
+            # register EVERY distinct state — including the depth-bound
+            # frontier, which never gets a frame: states_explored must
+            # count it and the monitors must not re-run per path to it
+            visited[digest] = [depth, set()]
+            for v in _state_violations(child):
+                rep.violations.append(Counterexample(cfg, v, sched))
+            _classify_near_miss(child, sched, rep)
+        if rep.violations and stop_on_violation:
+            rep.complete = False    # truncated, not exhausted
+            break
+
+        if depth >= cfg.depth:
+            continue
+        needs_visit = new_state or depth < rec[0]
+        if not needs_visit:
+            # already visited at <= this depth; only new actions (ones
+            # neither explored nor slept before) warrant a re-push
+            enabled = child.mc_enabled(max_round=cfg.max_round)
+            sleep = _child_sleep(f, act, por)
+            needs_visit = any(a not in sleep and a not in rec[1]
+                              for a in enabled)
+        if needs_visit:
+            sleep = _child_sleep(f, act, por)
+            nf, _ = make_frame(child, digest, depth, sleep)
+            if nf.todo:
+                stack.append(nf)
+                path.append(act)
+
+    rep.states = len(visited)
+    if collect_digests:
+        rep.digests = set(visited)
+    rep.seconds = time.perf_counter() - t0
+    return rep
+
+
+def _child_sleep(f: "_Frame", act: tuple, por: bool) -> frozenset:
+    """Sleep set for `act`'s subtree: lower-ordered independent actions
+    already explored from `f`'s state — their both-orders diamond
+    closes, so re-exploring them under `act` only re-reaches the state
+    the sibling-first branch already covers (module docstring)."""
+    if not por:
+        return frozenset()
+    explored = f.todo[:f.idx - 1]
+    inherited = f.sleep
+    return frozenset(
+        b for b in (*explored, *inherited)
+        if _indep(b, act) and b < act)
+
+
+def _classify_near_miss(net: Network, sched: List[tuple],
+                        rep: Report) -> None:
+    """Tag interesting first-reached states; the schedules seed the
+    regression corpus (kept as-reached; corpus emission minimizes)."""
+    def put(tag):
+        if tag not in rep.near_misses:
+            rep.near_misses[tag] = list(sched)
+
+    if all(0 in nd.decided for nd in net.nodes):
+        put("all_decided")
+        if any(nd.decided[0].round >= 1 for nd in net.nodes):
+            put("multi_round_decision")
+        if net._partition_cycles and net._group is None:
+            put("healed_then_decided")
+    if any(nd.all_equivocations() for nd in net.nodes):
+        put("evidence_surfaced")
+
+
+# ---------------------------------------------------------------------------
+# Deterministic replay + delta-debug minimization
+# ---------------------------------------------------------------------------
+
+
+def run_with_monitors(cfg: MCConfig, actions: Sequence,
+                      executor_cls: Optional[type] = None,
+                      sign: bool = False) -> Tuple[Network,
+                                                   List[Violation]]:
+    """Replay `actions` (tuple or JSON form; not-enabled ones skip) on
+    a fresh network, running every monitor after every applied action —
+    the reproduction predicate for minimization and the corpus tests."""
+    net = build_network(cfg, executor_cls, sign=sign)
+    viols: List[Violation] = list(_state_violations(net))
+    snap = [_edge_snapshot(net)]
+
+    def on_action(_k, _act, ok):
+        if ok:
+            viols.extend(_edge_violations(net, snap[0]))
+            viols.extend(_state_violations(net))
+        snap[0] = _edge_snapshot(net)
+
+    net.run_schedule(actions, on_action=on_action)
+    return net, viols
+
+
+def reproduces(cfg: MCConfig, actions: Sequence, prop: str,
+               executor_cls: Optional[type] = None) -> bool:
+    _, viols = run_with_monitors(cfg, actions, executor_cls)
+    return any(v.property == prop for v in viols)
+
+
+def minimize_schedule(cfg: MCConfig, actions: Sequence[tuple],
+                      pred: Callable[[Network, List[Violation]], bool],
+                      executor_cls: Optional[type] = None) -> List[tuple]:
+    """ddmin (Zeller) over the action sequence, then a greedy
+    one-at-a-time pass: a short schedule whose deterministic replay
+    still satisfies `pred(net, violations)`.  Replay-with-skip keeps
+    every subset well-defined."""
+    def pred_acts(acts: List[tuple]) -> bool:
+        return pred(*run_with_monitors(cfg, acts, executor_cls))
+
+    return _ddmin(list(actions), pred_acts)
+
+
+def minimize(cfg: MCConfig, actions: Sequence[tuple], prop: str,
+             executor_cls: Optional[type] = None) -> List[tuple]:
+    """Shortest (under ddmin) schedule still violating `prop`."""
+    return minimize_schedule(
+        cfg, actions,
+        lambda _net, viols: any(v.property == prop for v in viols),
+        executor_cls)
+
+
+def _ddmin(acts: List[tuple], pred: Callable[[List[tuple]], bool]
+           ) -> List[tuple]:
+    assert pred(acts), "minimize called on a non-reproducing schedule"
+    n = 2
+    while len(acts) >= 2:
+        chunk = max(1, len(acts) // n)
+        reduced = False
+        for i in range(0, len(acts), chunk):
+            trial = acts[:i] + acts[i + chunk:]
+            if trial and pred(trial):
+                acts = trial
+                n = max(n - 1, 2)
+                reduced = True
+                break
+        if not reduced:
+            if n >= len(acts):
+                break
+            n = min(len(acts), 2 * n)
+    # greedy 1-minimal pass
+    i = 0
+    while i < len(acts):
+        trial = acts[:i] + acts[i + 1:]
+        if trial and pred(trial):
+            acts = trial
+        else:
+            i += 1
+    return acts
+
+
+# ---------------------------------------------------------------------------
+# Corpus entries (tests/corpus/*.json) + device-plane replay
+# ---------------------------------------------------------------------------
+
+
+def corpus_entry(name: str, cfg: MCConfig, actions: Sequence[tuple],
+                 origin: str) -> dict:
+    """Serialize a schedule as a regression-corpus entry, stamping the
+    honest host plane's outcome (decisions + evidence counts) so the
+    replay test asserts bit-stable semantics, not just liveness."""
+    net, viols = run_with_monitors(cfg, actions)
+    entry = {
+        "name": name,
+        "origin": origin,
+        "config": cfg.to_json(),
+        "actions": [Network.action_to_json(tuple(a)) for a in actions],
+        "expect": {
+            "violations": sorted({v.property for v in viols}),
+            "decided": {
+                str(j): [nd.decided[0].round, nd.decided[0].value]
+                for j, nd in enumerate(net.nodes) if 0 in nd.decided},
+            "evidence": {
+                str(j): len(nd.all_equivocations())
+                for j, nd in enumerate(net.nodes)
+                if nd.all_equivocations()},
+        },
+    }
+    return entry
+
+
+def load_corpus(directory: str) -> List[dict]:
+    out = []
+    if os.path.isdir(directory):
+        for fn in sorted(os.listdir(directory)):
+            if fn.endswith(".json"):
+                with open(os.path.join(directory, fn)) as f:
+                    out.append(json.load(f))
+    return out
+
+
+def replay_corpus_entry(entry: dict,
+                        sign: bool = False) -> Tuple[Network,
+                                                     List[Violation]]:
+    """Host-plane deterministic replay of a corpus entry; asserts the
+    stamped expectations (decisions, evidence, property verdicts)."""
+    cfg = MCConfig.from_json(entry["config"])
+    net, viols = run_with_monitors(cfg, entry["actions"], sign=sign)
+    exp = entry["expect"]
+    got_decided = {str(j): [nd.decided[0].round, nd.decided[0].value]
+                   for j, nd in enumerate(net.nodes) if 0 in nd.decided}
+    assert got_decided == exp["decided"], (
+        f"{entry['name']}: decisions diverged: {got_decided} != "
+        f"{exp['decided']}")
+    got_ev = {str(j): len(nd.all_equivocations())
+              for j, nd in enumerate(net.nodes) if nd.all_equivocations()}
+    assert got_ev == exp["evidence"], (
+        f"{entry['name']}: evidence diverged: {got_ev} != "
+        f"{exp['evidence']}")
+    assert sorted({v.property for v in viols}) == exp["violations"], (
+        f"{entry['name']}: property verdicts diverged")
+    return net, viols
+
+
+def device_replay_entry(entry: dict) -> list:
+    """Replay a corpus entry's schedule through the PRODUCTION device
+    plane: run the signed host network under trace taps, then push each
+    node's exact processing stream through VoteBatcher -> fused device
+    step (harness/replay.py).  Returns (host net, [(node, host
+    Decision | None, ReplayResult)]).  This is the ONLY modelcheck path
+    that touches jax — imported lazily, never from the CLI gate."""
+    from agnes_tpu.harness.replay import replay_trace, trace_network
+
+    cfg = MCConfig.from_json(entry["config"])
+    net = build_network(cfg, sign=True, verify=True, start=False)
+    traces = trace_network(net)
+    net.run_schedule(entry["actions"])
+    out = []
+    for j, nd in enumerate(net.nodes):
+        rep = replay_trace(traces[j], n_validators=net.n)
+        out.append((j, nd.decided.get(0), rep))
+    return net, out
+
+
+def _walk_until(cfg: MCConfig,
+                pred: Callable[[Network], bool],
+                seed: int, max_steps: int = 600,
+                deliver_bias: Optional[float] = None
+                ) -> Optional[List[tuple]]:
+    """Seeded guided random walk to a predicate state — the corpus
+    generator's probe for goals DEEPER than the exhaustive bounds (a
+    full 4-node decision takes ~25 deliveries; the explorer's smoke
+    depth stops well short).  Deterministic given (cfg, seed).
+    `deliver_bias` is the probability of considering non-delivery
+    actions at all — large N needs delivery-heavy walks (uniform
+    timeout churn wedges at the round cap before a quorum forms)."""
+    import random
+
+    rng = random.Random(seed)
+    net = build_network(cfg)
+    sched: List[tuple] = []
+    for _ in range(max_steps):
+        if pred(net):
+            return sched
+        acts = net.mc_enabled(max_round=cfg.max_round)
+        if not acts:
+            return None
+        if deliver_bias is not None:
+            dels = [a for a in acts if a[0] == "d"]
+            if dels and rng.random() > deliver_bias:
+                acts = dels
+        act = rng.choice(acts)
+        assert net.mc_apply(act)
+        sched.append(act)
+    return sched if pred(net) else None
+
+
+def _all_decided(net: Network) -> bool:
+    return all(0 in nd.decided for nd in net.nodes)
+
+
+#: name -> (config, goal predicate, walk seed, deliver bias): the
+#: shipped regression corpus (tests/corpus/).  Each goal is a coverage
+#: milestone the cross-plane differential should replay forever: full
+#: decisions under each fault model, surfaced equivocation evidence, a
+#: partition/heal recovery, a multi-round decision, and an N=7
+#: decision.  Seeds are the first that reach the goal; depth is unused
+#: by replay (0 marks these as walk configs, not exploration bounds).
+CORPUS_GOALS: Dict[str, tuple] = {
+    "mc_n4_honest_decides": (
+        MCConfig(name="n4_honest", depth=0, max_round=2),
+        _all_decided, 1, None),
+    "mc_n4_multi_round_decides": (
+        MCConfig(name="n4_honest_r1", depth=0, max_round=2),
+        lambda net: (_all_decided(net)
+                     and any(nd.decided[0].round >= 1
+                             for nd in net.nodes)), 0, None),
+    "mc_n4_equivocator_evidence": (
+        MCConfig(name="n4_equivocator", depth=0, max_round=2,
+                 behaviors=("equivocator", "honest", "honest", "honest")),
+        lambda net: (_all_decided(net)
+                     and any(nd.all_equivocations()
+                             for nd in net.nodes)), 3, None),
+    "mc_n4_nil_flood_decides": (
+        MCConfig(name="n4_nil_flood", depth=0, max_round=2,
+                 behaviors=("nil_flood", "honest", "honest", "honest")),
+        _all_decided, 8, None),
+    "mc_n4_partition_heal_decides": (
+        MCConfig(name="n4_partition_heal", depth=0, max_round=2,
+                 partition=((0, 1), (2, 3))),
+        lambda net: (_all_decided(net) and net._partition_cycles > 0
+                     and net._group is None), 2, None),
+    "mc_n7_honest_decides": (
+        MCConfig(name="n7_honest", n=7, depth=0, max_round=2,
+                 behaviors=("honest",) * 7),
+        _all_decided, 0, 0.05),
+}
+
+
+def emit_corpus(directory: str, include_mutants: bool = True) -> List[str]:
+    """(Re)generate the regression corpus: a ddmin-minimized schedule
+    per CORPUS_GOALS milestone, plus the two mutation self-test
+    counterexamples replayed on the honest executor (they stay
+    interesting as device-plane differential cases even where the
+    honest host plane is clean).  Deterministic; committed as
+    tests/corpus/*.json and replayed by tests/test_cross_plane.py."""
+    os.makedirs(directory, exist_ok=True)
+    written = []
+    for name, (cfg, pred, seed, bias) in CORPUS_GOALS.items():
+        sched = _walk_until(cfg, pred, seed, max_steps=1500,
+                            deliver_bias=bias)
+        assert sched is not None, f"corpus goal {name} unreachable"
+        sched = minimize_schedule(cfg, sched,
+                                  lambda net, _v, p=pred: p(net))
+        entry = corpus_entry(name, cfg, sched,
+                             origin=f"emit_corpus goal walk seed={seed}, "
+                                    f"ddmin-minimized")
+        path = os.path.join(directory, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(entry, f, indent=1, sort_keys=True)
+            f.write("\n")
+        written.append(path)
+    if include_mutants:
+        for mname, r in self_test().items():
+            ce = r["counterexample"]
+            cfg = MCConfig.from_json(ce["config"])
+            acts = [Network.action_from_json(a) for a in ce["schedule"]]
+            entry = corpus_entry(
+                f"mc_mut_{mname}", cfg, acts,
+                origin=f"minimized {mname} mutation counterexample "
+                       f"(honest replay: near-miss)")
+            path = os.path.join(directory, f"mc_mut_{mname}.json")
+            with open(path, "w") as f:
+                json.dump(entry, f, indent=1, sort_keys=True)
+                f.write("\n")
+            written.append(path)
+    return written
+
+
+# ---------------------------------------------------------------------------
+# Mutation self-test: doctored executors the monitors MUST catch
+# ---------------------------------------------------------------------------
+
+
+class QuorumlessExecutor(ConsensusExecutor):
+    """Doctored: treats a single precommit-for-value as a +2/3 quorum
+    (the classic miscounted-threshold bug).  Method-override only, so
+    ConsensusExecutor.clone() stays subclass-safe."""
+
+    def _on_vote(self, v) -> None:
+        super()._on_vote(v)
+        if (v.typ == VoteType.PRECOMMIT and v.value is not None
+                and (v.height is None or v.height == self.height)
+                and self.state.step != sm.Step.COMMIT):
+            self._apply_event(v.round, sm.Event.precommit_value(v.value))
+
+
+class EvidenceDroppingExecutor(ConsensusExecutor):
+    """Doctored: the slashing surface goes blind — equivocations are
+    tallied (first vote counts, conflicts ignored) but never reported."""
+
+    def all_equivocations(self) -> list:
+        return []
+
+
+#: mutant name -> (executor class, property the monitors must catch it
+#: with, config the violation is reachable in)
+MUTANTS: Dict[str, tuple] = {
+    "decide_without_quorum": (
+        QuorumlessExecutor, "quorum",
+        MCConfig(name="mut_quorumless", n=4,
+                 behaviors=("honest",) * 4, depth=8, max_round=1)),
+    "drop_equivocation_evidence": (
+        EvidenceDroppingExecutor, "evidence",
+        MCConfig(name="mut_evidence", n=4,
+                 behaviors=("equivocator", "honest", "honest", "honest"),
+                 depth=6, max_round=1)),
+}
+
+
+def self_test(por: bool = True) -> dict:
+    """Prove the monitors have teeth: each doctored executor must be
+    caught, its counterexample must delta-minimize, and the minimized
+    schedule must run CLEAN on the honest executor (the violation is
+    the mutation's, not the checker's)."""
+    out = {}
+    for name, (mut_cls, prop, cfg) in MUTANTS.items():
+        rep = explore(cfg, executor_cls=mut_cls, por=por)
+        caught = [c for c in rep.violations
+                  if c.violation.property == prop]
+        assert caught, (
+            f"mutant {name}: no {prop} violation in "
+            f"{rep.states} states")
+        ce = caught[0]
+        ce.minimized = minimize(cfg, ce.schedule, prop,
+                                executor_cls=mut_cls)
+        assert reproduces(cfg, ce.minimized, prop, executor_cls=mut_cls)
+        _, honest_viols = run_with_monitors(cfg, ce.minimized)
+        assert not honest_viols, (
+            f"mutant {name}: minimized schedule also violates on the "
+            f"honest executor: {honest_viols}")
+        out[name] = {
+            "property": prop,
+            "states_to_detection": rep.states,
+            "schedule_len": len(ce.schedule),
+            "minimized_len": len(ce.minimized),
+            "counterexample": ce.to_json(),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Scopes + frontier-sharded workers + CLI
+# ---------------------------------------------------------------------------
+
+#: The smoke scope: the ci.sh gate's envelope.  Sized for the 2-CPU CI
+#: box — must EXHAUST (complete=True) well inside the gate timeout
+#: while clearing the >= 50k distinct-state acceptance floor.  One
+#: config per fault model plus a partition/heal drill and an N=7
+#: shallow sweep; every one stays within f < n/3.
+SMOKE_SCOPE: Tuple[MCConfig, ...] = (
+    MCConfig(name="n4_honest", depth=10, max_round=1),
+    MCConfig(name="n4_silent", depth=11, max_round=1,
+             behaviors=("silent", "honest", "honest", "honest")),
+    MCConfig(name="n4_equivocator", depth=9, max_round=1,
+             behaviors=("equivocator", "honest", "honest", "honest")),
+    MCConfig(name="n4_nil_flood", depth=9, max_round=1,
+             behaviors=("nil_flood", "honest", "honest", "honest")),
+    MCConfig(name="n4_partition_heal", depth=9, max_round=1,
+             partition=((0, 1), (2, 3))),
+    MCConfig(name="n7_honest", n=7, behaviors=("honest",) * 7,
+             depth=5, max_round=1),
+)
+
+#: Unit-test / CLI-smoke scope: seconds, not minutes.
+TINY_SCOPE: Tuple[MCConfig, ...] = (
+    MCConfig(name="tiny_honest", depth=6, max_round=1),
+    MCConfig(name="tiny_equivocator", depth=5, max_round=1,
+             behaviors=("equivocator", "honest", "honest", "honest")),
+)
+
+#: Deep scope for workstation runs (not CI-gated): more rounds, deeper
+#: schedules, a second fault in the n=7 set.
+FULL_SCOPE: Tuple[MCConfig, ...] = SMOKE_SCOPE + (
+    MCConfig(name="n4_honest_deep", depth=12, max_round=2),
+    MCConfig(name="n4_equivocator_deep", depth=11, max_round=2,
+             behaviors=("equivocator", "honest", "honest", "honest")),
+    MCConfig(name="n7_two_faults", n=7, depth=6, max_round=1,
+             behaviors=("equivocator", "silent", "honest", "honest",
+                        "honest", "honest", "honest")),
+)
+
+SCOPES = {"tiny": TINY_SCOPE, "smoke": SMOKE_SCOPE, "full": FULL_SCOPE}
+
+
+def _scope_worker(task: dict) -> dict:
+    """One exploration shard in a spawned interpreter (the agnes_lint
+    --pass all pattern): configs are independent, so they parallelize
+    across cores; JSON-able dicts cross the process boundary."""
+    cfg = MCConfig.from_json(task["config"])
+    rep = explore(cfg, por=task["por"],
+                  deadline_at=task["deadline_at"],
+                  max_states=task.get("max_states"))
+    for ce in rep.violations:
+        try:
+            ce.minimized = minimize(cfg, ce.schedule,
+                                    ce.violation.property)
+        except AssertionError:
+            ce.minimized = None     # non-deterministic repro: report raw
+    return rep.to_json()
+
+
+def run_scope(scope: str, workers: Optional[int] = None, por: bool = True,
+              deadline_at: Optional[float] = None,
+              max_states: Optional[int] = None) -> dict:
+    """Explore every config of `scope`, frontier-sharded over spawned
+    workers; aggregate states/violations (the CLI/gate record)."""
+    configs = SCOPES[scope]
+    tasks = [{"config": c.to_json(), "por": por,
+              "deadline_at": deadline_at, "max_states": max_states}
+             for c in configs]
+    t0 = time.perf_counter()
+    if workers is None:
+        workers = min(len(tasks), max(2, os.cpu_count() or 2))
+    if workers <= 1 or len(tasks) == 1:
+        results = [_scope_worker(t) for t in tasks]
+    else:
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")       # no forked interpreter state
+        with ctx.Pool(processes=workers) as pool:
+            results = pool.map(_scope_worker, tasks)
+    report = {
+        "scope": scope,
+        "por": por,
+        "configs": {r["config"]: r for r in results},
+        "states_explored": sum(r["states"] for r in results),
+        "transitions": sum(r["transitions"] for r in results),
+        "violations": sum(len(r["violations"]) for r in results),
+        "complete": all(r["complete"] for r in results),
+        "seconds": round(time.perf_counter() - t0, 1),
+    }
+    report["ok"] = report["violations"] == 0
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI (scripts/agnes_modelcheck.py + the agnes-modelcheck console
+    script).  Pure CPU, zero XLA compiles; honors the enclosing
+    timeout budget (utils/budget.Deadline discovery) so the ci.sh gate
+    always gets a parseable record — complete=False is the sentinel
+    half of the real-value-or-sentinel contract."""
+    import argparse
+
+    from agnes_tpu.utils.budget import Deadline
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--scope", default="smoke", choices=sorted(SCOPES),
+                    help="bounded exploration envelope (default: smoke)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--no-por", action="store_true",
+                    help="disable partial-order reduction (debug aid)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the doctored-executor mutation self-test")
+    ap.add_argument("--emit-corpus", metavar="DIR", default=None,
+                    help="(re)generate the regression corpus into DIR")
+    ap.add_argument("--max-states", type=int, default=None)
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="wall budget; default: discovered from "
+                         "AGNES_MODELCHECK_DEADLINE_S or the enclosing "
+                         "`timeout N`")
+    args = ap.parse_args(argv)
+
+    if args.deadline_s is not None:
+        deadline = Deadline.after(args.deadline_s)
+    else:
+        deadline = Deadline.discover(
+            env_var="AGNES_MODELCHECK_DEADLINE_S")
+    rem = deadline.remaining()
+    # leave a report-assembly margin before the enclosing kill; the
+    # 1s floor only guards an already-blown budget (the sentinel path)
+    deadline_at = None if deadline.at is None \
+        else time.time() + max(1.0, rem - min(20.0, rem * 0.2))
+
+    t0 = time.perf_counter()
+    if args.self_test:
+        mut = self_test(por=not args.no_por)
+        report = {"self_test": mut, "ok": True,
+                  "seconds": round(time.perf_counter() - t0, 1)}
+        print(json.dumps(report, sort_keys=True), flush=True)
+        return 0
+    if args.emit_corpus:
+        written = emit_corpus(args.emit_corpus)
+        print(json.dumps({"ok": True, "corpus": written,
+                          "seconds": round(time.perf_counter() - t0, 1)},
+                         sort_keys=True), flush=True)
+        return 0
+
+    report = run_scope(args.scope, workers=args.workers,
+                       por=not args.no_por, deadline_at=deadline_at,
+                       max_states=args.max_states)
+    from agnes_tpu.utils.metrics import (
+        MODELCHECK_STATES_EXPLORED,
+        MODELCHECK_VIOLATIONS,
+    )
+
+    report["metrics"] = {
+        MODELCHECK_STATES_EXPLORED: report["states_explored"],
+        MODELCHECK_VIOLATIONS: report["violations"],
+    }
+    report["deadline"] = {"source": deadline.source,
+                          "budget_s": None if rem == float("inf")
+                          else round(rem, 1)}
+    if not args.json:
+        for name, r in report["configs"].items():
+            status = "EXHAUSTED" if r["complete"] else "partial"
+            print(f"[agnes_modelcheck] {name}: {r['states']} states / "
+                  f"{r['transitions']} transitions {status} "
+                  f"({r['seconds']}s), {len(r['violations'])} "
+                  f"violation(s)", flush=True)
+    print(json.dumps(report, sort_keys=True), flush=True)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -122,6 +122,38 @@ class TimerWheel:
     def next_deadline(self) -> Optional[float]:
         return self._heap[0].deadline if self._heap else None
 
+    # -- single-step scheduler surface (model checker, harness.simulator
+    # step mode): under the asynchronous abstraction a scheduled timer
+    # may fire at ANY point, so deadlines stop mattering and the wheel
+    # becomes a pending-timeout multiset an external scheduler pops.
+
+    def pending(self) -> List[WireTimeout]:
+        """Every scheduled-but-unfired timeout (deadline-order-free)."""
+        return [e.timeout for e in self._heap]
+
+    def remove(self, timeout: WireTimeout) -> bool:
+        """Remove ONE pending entry equal to `timeout` (the scheduler
+        is about to fire it by hand); False if none pending.  Rebuilds
+        the heap list rather than mutating it in place, so clones that
+        still share the list (see `clone`) are unaffected."""
+        for k, e in enumerate(self._heap):
+            if e.timeout == timeout:
+                rest = self._heap[:k] + self._heap[k + 1:]
+                heapq.heapify(rest)
+                self._heap = rest
+                return True
+        return False
+
+    def clone(self) -> "TimerWheel":
+        """O(pending) copy for state-space branching: entries are never
+        mutated after push, so a shallow list copy suffices (`remove`
+        replaces the list, `schedule` pushes onto the clone's own)."""
+        w = TimerWheel.__new__(TimerWheel)
+        w._heap = list(self._heap)
+        w._seq = self._seq
+        w.now = self.now
+        return w
+
 
 @dataclass(frozen=True)
 class TimeoutConfig:
@@ -152,6 +184,26 @@ class Decision:
     height: int
     round: int
     value: int
+
+
+@dataclass(frozen=True, slots=True)
+class DecisionCert:
+    """The quorum a decision rested on, captured AT decide time.
+
+    `_decide` discards the live `VoteExecutor` (the tally dies with the
+    height), so anything that wants to audit "no decision without +2/3
+    precommit weight" after the fact — the model checker's quorum
+    monitor (analysis/modelcheck.py) — must read the weight before it
+    is gone.  `weight` is the precommit weight this node had counted
+    for (round, value) at the instant it decided; `total` the set's
+    total power.  A legitimate decision satisfies 3*weight > 2*total.
+    """
+
+    height: int
+    round: int
+    value: int
+    weight: int
+    total: int
 
 
 class ConsensusExecutor:
@@ -195,10 +247,17 @@ class ConsensusExecutor:
         # slashing evidence archived across heights (the per-height
         # VoteExecutor is replaced on decision; evidence must survive)
         self.evidence: List[object] = []
+        # quorum certificates, one per decision (audit surface — see
+        # DecisionCert; appended by _decide, never read by the core)
+        self.decision_certs: List[DecisionCert] = []
 
         self._rotation = ProposerRotation(vset)
         self._proposer_cache: Dict[Tuple[int, int], int] = {}
         self._rotation_pos = (start_height, 0)
+        # set by prefill_proposers(): a frozen cache may be SHARED by
+        # clone() (the memo is a pure function of (height, round), but
+        # the rotation cursor behind it is not clone-divergence-safe)
+        self._proposer_frozen = False
         self._started = False
 
     # -- proposer schedule --------------------------------------------------
@@ -210,11 +269,26 @@ class ConsensusExecutor:
         proposer table."""
         key = (height, round % ROUNDS_WINDOW)
         while key not in self._proposer_cache:
+            assert not self._proposer_frozen, (
+                f"proposer cache frozen but {key} missed — raise the "
+                f"prefill_proposers height bound")
             h, r = self._rotation_pos
             self._proposer_cache[(h, r)] = self._rotation.step()
             self._rotation_pos = (h, r + 1) if r + 1 < ROUNDS_WINDOW \
                 else (h + 1, 0)
         return self._proposer_cache[key]
+
+    def prefill_proposers(self, max_height: int) -> None:
+        """Materialize the proposer schedule for every (height ≤
+        max_height, round-window slot) and FREEZE the cache.  After
+        this the memo is read-only, so `clone()` shares it (and the
+        now-inert rotation cursor) across every branch of a state-space
+        exploration — a miss past the bound asserts instead of silently
+        corrupting the shared cursor."""
+        for h in range(self.height, max_height + 1):
+            for r in range(ROUNDS_WINDOW):
+                self.proposer(h, r)
+        self._proposer_frozen = True
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -376,6 +450,13 @@ class ConsensusExecutor:
         dec = Decision(self.height, d.round, d.value)
         self.decisions.append(dec)
         self.decided[self.height] = dec
+        # capture the quorum certificate BEFORE the tally is replaced
+        # (DecisionCert docstring): the precommit weight counted for
+        # the decided (round, value) at this instant
+        rv = self.votes.votes.rounds.get(d.round)
+        weight = rv.precommits.value_weight(d.value) if rv else 0
+        self.decision_certs.append(DecisionCert(
+            self.height, d.round, d.value, weight, self.vset.total_power))
         # dedup: a restart restores live-height evidence into the archive,
         # and peers redelivering the same votes would re-detect it here
         seen = set(self.evidence)
@@ -404,3 +485,128 @@ class ConsensusExecutor:
         """Drive the clock; expired timeouts re-enter via execute."""
         for t in self.wheel.advance(to):
             self.execute(t)
+
+    def timer_live(self, t: WireTimeout) -> bool:
+        """Can this pending timeout still take effect if fired?
+
+        Sound in one direction only: True may still be a no-op fire,
+        but False is a PROOF of no-op — height/round/step are all
+        monotone (height by _decide, round within a height by
+        _round_skip, step within a round by next_step/commit), and
+        every timeout arm in state_machine.apply carries an `eqr`
+        guard plus (for propose/prevote) a step guard.  The model
+        checker uses this both to prune dead fire-actions and to drop
+        dead timers from the canonical state, so states differing only
+        in inert wheel residue merge."""
+        if t.height != self.height or t.round != self.state.round:
+            return False
+        step = self.state.step
+        if t.step == sm.TimeoutStep.PROPOSE:
+            return step <= sm.Step.PROPOSE
+        if t.step == sm.TimeoutStep.PREVOTE:
+            return step <= sm.Step.PREVOTE
+        return step < sm.Step.COMMIT        # PRECOMMIT: step-agnostic arm
+
+    # -- state-space surface (analysis/modelcheck.py) -----------------------
+
+    def clone(self) -> "ConsensusExecutor":
+        """O(live state) copy for state-space branching.
+
+        Immutable/deterministic members (vset, config callables, the
+        frozen State, wire messages) are shared; every mutable
+        container is copied one level deep — deep enough because the
+        leaves (Vote, Equivocation, Decision, DecisionCert, State) are
+        all frozen or append-only.  The proposer memo is shared ONLY
+        when frozen by prefill_proposers (see there).  Subclass-safe
+        for method-override doctored executors (the modelcheck
+        mutation registry); a subclass adding mutable attributes must
+        extend this."""
+        cls = type(self)
+        n = cls.__new__(cls)
+        n.vset = self.vset
+        n.index = self.index
+        n.seed = self.seed
+        n.get_value = self.get_value
+        n.is_valid = self.is_valid
+        n.tcfg = self.tcfg
+        n.verify_signatures = self.verify_signatures
+        n.height = self.height
+        n.state = self.state
+        n.votes = self.votes.clone()
+        n.wheel = self.wheel.clone()
+        n.outbox = list(self.outbox)
+        n.decisions = list(self.decisions)
+        n.decided = dict(self.decided)
+        n.evidence = list(self.evidence)
+        n.decision_certs = list(self.decision_certs)
+        if self._proposer_frozen:
+            n._rotation = self._rotation
+            n._proposer_cache = self._proposer_cache
+        else:
+            # rebuild an equivalent cursor: the rotation is a pure
+            # deterministic sequence and each cache entry consumed
+            # exactly one step, so re-stepping a fresh rotation
+            # len(cache) times lands it where the original's is
+            n._rotation = ProposerRotation(self.vset)
+            for _ in range(len(self._proposer_cache)):
+                n._rotation.step()
+            n._proposer_cache = dict(self._proposer_cache)
+        n._rotation_pos = self._rotation_pos
+        n._proposer_frozen = self._proposer_frozen
+        n._started = self._started
+        return n
+
+    def canonical_state(self) -> tuple:
+        """A canonical, hashable, int-only summary of everything that
+        can influence this node's FUTURE behavior — the model checker's
+        dedup key.  Deliberately excluded: outbox/decisions history
+        (drained/duplicated elsewhere), decision_certs (audit log,
+        checked per transition), the proposer memo (pure function),
+        wheel deadlines and dead timers (the asynchronous abstraction:
+        any pending live timer may fire at any point, so only the SET
+        of live (round, step) timers matters).  None-valued vote
+        values encode as -2 (NIL_ID is -1, real ids >= 0)."""
+        def _v(x):
+            return -2 if x is None else x
+
+        hv = self.votes.votes
+        rounds = []
+        for r in sorted(hv.rounds):
+            rv = hv.rounds[r]
+            rounds.append((
+                r,
+                rv.prevotes.nil,
+                tuple(sorted(rv.prevotes.weights.items())),
+                rv.precommits.nil,
+                tuple(sorted(rv.precommits.weights.items())),
+                tuple(sorted((val, int(t), _v(v), w)
+                             for (val, t), (v, w) in rv.seen.items())),
+                tuple(sorted((int(t), w)
+                             for t, w in rv._anon_weight.items())),
+                tuple(sorted((e.validator, int(e.typ), _v(e.first_value),
+                              _v(e.second_value))
+                             for e in rv.equivocations)),
+            ))
+        lock = (self.state.locked.round, self.state.locked.value) \
+            if self.state.locked else None
+        valid = (self.state.valid.round, self.state.valid.value) \
+            if self.state.valid else None
+        return (
+            self.height,
+            self.state.round,
+            int(self.state.step),
+            lock,
+            valid,
+            tuple(rounds),
+            tuple(sorted((r, int(tag), _v(v))
+                         for r, tag, v in self.votes._emitted)),
+            tuple(sorted(self.votes._skipped)),
+            tuple(sorted((h, d.round, d.value)
+                         for h, d in self.decided.items())),
+            tuple(sorted((e.height, e.round, int(e.typ), e.validator,
+                          _v(e.first_value), _v(e.second_value))
+                         for e in self.evidence)),
+            tuple(sorted({(t.round, int(t.step))
+                          for t in self.wheel.pending()
+                          if self.timer_live(t)})),
+        )

@@ -154,6 +154,10 @@ class VoteCount:
             return Thresh.any()
         return Thresh.init()
 
+    def clone(self) -> "VoteCount":
+        """Shallow-bucket copy (state-space branching surface)."""
+        return VoteCount(self.total, self.nil, dict(self.weights))
+
 
 @dataclass
 class RoundVotes:
@@ -204,6 +208,19 @@ class RoundVotes:
         else:
             self._anon_weight[vote.typ] = self._anon_weight.get(vote.typ, 0) + weight
         return count.add(vote.value, weight)
+
+    def clone(self) -> "RoundVotes":
+        """One-level copy: every container is duplicated, the leaves
+        (Vote values, Equivocation records) are frozen and shared —
+        the state-space branching surface (analysis/modelcheck.py)."""
+        rv = RoundVotes(self.height, self.round, self.total,
+                        prevotes=self.prevotes.clone(),
+                        precommits=self.precommits.clone(),
+                        seen=dict(self.seen),
+                        equivocations=list(self.equivocations),
+                        _flagged=set(self._flagged),
+                        _anon_weight=dict(self._anon_weight))
+        return rv
 
     def skip_weight(self) -> int:
         """Weight of distinct voters seen in this round — the +1/3

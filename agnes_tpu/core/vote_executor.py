@@ -95,6 +95,11 @@ class HeightVotes:
             out.extend(rv.equivocations)
         return out
 
+    def clone(self) -> "HeightVotes":
+        """Per-round deep-enough copy (state-space branching)."""
+        return HeightVotes(self.height, self.total,
+                           {r: rv.clone() for r, rv in self.rounds.items()})
+
 
 @dataclass
 class VoteExecutor:
@@ -116,6 +121,12 @@ class VoteExecutor:
     def __post_init__(self):
         if self.votes is None:
             self.votes = HeightVotes(self.height, self.total_weight)
+
+    def clone(self) -> "VoteExecutor":
+        """State-space branching copy; edge-trigger records included."""
+        return VoteExecutor(self.height, self.total_weight,
+                            self.edge_triggered, self.votes.clone(),
+                            set(self._emitted), set(self._skipped))
 
     def apply(self, vote: Vote, weight: int) -> Optional[sm.Event]:
         """Add the vote to its round's tally; return the event its class's

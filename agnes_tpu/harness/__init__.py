@@ -19,9 +19,26 @@ philosophy to both planes:
 """
 
 from agnes_tpu.harness.simulator import Network, NodeSpec  # noqa: F401
-from agnes_tpu.harness.device_driver import DeviceDriver  # noqa: F401
 from agnes_tpu.harness.replay import (  # noqa: F401
     ReplayResult,
     replay_trace,
     trace_network,
 )
+
+# DeviceDriver is re-exported LAZILY (PEP 562): importing it pulls jax,
+# and the model checker's spawned workers (analysis/modelcheck.py) need
+# `harness.simulator` in a jax-free interpreter — both for spawn cost
+# and for the zero-XLA-compile guarantee of the agnes_modelcheck gate.
+_LAZY = {"DeviceDriver": ("agnes_tpu.harness.device_driver",
+                          "DeviceDriver")}
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)

@@ -27,11 +27,18 @@ scenario, no cluster required.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field, replace as dc_replace
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from agnes_tpu.core.executor import ConsensusExecutor, TimeoutConfig
+from agnes_tpu.core.executor import (
+    ConsensusExecutor,
+    TimeoutConfig,
+    WireProposal,
+    WireTimeout,
+)
 from agnes_tpu.core.round_votes import Equivocation
+from agnes_tpu.core.state_machine import TimeoutStep
 from agnes_tpu.core.validators import Validator, ValidatorSet
 from agnes_tpu.crypto import ed25519_ref as ed
 from agnes_tpu.crypto import host_sign as _sign
@@ -39,6 +46,14 @@ from agnes_tpu.crypto.encoding import vote_signing_bytes
 from agnes_tpu.types import Vote
 
 BEHAVIORS = ("honest", "silent", "equivocator", "nil_flood")
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_pubkey(seed: bytes) -> bytes:
+    """Seeds here are tiny deterministic test vectors; the pure-Python
+    keypair derivation costs ~ms each, and the model checker builds
+    THOUSANDS of Networks (one per delta-debug probe) — memoize."""
+    return ed.keypair(seed)[1]
 
 
 @dataclass
@@ -57,12 +72,20 @@ class Network:
     timeout_config: TimeoutConfig = field(default_factory=TimeoutConfig)
     get_value: Callable[[int], int] = lambda h: 100 + h
     verify_signatures: bool = True
+    # model-checking knobs: skip Ed25519 entirely (the checker explores
+    # consensus logic, not crypto — every vote still carries identity,
+    # delivery stays index-trusted with verify_signatures=False), and
+    # swap in a doctored executor class (the mutation-test surface)
+    sign_messages: bool = True
+    executor_cls: type = ConsensusExecutor
 
     def __post_init__(self):
+        assert self.sign_messages or not self.verify_signatures, \
+            "unsigned networks cannot verify signatures"
         specs = list(self.specs or [NodeSpec() for _ in range(self.n)])
         assert len(specs) == self.n
         seeds = [bytes([i + 1]) * 32 for i in range(self.n)]
-        keyed = sorted(zip([ed.keypair(s)[1] for s in seeds], seeds,
+        keyed = sorted(zip([_cached_pubkey(s) for s in seeds], seeds,
                            range(self.n)))
         # specs are re-indexed to sorted order so specs[i] matches node i
         self.specs = [specs[orig] for _, _, orig in keyed]
@@ -71,8 +94,9 @@ class Network:
             [Validator(pk, self.specs[i].power)
              for i, (pk, _, _) in enumerate(keyed)])
         self.nodes: List[ConsensusExecutor] = [
-            ConsensusExecutor(
-                self.vset, index=i, seed=self.seeds[i],
+            self.executor_cls(
+                self.vset, index=i,
+                seed=self.seeds[i] if self.sign_messages else None,
                 get_value=self.get_value,
                 timeout_config=self.timeout_config,
                 verify_signatures=self.verify_signatures)
@@ -82,6 +106,7 @@ class Network:
         self._group: Optional[List[int]] = None   # node -> partition id
         self._held_cross: List = []               # (target, msg) queue
         self.held_partition = 0
+        self._step_mode = False
 
     # -- fault models -------------------------------------------------------
 
@@ -95,12 +120,14 @@ class Network:
                 and msg.value is not None:
             other = msg.value + 1_000_000
             sig = _sign(self.seeds[i], vote_signing_bytes(
-                msg.height, msg.round, int(msg.typ), other))
+                msg.height, msg.round, int(msg.typ), other)) \
+                if self.sign_messages else None
             evil = dc_replace(msg, value=other, signature=sig)
             return [msg, evil]
         if b == "nil_flood" and isinstance(msg, Vote):
             sig = _sign(self.seeds[i], vote_signing_bytes(
-                msg.height, msg.round, int(msg.typ), None))
+                msg.height, msg.round, int(msg.typ), None)) \
+                if self.sign_messages else None
             return [dc_replace(msg, value=None, signature=sig)]
         return [msg]
 
@@ -159,6 +186,8 @@ class Network:
 
     def step_router(self) -> bool:
         """Deliver every pending outbox message; True if any moved."""
+        assert not self._step_mode, \
+            "step-mode networks are driven via mc_apply/run_schedule"
         progress = False
         for i, node in enumerate(self.nodes):
             while self._delivered[i] < len(node.outbox):
@@ -220,3 +249,272 @@ class Network:
             if ev:
                 out[i] = ev
         return out
+
+    # ======================================================================
+    # Single-step scheduler mode (the model checker's surface,
+    # analysis/modelcheck.py).
+    #
+    # In step mode the router stops auto-delivering: outbound traffic is
+    # drained into per-(src, dst) FIFO channels (per-link FIFO order, the
+    # standard asynchronous-network assumption) and an external scheduler
+    # picks ONE atomic action at a time:
+    #
+    #   ("d", i, j)             deliver the head of channel i->j
+    #   ("t", j, h, r, step)    fire node j's pending (h, r, step) timeout
+    #                           (the asynchronous abstraction: a scheduled
+    #                           timer may expire at ANY point, so deadline
+    #                           values stop mattering)
+    #   ("p",)                  split into the configured partition groups
+    #   ("h",)                  heal the partition
+    #
+    # Every action is followed by a deterministic re-route of all outboxes,
+    # so the post-action state is a pure function of (initial config,
+    # action sequence) — the determinism `run_schedule` and the regression
+    # corpus rely on.  A partition HOLDS cross-group channels (delivery
+    # disabled, nothing dropped) exactly like the classic router's
+    # held-until-heal policy, just at delivery rather than routing time.
+    # ======================================================================
+
+    def enable_step_mode(self, partition_groups=None, max_height: int = 1,
+                         max_partition_cycles: int = 1) -> None:
+        """Switch the router into externally-scheduled single-step mode
+        (before `start()`).  `partition_groups` is the one partition
+        shape the ("p",) action applies, or None to disable it."""
+        assert not self._step_mode and not any(
+            nd._started for nd in self.nodes)
+        self._step_mode = True
+        self._channels: Dict[Tuple[int, int], List[object]] = {}
+        self._mc_partition_groups = None if partition_groups is None else \
+            tuple(tuple(sorted(g)) for g in partition_groups)
+        self._max_partition_cycles = max_partition_cycles
+        self._partition_cycles = 0
+        # height -> set of value ids any node ever put in a WireProposal
+        # (recorded pre-behavior, so a silent proposer's value counts):
+        # the validity monitor's ground truth
+        self._proposed: Dict[int, set] = {}
+        # per target node: (validator, height, round, typ) -> values
+        # delivered AND counted (vote height matched the node's live
+        # height); two+ distinct values mean round_votes must have
+        # surfaced equivocation evidence — the completeness monitor
+        self._dv: List[Dict[Tuple[int, int, int, int], set]] = [
+            {} for _ in range(self.n)]
+        self._expected_ev: List[set] = [set() for _ in range(self.n)]
+        for nd in self.nodes:
+            nd.prefill_proposers(max_height + 2)
+
+    def mc_start(self) -> None:
+        """Start every node and route the initial burst (proposals,
+        propose timeouts) into the channels."""
+        assert self._step_mode
+        self.start()
+        self._mc_route()
+
+    def _mc_route(self) -> None:
+        """Drain every outbox through the behavior policies into the
+        channels, then TRUNCATE the outboxes — in step mode history
+        lives in the schedule, and clone cost must not grow with it."""
+        for i, node in enumerate(self.nodes):
+            for msg in node.outbox[self._delivered[i]:]:
+                if isinstance(msg, WireProposal):
+                    self._proposed.setdefault(msg.height,
+                                              set()).add(msg.value)
+                for out in self._outbound(i, msg):
+                    for j in range(self.n):
+                        if j != i:
+                            self._channels.setdefault((i, j),
+                                                      []).append(out)
+            node.outbox.clear()
+            self._delivered[i] = 0
+
+    def _cross(self, i: int, j: int) -> bool:
+        return (self._group is not None
+                and self._group[i] != self._group[j])
+
+    def mc_enabled(self, max_round: Optional[int] = None) -> List[tuple]:
+        """Every action enabled in the current state, in canonical
+        order (the determinism + partial-order-reduction key order).
+        `max_round` prunes TIMEOUT_PRECOMMIT fires that would push a
+        node past the round bound — rounds only ever advance off those
+        fires, so this caps the explored round space."""
+        assert self._step_mode
+        acts: List[tuple] = []
+        for (i, j), q in sorted(self._channels.items()):
+            if q and not self._cross(i, j):
+                acts.append(("d", i, j))
+        if self._group is not None:
+            acts.append(("h",))
+        if (self._mc_partition_groups is not None
+                and self._group is None
+                and self._partition_cycles < self._max_partition_cycles):
+            acts.append(("p",))
+        for j, node in enumerate(self.nodes):
+            if self.specs[j].behavior == "silent":
+                continue            # crash fault: the clock never fires
+            seen = set()
+            for t in node.wheel.pending():
+                if not node.timer_live(t):
+                    continue
+                if (max_round is not None
+                        and t.step == TimeoutStep.PRECOMMIT
+                        and node.state.round >= max_round):
+                    continue
+                key = ("t", j, t.height, t.round, int(t.step))
+                if key not in seen:
+                    seen.add(key)
+                    acts.append(key)
+        return acts
+
+    def mc_apply(self, act: tuple) -> bool:
+        """Apply one action; False (state untouched) when it is not
+        currently enabled — the tolerance delta-debug minimization
+        leans on (a shrunk schedule stays runnable)."""
+        assert self._step_mode
+        kind = act[0]
+        if kind == "d":
+            _, i, j = act
+            q = self._channels.get((i, j))
+            if not q or self._cross(i, j):
+                return False
+            msg = q.pop(0)
+            self._mc_track_delivery(j, msg)
+            self.nodes[j].execute(msg)
+        elif kind == "t":
+            _, j, h, r, s = act
+            t = WireTimeout(h, r, TimeoutStep(s))
+            if self.specs[j].behavior == "silent" or \
+                    not self.nodes[j].wheel.remove(t):
+                return False
+            self.nodes[j].execute(t)
+        elif kind == "p":
+            if (self._group is not None
+                    or self._mc_partition_groups is None
+                    or self._partition_cycles
+                    >= self._max_partition_cycles):
+                return False
+            self.partition(*self._mc_partition_groups)
+            self._partition_cycles += 1
+        elif kind == "h":
+            if self._group is None:
+                return False
+            self._group = None      # channels become deliverable again
+        else:
+            raise ValueError(f"unknown action {act!r}")
+        self._mc_route()
+        return True
+
+    def _mc_track_delivery(self, j: int, msg) -> None:
+        if (isinstance(msg, Vote) and msg.validator is not None
+                and msg.height == self.nodes[j].height):
+            key = (msg.validator, msg.height, msg.round, int(msg.typ))
+            vals = self._dv[j].setdefault(key, set())
+            vals.add(-2 if msg.value is None else msg.value)
+            if len(vals) > 1:
+                self._expected_ev[j].add(key)
+
+    # -- schedule serialization --------------------------------------------
+
+    _ACT_NAMES = {"d": "deliver", "t": "timeout", "p": "partition",
+                  "h": "heal"}
+    _ACT_CODES = {v: k for k, v in _ACT_NAMES.items()}
+
+    @classmethod
+    def action_to_json(cls, act: tuple) -> list:
+        return [cls._ACT_NAMES[act[0]], *act[1:]]
+
+    @classmethod
+    def action_from_json(cls, a: list) -> tuple:
+        return (cls._ACT_CODES[a[0]], *(int(x) for x in a[1:]))
+
+    def run_schedule(self, actions: Sequence,
+                     on_action: Optional[Callable] = None) -> List[bool]:
+        """Deterministically replay a serialized schedule: start (if
+        needed), then apply each action — JSON form or tuple form —
+        skipping the not-currently-enabled ones.  `on_action(k, act,
+        applied)` is the monitor hook.  Returns the applied flags."""
+        assert self._step_mode
+        if not any(nd._started for nd in self.nodes):
+            self.mc_start()
+        applied = []
+        for k, a in enumerate(actions):
+            act = self.action_from_json(a) if a[0] in self._ACT_CODES \
+                else tuple(a)
+            ok = self.mc_apply(act)
+            applied.append(ok)
+            if on_action is not None:
+                on_action(k, act, ok)
+        return applied
+
+    # -- state-space branching ---------------------------------------------
+
+    def mc_clone(self) -> "Network":
+        """O(live state) copy of the whole stepped network — the
+        exploration branch operation.  Shares: specs/seeds/vset/config
+        (immutable after init), the partition scenario, and each
+        node's frozen proposer memo (executor.clone)."""
+        assert self._step_mode
+        cls = type(self)
+        net = cls.__new__(cls)
+        net.n = self.n
+        net.specs = self.specs
+        net.timeout_config = self.timeout_config
+        net.get_value = self.get_value
+        net.verify_signatures = self.verify_signatures
+        net.sign_messages = self.sign_messages
+        net.executor_cls = self.executor_cls
+        net.seeds = self.seeds
+        net.vset = self.vset
+        net.nodes = [nd.clone() for nd in self.nodes]
+        net._delivered = [0] * self.n
+        net.dropped = self.dropped
+        net._group = None if self._group is None else list(self._group)
+        net._held_cross = []
+        net.held_partition = 0
+        net._step_mode = True
+        net._channels = {k: list(q)
+                         for k, q in self._channels.items() if q}
+        net._mc_partition_groups = self._mc_partition_groups
+        net._max_partition_cycles = self._max_partition_cycles
+        net._partition_cycles = self._partition_cycles
+        net._proposed = {h: set(v) for h, v in self._proposed.items()}
+        net._dv = [{k: set(v) for k, v in d.items()} for d in self._dv]
+        net._expected_ev = [set(s) for s in self._expected_ev]
+        return net
+
+    @staticmethod
+    def _canon_msg(m) -> tuple:
+        if isinstance(m, Vote):
+            return (0, int(m.typ), m.round,
+                    -2 if m.value is None else m.value,
+                    -2 if m.validator is None else m.validator,
+                    -2 if m.height is None else m.height)
+        if isinstance(m, WireProposal):
+            return (1, m.height, m.round, m.value, m.pol_round, m.proposer)
+        raise TypeError(f"uncanonicalizable channel message {m!r}")
+
+    def mc_canonical(self) -> tuple:
+        """Canonical, int-only form of the global state: node states
+        (executor.canonical_state), channel contents in per-link FIFO
+        order, partition status, and the monitor trackers (included so
+        two paths that agree on executor state but disagree on what
+        the monitors should expect never merge)."""
+        assert self._step_mode
+        return (
+            tuple(nd.canonical_state() for nd in self.nodes),
+            tuple((i, j, tuple(self._canon_msg(m) for m in q))
+                  for (i, j), q in sorted(self._channels.items()) if q),
+            None if self._group is None else tuple(self._group),
+            self._partition_cycles,
+            tuple(sorted((h, tuple(sorted(v)))
+                         for h, v in self._proposed.items())),
+            tuple(tuple(sorted(s)) for s in self._expected_ev),
+        )
+
+    def mc_digest(self) -> bytes:
+        """16-byte stable digest of mc_canonical — the dedup key.
+        hashlib over the repr (ints/tuples only: deterministic across
+        processes and runs) rather than builtin hash: no PYTHONHASHSEED
+        sensitivity, negligible collision odds at corpus scale."""
+        import hashlib
+
+        return hashlib.blake2b(repr(self.mc_canonical()).encode(),
+                               digest_size=16).digest()

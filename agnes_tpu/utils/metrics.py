@@ -124,6 +124,13 @@ class Metrics:
 #: clean audit is part of the round artifact)
 ANALYSIS_ENTRIES_AUDITED = "analysis_entries_audited"
 RETRACE_UNEXPECTED = "retrace_unexpected"
+#: bounded model checker (analysis/modelcheck.py, ISSUE 6): distinct
+#: canonical states the exhaustive explorer visited, and property
+#: violations found.  Bench verdict records carry both (ci.sh exports
+#: the [1d] gate's numbers) so a round artifact states that the
+#: semantic gate ran and ran clean — the PR 4 pattern.
+MODELCHECK_STATES_EXPLORED = "modelcheck_states_explored"
+MODELCHECK_VIOLATIONS = "modelcheck_violations"
 VOTES_INGESTED = "votes_ingested"
 VOTES_VERIFIED = "votes_verified"
 THRESHOLDS_CROSSED = "thresholds_crossed"

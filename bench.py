@@ -122,9 +122,16 @@ _PROBE_PROC = None         # in-flight probe child; reaped on any exit
 #: drivers run with the recompile tripwire armed, ISSUE 4): distinct
 #: dispatch signatures vetted + traces outside the expected set.  The
 #: final verdict records carry both, so a hardware round's artifact
-#: states THAT the audit ran and that it ran clean.
+#: states THAT the audit ran and that it ran clean.  The bounded model
+#: checker's gate numbers ride along the same way (ISSUE 6): ci.sh
+#: exports the [1d] gate's JSON into these env vars before the bench
+#: gates run; -1 means the gate did not run in this process tree.
 _ANALYSIS: dict = {"analysis_entries_audited": 0,
-                   "retrace_unexpected": 0}
+                   "retrace_unexpected": 0,
+                   "modelcheck_states_explored": int(os.environ.get(
+                       "AGNES_MODELCHECK_STATES_EXPLORED", -1)),
+                   "modelcheck_violations": int(os.environ.get(
+                       "AGNES_MODELCHECK_VIOLATIONS", -1))}
 
 
 def _harvest_audit(driver) -> None:

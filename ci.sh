@@ -10,6 +10,10 @@
 #       3 producer threads racing the tick protocol).  A binary rather
 #       than pytest because TSAN through python drowns findings in
 #       uninstrumented jaxlib/Eigen thread-pool noise;
+#   1d. bounded model checker gate — exhaustive small-scope schedule
+#       exploration of the consensus core (agnes_modelcheck --scope
+#       smoke): zero XLA compiles, spec-level property monitors,
+#       real-value-or-sentinel under the enclosing timeout;
 #   2.  full pytest on the virtual 8-device CPU mesh;
 #   2b. the 16 interpret-heavy crypto tests in isolated child
 #       interpreters, VERBOSE, so their per-file pass/fail lands in
@@ -79,6 +83,49 @@ per_pass = ", ".join(f"{k}:{v['seconds']}s"
 print(f"static analyzer OK: {audited} entries audited clean in "
       f"{rep['seconds']}s ({per_pass})")
 PY
+
+echo "=== [1d/4] bounded model checker (exhaustive smoke scope, no XLA) ==="
+# ISSUE 6: exhaustive bounded model checking of the consensus core —
+# every delivery/timeout/partition schedule within the smoke bounds,
+# canonical-state dedup + partial-order reduction, agreement/validity/
+# quorum/monotonicity/evidence monitors on every reachable state.
+# Pure CPU, zero jax imports, zero compiles; the CLI discovers the
+# enclosing timeout and degrades to a complete=false partial record
+# instead of getting SIGKILLed (real-value-or-sentinel, like [3c]/[3d]).
+MC_JSON="$(mktemp -d)/agnes_modelcheck.json"
+MC_RC=0
+timeout -k 10 420 python scripts/agnes_modelcheck.py --scope smoke --json \
+  > "$MC_JSON" || MC_RC=$?
+if [ "$MC_RC" -ne 0 ]; then
+  echo "model checker FAILED (rc=$MC_RC):"; tail -5 "$MC_JSON"; exit 1
+fi
+# one parse, as a standalone step so an assertion failure FAILS the
+# gate (a `$(...)` inside a redirect word would have its exit status
+# discarded under set -e); the numbers land in a file for the env
+# exports the [4/4] bench's verdict records carry alongside
+# analysis_entries_audited (utils/metrics.py names, PR 4 pattern)
+MC_NUMS="${MC_JSON%.json}.nums"
+python - "$MC_JSON" "$MC_NUMS" <<'PY'
+import json, sys
+rep = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert rep["ok"], [c["violations"] for c in rep["configs"].values()]
+assert rep["states_explored"] > 0, rep
+assert rep["violations"] == 0, rep
+if rep["complete"]:
+    # the acceptance floor: a COMPLETE smoke run that shrank this far
+    # means someone collapsed the envelope or broke the explorer; a
+    # deadline-sentinel partial is exempt (slow box, not a regression)
+    assert rep["states_explored"] >= 50_000, rep["states_explored"]
+kind = "EXHAUSTED" if rep["complete"] else "partial (deadline sentinel)"
+print(f"model checker OK: {rep['states_explored']} canonical states "
+      f"{kind}, 0 violations in {rep['seconds']}s "
+      f"({rep['transitions']} transitions)")
+with open(sys.argv[2], "w") as f:
+    f.write(f"{rep['states_explored']} {rep['violations']}\n")
+PY
+read -r MC_STATES MC_VIOLS < "$MC_NUMS"
+export AGNES_MODELCHECK_STATES_EXPLORED="${MC_STATES:?}"
+export AGNES_MODELCHECK_VIOLATIONS="${MC_VIOLS:?}"
 
 echo "=== [2/4] full test suite (virtual 8-device CPU mesh) ==="
 # step 1 already ran the native differential + fuzz files under ASan

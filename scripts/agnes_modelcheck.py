@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""agnes_modelcheck: exhaustive bounded model checking of the
+consensus core (agnes_tpu/analysis/modelcheck.py, ISSUE 6).
+
+Explores EVERY delivery/timeout/partition schedule of the host plane
+within a bounded scope — N nodes x fault assignment x depth x rounds —
+with canonical-state dedup and partial-order reduction, checking the
+spec-level monitors (agreement, validity, quorum certificates,
+monotonicity, evidence completeness) on every reachable state.  Pure
+CPU, zero jax imports, ZERO XLA compiles: it shares the pre-test ci.sh
+gate slot with agnes_lint.
+
+Usage:
+  scripts/agnes_modelcheck.py --scope smoke --json   # the ci.sh gate
+  scripts/agnes_modelcheck.py --scope tiny           # seconds-fast
+  scripts/agnes_modelcheck.py --self-test            # mutation drill
+  scripts/agnes_modelcheck.py --scope smoke --no-por # debug aid
+
+The CLI discovers its enclosing wall budget (AGNES_MODELCHECK_DEADLINE_S
+or an ancestor `timeout N`) and stops cleanly with complete=false
+partials rather than getting SIGKILLed — the same
+real-value-or-sentinel contract as the bench gates.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from agnes_tpu.analysis.modelcheck import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,8 @@ which share the state machine but NOT the tally/event ordering
 _requery) — exactly where a divergence would hide.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -79,6 +81,59 @@ def _compare(net, traces, scenario, seed):
         # equivocations the host catches (e.g. conflicting votes that
         # arrive after its window rotated past the round).
         host_ev = {e.validator for e in node.all_equivocations()}
+        assert rep.equivocators <= host_ev, (
+            f"{ctx}: device flagged {rep.equivocators - host_ev} "
+            f"without host evidence")
+
+
+# -- regression corpus (ISSUE 6): model-checker schedules FIRST ------------
+#
+# tests/corpus/*.json holds ddmin-minimized schedules the bounded model
+# checker (analysis/modelcheck.py) flagged as coverage milestones or
+# mutation counterexamples.  They replay deterministically — unlike the
+# random fuzz below, a corpus failure bisects to one short, named
+# schedule — and they run BEFORE the seeds (definition order) so a
+# cross-plane regression surfaces in the cheapest, most attributable
+# case available.
+
+_CORPUS_DIR = os.path.join(os.path.dirname(__file__), "corpus")
+
+
+def _load_corpus():
+    from agnes_tpu.analysis import modelcheck as mc
+
+    return mc.load_corpus(_CORPUS_DIR)
+
+
+@pytest.mark.parametrize("entry", _load_corpus(),
+                         ids=lambda e: e["name"])
+def test_corpus_schedule_replays_identically_on_device_plane(entry):
+    """Each corpus schedule runs on the SIGNED, verifying host plane
+    under trace taps, then every node's exact processing stream goes
+    through the production device path (VoteBatcher -> fused step).
+    Decisions must agree per node; device evidence must be a subset of
+    host evidence (same rule as _compare below)."""
+    from agnes_tpu.analysis import modelcheck as mc
+
+    net, results = mc.device_replay_entry(entry)
+    exp = entry["expect"]["decided"]
+    for j, host_dec, rep in results:
+        ctx = f"corpus={entry['name']} node={j}"
+        if host_dec is None:
+            assert not rep.decided, \
+                f"{ctx}: device decided, host did not"
+            continue
+        # the signed replay must also match the stamped (unsigned,
+        # model-checker-time) expectation — crypto must be transparent
+        assert [host_dec.round, host_dec.value] == exp[str(j)], (
+            f"{ctx}: signed host replay diverged from corpus stamp")
+        assert rep.decided, f"{ctx}: host decided {host_dec}, device did not"
+        assert rep.value == host_dec.value, (
+            f"{ctx}: value {rep.value} != host {host_dec.value}")
+        assert rep.round == host_dec.round, (
+            f"{ctx}: round {rep.round} != host {host_dec.round}")
+        host_ev = {e.validator
+                   for e in net.nodes[j].all_equivocations()}
         assert rep.equivocators <= host_ev, (
             f"{ctx}: device flagged {rep.equivocators - host_ev} "
             f"without host evidence")

@@ -1,0 +1,300 @@
+"""Bounded model checker (analysis/modelcheck.py, ISSUE 6) — checker
+soundness, mutation detection, minimization, corpus determinism, CLI.
+
+Everything here is pure CPU with ZERO XLA compiles (the checker never
+imports jax — asserted below), so the file sits in conftest._CHEAP.
+The device-plane half of the story — corpus schedules replayed through
+VoteBatcher -> fused step — lives in tests/test_cross_plane.py, which
+already owns the compile-bearing replay path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from agnes_tpu.analysis import modelcheck as mc
+from agnes_tpu.harness.simulator import Network
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "corpus")
+
+
+# ---------------------------------------------------------------------------
+# zero-jax / zero-compile guarantee
+# ---------------------------------------------------------------------------
+
+
+def test_checker_import_is_jax_free():
+    """The ci.sh gate slot (pre-test, beside agnes_lint) depends on the
+    checker never touching jax: importing and RUNNING an exploration
+    must not pull jax into the interpreter."""
+    code = (
+        "import sys\n"
+        "from agnes_tpu.analysis import modelcheck as mc\n"
+        "rep = mc.explore(mc.MCConfig(name='t', depth=3))\n"
+        "assert rep.states > 1 and not rep.violations\n"
+        "assert 'jax' not in sys.modules, 'jax leaked into the checker'\n"
+        "print('JAXFREE-OK')\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0 and "JAXFREE-OK" in out.stdout, (
+        out.stdout, out.stderr)
+
+
+# ---------------------------------------------------------------------------
+# step-mode determinism + schedule serialization
+# ---------------------------------------------------------------------------
+
+
+def _walk(cfg, seed, steps):
+    import random
+
+    rng = random.Random(seed)
+    net = mc.build_network(cfg)
+    sched = []
+    for _ in range(steps):
+        acts = net.mc_enabled(max_round=cfg.max_round)
+        if not acts:
+            break
+        a = rng.choice(acts)
+        assert net.mc_apply(a)
+        sched.append(a)
+    return net, sched
+
+
+def test_schedule_replay_is_deterministic():
+    cfg = mc.MCConfig(name="det", depth=0, max_round=2)
+    net, sched = _walk(cfg, seed=7, steps=60)
+    for _ in range(2):
+        net2 = mc.build_network(cfg)
+        assert all(net2.run_schedule(sched))
+        assert net2.mc_digest() == net.mc_digest()
+        assert [nd.decided.get(0) for nd in net2.nodes] == \
+            [nd.decided.get(0) for nd in net.nodes]
+
+
+def test_schedule_json_roundtrip():
+    cfg = mc.MCConfig(name="json", depth=0, max_round=2)
+    net, sched = _walk(cfg, seed=3, steps=40)
+    js = [Network.action_to_json(a) for a in sched]
+    assert [Network.action_from_json(a) for a in js] == sched
+    net2 = mc.build_network(cfg)
+    net2.run_schedule(json.loads(json.dumps(js)))   # through real JSON
+    assert net2.mc_digest() == net.mc_digest()
+
+
+def test_run_schedule_skips_unenabled_actions():
+    """The ddmin tolerance contract: a not-currently-enabled action is
+    a recorded no-op, leaving the state untouched."""
+    cfg = mc.MCConfig(name="skip", depth=0)
+    net = mc.build_network(cfg)
+    d0 = net.mc_digest()
+    flags = net.run_schedule([("d", 2, 3), ("h",),
+                              ("t", 0, 0, 0, 2)])
+    assert flags == [False, False, False]
+    assert net.mc_digest() == d0
+
+
+# ---------------------------------------------------------------------------
+# exploration: determinism, POR soundness, clean smoke slices
+# ---------------------------------------------------------------------------
+
+
+POR_CONFIGS = (
+    mc.MCConfig(name="por_honest", depth=6, max_round=1),
+    mc.MCConfig(name="por_equiv", depth=5, max_round=1,
+                behaviors=("equivocator", "honest", "honest", "honest")),
+    mc.MCConfig(name="por_part", depth=5, max_round=1,
+                partition=((0, 1), (2, 3))),
+)
+
+
+@pytest.mark.parametrize("cfg", POR_CONFIGS, ids=lambda c: c.name)
+def test_por_reaches_exactly_the_full_state_set(cfg):
+    """Partial-order reduction must prune TRANSITIONS, never states:
+    the por and no-por explorations visit the identical canonical
+    state set (and both run violation-free)."""
+    a = mc.explore(cfg, por=True, collect_digests=True)
+    b = mc.explore(cfg, por=False, collect_digests=True)
+    assert a.complete and b.complete
+    assert a.digests == b.digests
+    assert a.states == b.states
+    assert a.transitions < b.transitions     # the reduction is real
+    assert not a.violations and not b.violations
+
+
+def test_exploration_is_deterministic():
+    cfg = mc.MCConfig(name="det2", depth=5, max_round=1)
+    a = mc.explore(cfg, collect_digests=True)
+    b = mc.explore(cfg, collect_digests=True)
+    assert (a.states, a.transitions, a.digests) == \
+        (b.states, b.transitions, b.digests)
+
+
+def test_deadline_yields_clean_partial():
+    cfg = mc.MCConfig(name="dl", depth=10, max_round=1)
+    rep = mc.explore(cfg, deadline_at=time.time() - 1.0)
+    assert not rep.complete
+    assert rep.states > 0 and not rep.violations
+
+
+def test_max_states_cap_yields_clean_partial():
+    cfg = mc.MCConfig(name="cap", depth=10, max_round=1)
+    rep = mc.explore(cfg, max_states=500)
+    assert not rep.complete and 500 <= rep.states <= 600
+
+
+def test_honest_decisions_carry_quorum_certs():
+    """Positive monitor coverage: a real decision's DecisionCert shows
+    +2/3 precommit weight (the thing the quorumless mutant breaks)."""
+    cfg, pred, seed, bias = mc.CORPUS_GOALS["mc_n4_honest_decides"]
+    sched = mc._walk_until(cfg, pred, seed, deliver_bias=bias)
+    net, viols = mc.run_with_monitors(cfg, sched)
+    assert not viols
+    for nd in net.nodes:
+        assert 0 in nd.decided
+        (cert,) = nd.decision_certs
+        assert 3 * cert.weight > 2 * cert.total
+
+
+# ---------------------------------------------------------------------------
+# mutation tests: the monitors must have teeth
+# ---------------------------------------------------------------------------
+
+
+def test_mutation_decide_without_quorum_is_caught_and_minimized():
+    name = "decide_without_quorum"
+    mut_cls, prop, cfg = mc.MUTANTS[name]
+    rep = mc.explore(cfg, executor_cls=mut_cls)
+    caught = [c for c in rep.violations if c.violation.property == prop]
+    assert caught, f"monitors missed the {name} mutant"
+    ce = caught[0]
+    small = mc.minimize(cfg, ce.schedule, prop, executor_cls=mut_cls)
+    assert len(small) <= len(ce.schedule)
+    assert mc.reproduces(cfg, small, prop, executor_cls=mut_cls)
+    # 1-minimality: every action in the minimized schedule is load-bearing
+    for i in range(len(small)):
+        trial = small[:i] + small[i + 1:]
+        assert not trial or not mc.reproduces(cfg, trial, prop,
+                                              executor_cls=mut_cls)
+    # the violation belongs to the mutation, not the checker: the same
+    # schedule on the honest executor runs clean
+    _, honest = mc.run_with_monitors(cfg, small)
+    assert not honest
+
+
+def test_mutation_drop_evidence_is_caught_and_minimized():
+    name = "drop_equivocation_evidence"
+    mut_cls, prop, cfg = mc.MUTANTS[name]
+    rep = mc.explore(cfg, executor_cls=mut_cls)
+    caught = [c for c in rep.violations if c.violation.property == prop]
+    assert caught, f"monitors missed the {name} mutant"
+    small = mc.minimize(cfg, caught[0].schedule, prop,
+                        executor_cls=mut_cls)
+    assert mc.reproduces(cfg, small, prop, executor_cls=mut_cls)
+    _, honest = mc.run_with_monitors(cfg, small)
+    assert not honest
+    # the honest replay SURFACES the evidence the mutant dropped
+    net, _ = mc.run_with_monitors(cfg, small)
+    assert any(nd.all_equivocations() for nd in net.nodes)
+
+
+def test_mutation_detection_survives_por():
+    """POR must not prune the violating interleavings away."""
+    for name, (mut_cls, prop, cfg) in mc.MUTANTS.items():
+        rep = mc.explore(cfg, executor_cls=mut_cls, por=True)
+        assert any(c.violation.property == prop
+                   for c in rep.violations), name
+
+
+def test_self_test_end_to_end():
+    out = mc.self_test()
+    assert set(out) == set(mc.MUTANTS)
+    for name, r in out.items():
+        assert r["minimized_len"] <= r["schedule_len"]
+        ce = r["counterexample"]
+        assert ce["schedule"], name
+        # the counterexample serializes as a corpus-replayable entry
+        cfg = mc.MCConfig.from_json(ce["config"])
+        acts = [Network.action_from_json(a) for a in ce["schedule"]]
+        entry = mc.corpus_entry(f"tmp_{name}", cfg, acts, origin="test")
+        assert entry["expect"]["violations"] == []   # honest: near-miss
+
+
+# ---------------------------------------------------------------------------
+# regression corpus (tests/corpus/*.json)
+# ---------------------------------------------------------------------------
+
+
+def test_corpus_exists_and_covers_the_fault_space():
+    entries = mc.load_corpus(CORPUS_DIR)
+    names = {e["name"] for e in entries}
+    assert len(entries) >= 8, names
+    behaviors = {b for e in entries for b in e["config"]["behaviors"]}
+    assert {"equivocator", "nil_flood"} <= behaviors
+    assert any(e["config"]["partition"] for e in entries)
+    assert any(e["config"]["n"] == 7 for e in entries)
+    assert any(e["expect"]["evidence"] for e in entries)
+    assert any(any(r >= 1 for r, _v in e["expect"]["decided"].values())
+               for e in entries if e["expect"]["decided"])
+    assert {n for n in names if n.startswith("mc_mut_")} == {
+        "mc_mut_decide_without_quorum",
+        "mc_mut_drop_equivocation_evidence"}
+
+
+@pytest.mark.parametrize("entry", mc.load_corpus(CORPUS_DIR),
+                         ids=lambda e: e["name"])
+def test_corpus_replays_deterministically_on_host(entry):
+    """Every corpus entry replays bit-stable on the (unsigned) host
+    plane: decisions, evidence counts and property verdicts must match
+    the stamped expectations.  The signed + device-plane replay of the
+    same entries runs in test_cross_plane.py."""
+    net, viols = mc.replay_corpus_entry(entry)
+    net2, _ = mc.replay_corpus_entry(entry)
+    assert net.mc_digest() == net2.mc_digest()
+
+
+# ---------------------------------------------------------------------------
+# CLI (scripts/agnes_modelcheck.py)
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args, timeout=240):
+    script = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "scripts", "agnes_modelcheck.py")
+    out = subprocess.run([sys.executable, script, *args],
+                         capture_output=True, text=True, timeout=timeout)
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln]
+    assert lines, (out.stdout, out.stderr)
+    return out.returncode, json.loads(lines[-1])
+
+
+def test_cli_tiny_scope_json():
+    rc, rep = _run_cli("--scope", "tiny", "--json", "--workers", "1")
+    assert rc == 0
+    assert rep["ok"] and rep["complete"]
+    assert rep["violations"] == 0
+    assert rep["states_explored"] > 1000
+    assert rep["metrics"]["modelcheck_states_explored"] == \
+        rep["states_explored"]
+    assert rep["metrics"]["modelcheck_violations"] == 0
+    assert set(rep["configs"]) == {c.name for c in mc.TINY_SCOPE}
+
+
+def test_cli_self_test():
+    rc, rep = _run_cli("--self-test")
+    assert rc == 0 and rep["ok"]
+    assert set(rep["self_test"]) == set(mc.MUTANTS)
+
+
+def test_cli_deadline_sentinel():
+    """The real-value-or-sentinel contract: with an impossible budget
+    the CLI still exits 0 with a parseable record, complete=false."""
+    rc, rep = _run_cli("--scope", "tiny", "--json", "--workers", "1",
+                       "--deadline-s", "0.01")
+    assert rc == 0 and rep["ok"]
+    assert not rep["complete"]
+    assert rep["violations"] == 0
