@@ -97,7 +97,14 @@ PROPERTIES = ("agreement", "validity", "quorum", "monotonic", "evidence")
 @dataclasses.dataclass(frozen=True)
 class MCConfig:
     """One bounded-exploration task: a behavior assignment plus the
-    exhaustiveness envelope.  JSON-able (spawn workers, corpus files)."""
+    exhaustiveness envelope.  JSON-able (spawn workers, corpus files).
+
+    `powers` assigns per-node voting power (original-index order, like
+    `behaviors`; None = all 1).  Asymmetric vectors move every +2/3
+    quorum boundary — the committee-weight territory of PAPERS.md
+    2004.12990 — and the monitors check the WEIGHTED predicates
+    (DecisionCert weight vs total power), so a tally that counts heads
+    instead of power is a catchable bug (the weight-blind mutant)."""
 
     name: str
     n: int = 4
@@ -107,12 +114,15 @@ class MCConfig:
     max_height: int = 0
     partition: Optional[Tuple[Tuple[int, ...], ...]] = None
     get_value_base: int = 100
+    powers: Optional[Tuple[int, ...]] = None
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
         d["behaviors"] = list(self.behaviors)
         d["partition"] = None if self.partition is None else \
             [list(g) for g in self.partition]
+        if self.powers is not None:
+            d["powers"] = list(self.powers)
         return d
 
     @classmethod
@@ -121,6 +131,8 @@ class MCConfig:
         d["behaviors"] = tuple(d["behaviors"])
         if d.get("partition") is not None:
             d["partition"] = tuple(tuple(g) for g in d["partition"])
+        if d.get("powers") is not None:
+            d["powers"] = tuple(d["powers"])
         return cls(**d)
 
 
@@ -134,9 +146,11 @@ def build_network(cfg: MCConfig,
     space is about consensus logic); corpus replay rebuilds the SAME
     config signed + verifying for production parity (sign=True)."""
     base = cfg.get_value_base
+    powers = cfg.powers or (1,) * cfg.n
     net = Network(
         n=cfg.n,
-        specs=[NodeSpec(behavior=b) for b in cfg.behaviors],
+        specs=[NodeSpec(behavior=b, power=p)
+               for b, p in zip(cfg.behaviors, powers)],
         get_value=lambda h: base + h,
         verify_signatures=sign if verify is None else verify,
         sign_messages=sign,
@@ -238,16 +252,183 @@ def _state_violations(net: Network) -> List[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# Symmetry reduction (ISSUE 7 tentpole axis 1)
+# ---------------------------------------------------------------------------
+
+
+class SymmetryCapError(AssertionError):
+    """A state escaped the envelope the symmetry group was built for
+    (a node's height exceeded `h_cap` or its round exceeded
+    `max_round`).  Orbit merges made under that assumption would be
+    unsound, so the exploration fails LOUD instead of silently
+    reporting a reduced-but-wrong state count.  The fix is a larger
+    cap (more fixed proposer slots, less reduction), never ignoring
+    the error."""
+
+
+def relabel_action(act: tuple, perm: Sequence[int]) -> tuple:
+    """An action's name under a node relabeling: deliveries carry
+    (src, dst), timeouts a node index; partition/heal are global."""
+    k = act[0]
+    if k == "d":
+        return ("d", perm[act[1]], perm[act[2]])
+    if k == "t":
+        return ("t", perm[act[1]], *act[2:])
+    return act
+
+
+@dataclasses.dataclass(frozen=True)
+class Symmetry:
+    """A sound node-permutation group for one MCConfig.
+
+    Honest nodes are interchangeable — relabeling them induces a
+    bisimulation — PROVIDED the permutation fixes everything the
+    transition relation can tell nodes apart by:
+
+      * behavior (byzantine policies are per-node),
+      * voting power (weights feed every quorum predicate),
+      * partition group (the ("p",) action's shape is fixed),
+      * every proposer slot queryable inside the envelope: heights
+        <= `h_cap`, rounds <= `max_round` (proposer identity is the
+        ONE asymmetry in honest logic).  `h_cap` comes from a sound
+        decision lower bound (`_decision_bound`): when the schedule
+        budget cannot possibly produce a decision, no node ever
+        leaves height 0 and only height-0 proposers need fixing —
+        which is what makes the n=7 scopes collapse by orbits of the
+        5 interchangeable non-proposers.
+
+    `digest()` re-checks the envelope on every state (SymmetryCapError
+    on escape), so the reduction is self-verifying rather than
+    trusted.  Only meaningful on unsigned networks (the checker's
+    build): per-node signing keys would distinguish relabeled nodes.
+    """
+
+    perms: Tuple[Tuple[int, ...], ...]     # identity first
+    h_cap: int
+    max_round: int
+
+    def check(self, net: Network) -> None:
+        for nd in net.nodes:
+            if nd.height > self.h_cap:
+                raise SymmetryCapError(
+                    f"node at height {nd.height} > symmetry h_cap "
+                    f"{self.h_cap}: orbit merges would be unsound")
+            if nd.state.round > self.max_round:
+                raise SymmetryCapError(
+                    f"node at round {nd.state.round} > symmetry round "
+                    f"cap {self.max_round}")
+
+    def digest(self, net: Network) -> Tuple[bytes,
+                                            Optional[Tuple[int, ...]]]:
+        """(least orbit digest, canonicalizing perm or None for
+        identity) — the visited key and the frame's action-name
+        translation (the rec[] bookkeeping must compare actions in ONE
+        labeling per orbit)."""
+        self.check(net)
+        best = net.mc_digest()
+        best_p: Optional[Tuple[int, ...]] = None
+        for p in self.perms[1:]:
+            d = net.mc_digest(p)
+            if d < best:
+                best, best_p = d, p
+        return best, best_p
+
+
+def _decision_bound(net: Network) -> int:
+    """A sound LOWER bound on the schedule length of any decision:
+    the decider needs q-1 delivered value-precommits (q = fewest
+    validators, heaviest first, whose power clears +2/3), and each of
+    those q-1 precommitters needed q-1 delivered prevotes for its
+    polka — all distinct delivery actions.  Behaviors only remove
+    messages and first-vote dedup blocks double counting, so no fault
+    model shortens this.  Holds for the HONEST quorum rule only — a
+    doctored executor may decide cheaper, so mutant explorations must
+    not lean on it (build_symmetry keeps their h_cap conservative)."""
+    powers = sorted((v.voting_power for v in net.vset), reverse=True)
+    total = sum(powers)
+    acc = q = 0
+    for w in powers:
+        acc += w
+        q += 1
+        if 3 * acc > 2 * total:
+            break
+    return q * (q - 1)
+
+
+def build_symmetry(cfg: MCConfig,
+                   executor_cls: Optional[type] = None,
+                   max_perms: int = 24) -> Symmetry:
+    """The symmetry group for `cfg` (sorted-index space).  Buckets the
+    honest, non-proposer-slot nodes by (power, partition group) and
+    permutes within buckets; the group size is capped at `max_perms`
+    (canonicalization costs one digest per perm per state) by fixing
+    lowest-index members of the largest bucket first — deterministic,
+    less reduction, never unsound."""
+    import itertools
+    import math
+
+    net = build_network(cfg, executor_cls)
+    mutant = executor_cls is not None \
+        and executor_cls is not ConsensusExecutor
+    if mutant or cfg.depth >= _decision_bound(net):
+        h_cap = cfg.max_height + 1
+    else:
+        h_cap = 0            # no decision fits the budget: heights pin
+    probe = net.nodes[0]
+    fixed = {probe.proposer(h, r)
+             for h in range(h_cap + 1)
+             for r in range(cfg.max_round + 1)}
+    gid: List[Optional[int]] = [None] * cfg.n
+    if cfg.partition is not None:
+        for g, members in enumerate(cfg.partition):
+            for i in members:
+                gid[i] = g
+    buckets_by_key: Dict[tuple, List[int]] = {}
+    for i in range(cfg.n):
+        if i in fixed or net.specs[i].behavior != "honest":
+            continue
+        key = (net.specs[i].power, gid[i])
+        buckets_by_key.setdefault(key, []).append(i)
+    buckets = [b for b in buckets_by_key.values() if len(b) >= 2]
+
+    def group_size(bs):
+        return math.prod(math.factorial(len(b)) for b in bs)
+
+    while buckets and group_size(buckets) > max_perms:
+        max(buckets, key=len).pop(0)
+        buckets = [b for b in buckets if len(b) >= 2]
+
+    ident = tuple(range(cfg.n))
+    perms = [ident]
+    for b in buckets:                      # buckets are disjoint
+        perms = [_compose(p, b, order)
+                 for p in perms
+                 for order in itertools.permutations(b)]
+    perms = [ident] + sorted(p for p in set(perms) if p != ident)
+    return Symmetry(perms=tuple(perms), h_cap=h_cap,
+                    max_round=cfg.max_round)
+
+
+def _compose(base: Tuple[int, ...], bucket: List[int],
+             order: Tuple[int, ...]) -> Tuple[int, ...]:
+    p = list(base)
+    for src, dst in zip(bucket, order):
+        p[src] = dst
+    return tuple(p)
+
+
+# ---------------------------------------------------------------------------
 # The explorer
 # ---------------------------------------------------------------------------
 
 
 @dataclasses.dataclass
 class Counterexample:
-    config: MCConfig
+    config: object                 # MCConfig | admission config
     violation: Violation
     schedule: List[tuple]          # action tuples from the initial state
     minimized: Optional[List[tuple]] = None
+    codec: type = Network          # owns action_to_json/from_json
 
     def to_json(self) -> dict:
         sched = self.minimized if self.minimized is not None \
@@ -257,15 +438,15 @@ class Counterexample:
             "property": self.violation.property,
             "node": self.violation.node,
             "detail": self.violation.detail,
-            "schedule": [Network.action_to_json(a) for a in sched],
+            "schedule": [self.codec.action_to_json(a) for a in sched],
             "schedule_unminimized":
-                [Network.action_to_json(a) for a in self.schedule],
+                [self.codec.action_to_json(a) for a in self.schedule],
         }
 
 
 @dataclasses.dataclass
 class Report:
-    config: MCConfig
+    config: object
     states: int = 0
     transitions: int = 0
     violations: List[Counterexample] = dataclasses.field(
@@ -275,8 +456,16 @@ class Report:
     deepest: int = 0
     seconds: float = 0.0
     # filled only when explore(collect_digests=True): the exact visited
-    # canonical-state set, for the POR-soundness equivalence tests
+    # key set (canonical ORBIT digests under symmetry reduction), for
+    # the POR/symmetry-soundness equivalence tests
     digests: Optional[set] = None
+    # filled only when explore(collect_orbit_digests=True): the orbit
+    # digest of every visited state — lets an UNREDUCED run state its
+    # orbit coverage for comparison against a reduced run
+    orbit_digests: Optional[set] = None
+    # symmetry-group size the exploration ran under (1 = unreduced)
+    sym_perms: int = 1
+    codec: type = Network
 
     def to_json(self) -> dict:
         return {
@@ -284,10 +473,11 @@ class Report:
             "states": self.states,
             "transitions": self.transitions,
             "violations": [c.to_json() for c in self.violations],
-            "near_misses": {k: [Network.action_to_json(a) for a in v]
+            "near_misses": {k: [self.codec.action_to_json(a) for a in v]
                             for k, v in self.near_misses.items()},
             "complete": self.complete,
             "deepest": self.deepest,
+            "sym_perms": self.sym_perms,
             "seconds": round(self.seconds, 1),
         }
 
@@ -307,16 +497,18 @@ def _indep(a: tuple, b: tuple) -> bool:
 
 
 class _Frame:
-    __slots__ = ("net", "digest", "depth", "snap", "todo", "idx", "sleep")
+    __slots__ = ("net", "digest", "depth", "snap", "todo", "idx",
+                 "sleep", "cperm")
 
-    def __init__(self, net, digest, depth, todo, sleep):
+    def __init__(self, net, digest, depth, snap, todo, sleep, cperm):
         self.net = net
         self.digest = digest
         self.depth = depth
-        self.snap = _edge_snapshot(net)
+        self.snap = snap
         self.todo = todo
         self.idx = 0
         self.sleep = sleep
+        self.cperm = cperm      # canonicalizing perm (None = identity)
 
 
 def _expandable(net: Network, cfg: MCConfig) -> bool:
@@ -325,35 +517,94 @@ def _expandable(net: Network, cfg: MCConfig) -> bool:
     return any(nd.height <= cfg.max_height for nd in net.nodes)
 
 
-def explore(cfg: MCConfig,
-            executor_cls: Optional[type] = None,
-            por: bool = True,
-            deadline_at: Optional[float] = None,
-            max_states: Optional[int] = None,
-            stop_on_violation: bool = True,
-            collect_digests: bool = False) -> Report:
-    """Depth-bounded exhaustive DFS over `cfg`'s schedule space.
+@dataclasses.dataclass
+class Domain:
+    """The exhaustive engine's pluggable surface (ISSUE 7: the one DFS
+    drives both the consensus Network and the serve-plane admission
+    model).  A system object must provide mc_clone / mc_apply /
+    mc_digest; everything domain-specific — enabling, monitors, POR
+    independence, bounds — arrives as hooks."""
 
-    `deadline_at` is an absolute time.time() instant: exploration past
-    it stops cleanly with `complete=False` (the gate's sentinel half).
-    Returns on the first violation (minimized by the caller)."""
+    enabled: Callable[[object], List[tuple]]
+    expandable: Callable[[object], bool]
+    state_violations: Callable[[object], List[Violation]]
+    edge_snapshot: Callable[[object], object]
+    edge_violations: Callable[[object, object], List[Violation]]
+    indep: Callable[[tuple, tuple], bool]
+    near_miss: Optional[Callable[[object, list, "Report"], None]] = None
+    symmetry: Optional[Symmetry] = None    # orbit-reduced visited keys
+    codec: type = Network
+
+
+def _explore_domain(root, cfg, dom: Domain, *,
+                    por: bool = True,
+                    deadline_at: Optional[float] = None,
+                    max_states: Optional[int] = None,
+                    stop_on_violation: bool = True,
+                    collect_digests: bool = False,
+                    collect_orbit_digests: bool = False,
+                    orbit_sym: Optional[Symmetry] = None) -> Report:
+    """Depth-bounded exhaustive DFS over `cfg`'s schedule space
+    (`cfg.depth` bounds it; `deadline_at` is an absolute time.time()
+    instant past which exploration stops cleanly with complete=False —
+    the gate's sentinel half).  Returns on the first violation
+    (minimized by the caller).
+
+    Symmetry composition (dom.symmetry): the visited key is the LEAST
+    ORBIT digest, and — because different orbit members name the same
+    action differently — the per-orbit explored-action bookkeeping
+    (`rec[1]`, the sleep-set/state-caching repair) stores and compares
+    action names translated into the orbit's canonical labeling via
+    each frame's canonicalizing perm.  Concrete frames are never
+    relabeled, so counterexample schedules stay root-replayable, and
+    POR's sleep sets (path-local, concrete labels) compose unchanged.
+
+    `orbit_sym` makes an UNREDUCED run also record the orbit digest of
+    every visited state (Report.orbit_digests) so tests can prove the
+    reduced search covers the identical orbit set."""
     t0 = time.perf_counter()
-    rep = Report(config=cfg)
-    root = build_network(cfg, executor_cls)
-    viols = _state_violations(root)
+    rep = Report(config=cfg, codec=dom.codec)
+    sym = dom.symmetry
+    if sym is not None:
+        rep.sym_perms = len(sym.perms)
+    viols = dom.state_violations(root)
     if viols:
-        rep.violations.append(Counterexample(cfg, viols[0], []))
+        rep.violations.append(
+            Counterexample(cfg, viols[0], [], codec=dom.codec))
         rep.states = 1
         rep.complete = False        # truncated at the root
         rep.seconds = time.perf_counter() - t0
         return rep
 
-    # digest -> [min_depth_seen, explored action set]
+    # visited key -> [min_depth_seen, explored action set (canonical
+    # labels under symmetry)]
     visited: Dict[bytes, list] = {}
+    # raw digest -> (orbit digest, canonicalizing perm): revisits of a
+    # raw-identical state skip the |perms| canonicalization loop
+    orbit_memo: Dict[bytes, tuple] = {}
     path: List[tuple] = []
+    orbit_digests: Optional[set] = set() if orbit_sym is not None \
+        else None
 
-    def make_frame(net, digest, depth, sleep):
-        enabled = net.mc_enabled(max_round=cfg.max_round)
+    def state_key(net):
+        if sym is None and orbit_sym is None:
+            return net.mc_digest(), None
+        raw = net.mc_digest()
+        hit = orbit_memo.get(raw)
+        if hit is None:
+            hit = orbit_memo[raw] = (sym or orbit_sym).digest(net)
+        orbit, cperm = hit
+        if orbit_digests is not None:
+            orbit_digests.add(orbit)
+        if sym is None:
+            return raw, None
+        return orbit, cperm
+
+    def canon_act(act, cperm):
+        return act if cperm is None else relabel_action(act, cperm)
+
+    def make_frame(net, digest, depth, sleep, cperm):
+        enabled = dom.enabled(net)
         rec = visited.get(digest)
         if rec is None:
             rec = visited[digest] = [depth, set()]
@@ -363,19 +614,19 @@ def explore(cfg: MCConfig,
             rec[0] = depth
             rec[1] = set()
         todo = [a for a in enabled
-                if a not in sleep and a not in rec[1]]
-        rec[1].update(todo)
-        return _Frame(net, digest, depth, todo, sleep), enabled
+                if a not in sleep and canon_act(a, cperm) not in rec[1]]
+        rec[1].update(canon_act(a, cperm) for a in todo)
+        return _Frame(net, digest, depth, dom.edge_snapshot(net),
+                      todo, sleep, cperm)
 
-    root_digest = root.mc_digest()
-    frame, _ = make_frame(root, root_digest, 0, frozenset())
-    stack = [frame]
+    root_digest, root_cperm = state_key(root)
+    stack = [make_frame(root, root_digest, 0, frozenset(), root_cperm)]
     check_tick = 0
 
     while stack:
         f = stack[-1]
         if f.idx >= len(f.todo) or f.depth >= cfg.depth \
-                or not _expandable(f.net, cfg):
+                or not dom.expandable(f.net):
             stack.pop()
             if path:
                 path.pop()
@@ -400,9 +651,10 @@ def explore(cfg: MCConfig,
         rep.deepest = max(rep.deepest, depth)
         sched = path + [act]
 
-        for v in _edge_violations(child, f.snap):
-            rep.violations.append(Counterexample(cfg, v, sched))
-        digest = child.mc_digest()
+        for v in dom.edge_violations(child, f.snap):
+            rep.violations.append(
+                Counterexample(cfg, v, sched, codec=dom.codec))
+        digest, cperm = state_key(child)
         rec = visited.get(digest)
         new_state = rec is None
         if new_state:
@@ -410,9 +662,11 @@ def explore(cfg: MCConfig,
             # frontier, which never gets a frame: states_explored must
             # count it and the monitors must not re-run per path to it
             visited[digest] = [depth, set()]
-            for v in _state_violations(child):
-                rep.violations.append(Counterexample(cfg, v, sched))
-            _classify_near_miss(child, sched, rep)
+            for v in dom.state_violations(child):
+                rep.violations.append(
+                    Counterexample(cfg, v, sched, codec=dom.codec))
+            if dom.near_miss is not None:
+                dom.near_miss(child, sched, rep)
         if rep.violations and stop_on_violation:
             rep.complete = False    # truncated, not exhausted
             break
@@ -420,16 +674,19 @@ def explore(cfg: MCConfig,
         if depth >= cfg.depth:
             continue
         needs_visit = new_state or depth < rec[0]
+        sleep = None
         if not needs_visit:
             # already visited at <= this depth; only new actions (ones
             # neither explored nor slept before) warrant a re-push
-            enabled = child.mc_enabled(max_round=cfg.max_round)
-            sleep = _child_sleep(f, act, por)
-            needs_visit = any(a not in sleep and a not in rec[1]
+            enabled = dom.enabled(child)
+            sleep = _child_sleep(f, act, por, dom)
+            needs_visit = any(a not in sleep
+                              and canon_act(a, cperm) not in rec[1]
                               for a in enabled)
         if needs_visit:
-            sleep = _child_sleep(f, act, por)
-            nf, _ = make_frame(child, digest, depth, sleep)
+            if sleep is None:
+                sleep = _child_sleep(f, act, por, dom)
+            nf = make_frame(child, digest, depth, sleep, cperm)
             if nf.todo:
                 stack.append(nf)
                 path.append(act)
@@ -437,11 +694,18 @@ def explore(cfg: MCConfig,
     rep.states = len(visited)
     if collect_digests:
         rep.digests = set(visited)
+    if orbit_digests is not None:
+        rep.orbit_digests = orbit_digests
+    elif sym is not None and collect_orbit_digests:
+        # under symmetry the visited keys ARE the orbit digests — the
+        # field's contract holds in both modes
+        rep.orbit_digests = set(visited)
     rep.seconds = time.perf_counter() - t0
     return rep
 
 
-def _child_sleep(f: "_Frame", act: tuple, por: bool) -> frozenset:
+def _child_sleep(f: "_Frame", act: tuple, por: bool,
+                 dom: Domain) -> frozenset:
     """Sleep set for `act`'s subtree: lower-ordered independent actions
     already explored from `f`'s state — their both-orders diamond
     closes, so re-exploring them under `act` only re-reaches the state
@@ -452,7 +716,50 @@ def _child_sleep(f: "_Frame", act: tuple, por: bool) -> frozenset:
     inherited = f.sleep
     return frozenset(
         b for b in (*explored, *inherited)
-        if _indep(b, act) and b < act)
+        if dom.indep(b, act) and b < act)
+
+
+def _consensus_domain(cfg: MCConfig,
+                      symmetry: Optional[Symmetry] = None) -> Domain:
+    return Domain(
+        enabled=lambda net: net.mc_enabled(max_round=cfg.max_round),
+        expandable=lambda net: _expandable(net, cfg),
+        state_violations=_state_violations,
+        edge_snapshot=_edge_snapshot,
+        edge_violations=_edge_violations,
+        indep=_indep,
+        near_miss=_classify_near_miss,
+        symmetry=symmetry,
+        codec=Network)
+
+
+def explore(cfg: MCConfig,
+            executor_cls: Optional[type] = None,
+            por: bool = True,
+            deadline_at: Optional[float] = None,
+            max_states: Optional[int] = None,
+            stop_on_violation: bool = True,
+            collect_digests: bool = False,
+            sym: bool = False,
+            collect_orbit_digests: bool = False) -> Report:
+    """Depth-bounded exhaustive DFS over `cfg`'s schedule space (the
+    consensus domain; _explore_domain is the engine).  `sym=True`
+    composes symmetry reduction with POR: states dedup on least-orbit
+    digests (build_symmetry's group), cutting visited states by up to
+    |group| while reaching the identical orbit set — the smoke gate
+    runs with it on.  `collect_orbit_digests` makes an unreduced run
+    record its orbit coverage for the equivalence tests."""
+    symmetry = build_symmetry(cfg, executor_cls) if sym else None
+    orbit_sym = build_symmetry(cfg, executor_cls) \
+        if (collect_orbit_digests and not sym) else None
+    root = build_network(cfg, executor_cls)
+    return _explore_domain(
+        root, cfg, _consensus_domain(cfg, symmetry), por=por,
+        deadline_at=deadline_at, max_states=max_states,
+        stop_on_violation=stop_on_violation,
+        collect_digests=collect_digests,
+        collect_orbit_digests=collect_orbit_digests,
+        orbit_sym=orbit_sym)
 
 
 def _classify_near_miss(net: Network, sched: List[tuple],
@@ -624,17 +931,23 @@ def device_replay_entry(entry: dict) -> list:
     plane: run the signed host network under trace taps, then push each
     node's exact processing stream through VoteBatcher -> fused device
     step (harness/replay.py).  Returns (host net, [(node, host
-    Decision | None, ReplayResult)]).  This is the ONLY modelcheck path
-    that touches jax — imported lazily, never from the CLI gate."""
+    Decision | None, ReplayResult)]).  Weighted configs hand the
+    sorted per-validator power vector to the replay so the device
+    tally counts the same quorum boundaries the host did.  This is
+    the ONLY modelcheck path that touches jax — imported lazily,
+    never from the CLI gate."""
     from agnes_tpu.harness.replay import replay_trace, trace_network
 
     cfg = MCConfig.from_json(entry["config"])
     net = build_network(cfg, sign=True, verify=True, start=False)
+    powers = None
+    if any(v.voting_power != 1 for v in net.vset):
+        powers = net.vset.device_arrays()[1]
     traces = trace_network(net)
     net.run_schedule(entry["actions"])
     out = []
     for j, nd in enumerate(net.nodes):
-        rep = replay_trace(traces[j], n_validators=net.n)
+        rep = replay_trace(traces[j], n_validators=net.n, powers=powers)
         out.append((j, nd.decided.get(0), rep))
     return net, out
 
@@ -711,16 +1024,42 @@ CORPUS_GOALS: Dict[str, tuple] = {
         MCConfig(name="n7_honest", n=7, depth=0, max_round=2,
                  behaviors=("honest",) * 7),
         _all_decided, 0, 0.05),
+    # weighted milestones (ISSUE 7): decisions whose +2/3 boundary
+    # falls between vote counts — the heavy validator is REQUIRED for
+    # any quorum (lights alone hold 3/6), so the replayed device tally
+    # must weight it correctly or the decision vanishes
+    "mc_n4_weighted_decides": (
+        MCConfig(name="n4_weighted", depth=0, max_round=2,
+                 powers=(1, 1, 1, 3)),
+        _all_decided, 1, None),
+    "mc_n4_weighted_evidence": (
+        MCConfig(name="n4_weighted_equiv", depth=0, max_round=2,
+                 behaviors=("equivocator", "honest", "honest", "honest"),
+                 powers=(1, 1, 1, 3)),
+        lambda net: (_all_decided(net)
+                     and any(nd.all_equivocations()
+                             for nd in net.nodes)), 1, None),
+    # symmetry milestone: a decision in the orbit-richest smoke config
+    # (n=7, five interchangeable non-proposers) — replayed forever so
+    # the orbit-merged envelope keeps a deterministic deep witness
+    "mc_n7_weighted_decides": (
+        MCConfig(name="n7_weighted", n=7, depth=0, max_round=2,
+                 behaviors=("honest",) * 7,
+                 powers=(1, 1, 1, 1, 1, 2, 3)),
+        _all_decided, 0, 0.05),
 }
 
 
 def emit_corpus(directory: str, include_mutants: bool = True) -> List[str]:
     """(Re)generate the regression corpus: a ddmin-minimized schedule
-    per CORPUS_GOALS milestone, plus the two mutation self-test
+    per CORPUS_GOALS milestone, plus the mutation self-test
     counterexamples replayed on the honest executor (they stay
     interesting as device-plane differential cases even where the
-    honest host plane is clean).  Deterministic; committed as
-    tests/corpus/*.json and replayed by tests/test_cross_plane.py."""
+    honest host plane is clean), plus the serve-plane admission corpus
+    under `directory`/admission (analysis/admission_mc.py; replayed by
+    tests/test_admission_mc.py through the real stubbed ServePipeline).
+    Deterministic; committed as tests/corpus/ and replayed by
+    tests/test_cross_plane.py."""
     os.makedirs(directory, exist_ok=True)
     written = []
     for name, (cfg, pred, seed, bias) in CORPUS_GOALS.items():
@@ -751,6 +1090,11 @@ def emit_corpus(directory: str, include_mutants: bool = True) -> List[str]:
                 json.dump(entry, f, indent=1, sort_keys=True)
                 f.write("\n")
             written.append(path)
+    from agnes_tpu.analysis import admission_mc as am
+
+    written += am.emit_admission_corpus(
+        os.path.join(directory, "admission"),
+        include_mutants=include_mutants)
     return written
 
 
@@ -780,8 +1124,32 @@ class EvidenceDroppingExecutor(ConsensusExecutor):
         return []
 
 
+class WeightBlindExecutor(ConsensusExecutor):
+    """Doctored: counts validator HEADS instead of voting power (every
+    vote weighs 1 against a total of n) — the committee-weight bug
+    class of PAPERS.md 2004.12990.  On an asymmetric power vector
+    where the +2/3 boundary falls between vote counts (three weight-1
+    validators out of four are a head-count quorum but only 3/6 of
+    the power), it decides without a real quorum; the cert monitor
+    sees the counted weight against the TRUE total power and fires."""
+
+    def _new_votes(self, height: int):
+        from agnes_tpu.core.vote_executor import VoteExecutor
+
+        return VoteExecutor(height=height, total_weight=len(self.vset),
+                            edge_triggered=True)
+
+    def _vote_weight(self, v) -> int:
+        return 1
+
+
 #: mutant name -> (executor class, property the monitors must catch it
-#: with, config the violation is reachable in)
+#: with, config the violation is reachable in).  The weight-blind
+#: config puts power 3 on one validator (original index 3 -> sorted
+#: index 2, the round-0 proposer under the weighted rotation): the
+#: three weight-1 validators form a head-count quorum (3 of 4) that
+#: holds only 3 of 6 power — the violation needs the full 11-action
+#: three-light protocol, hence the deeper bound.
 MUTANTS: Dict[str, tuple] = {
     "decide_without_quorum": (
         QuorumlessExecutor, "quorum",
@@ -792,6 +1160,11 @@ MUTANTS: Dict[str, tuple] = {
         MCConfig(name="mut_evidence", n=4,
                  behaviors=("equivocator", "honest", "honest", "honest"),
                  depth=6, max_round=1)),
+    "decide_weight_blind_quorum": (
+        WeightBlindExecutor, "quorum",
+        MCConfig(name="mut_weight_blind", n=4,
+                 behaviors=("honest",) * 4, powers=(1, 1, 1, 3),
+                 depth=11, max_round=1)),
 }
 
 
@@ -832,9 +1205,12 @@ def self_test(por: bool = True) -> dict:
 
 #: The smoke scope: the ci.sh gate's envelope.  Sized for the 2-CPU CI
 #: box — must EXHAUST (complete=True) well inside the gate timeout
-#: while clearing the >= 50k distinct-state acceptance floor.  One
-#: config per fault model plus a partition/heal drill and an N=7
-#: shallow sweep; every one stays within f < n/3.
+#: while clearing the per-shard state floors the gate asserts.  One
+#: config per fault model plus a partition/heal drill, an N=7 shallow
+#: sweep, and (ISSUE 7) two WEIGHTED configs whose +2/3 boundary falls
+#: between vote counts (power 3 on original index 3 -> sorted index 2:
+#: three weight-1 validators are a head-count majority with only 3/6
+#: of the power); every one stays within f < n/3 by weight.
 SMOKE_SCOPE: Tuple[MCConfig, ...] = (
     MCConfig(name="n4_honest", depth=10, max_round=1),
     MCConfig(name="n4_silent", depth=11, max_round=1,
@@ -847,17 +1223,40 @@ SMOKE_SCOPE: Tuple[MCConfig, ...] = (
              partition=((0, 1), (2, 3))),
     MCConfig(name="n7_honest", n=7, behaviors=("honest",) * 7,
              depth=5, max_round=1),
+    MCConfig(name="n4_weighted", powers=(1, 1, 1, 3), depth=10,
+             max_round=1),
+    MCConfig(name="n4_weighted_equiv", powers=(1, 1, 1, 3), depth=9,
+             max_round=1,
+             behaviors=("equivocator", "honest", "honest", "honest")),
 )
+
+#: PR 6's measured unreduced (por-only) visit counts on the shared
+#: smoke configs — the denominator-side baseline for the
+#: `modelcheck_sym_orbit_reduction` metric.  These are DETERMINISTIC
+#: (same config -> same visited set); regenerate with
+#: `explore(cfg, sym=False)` after any semantic change to the core or
+#: the enumerator (the floor assertions in ci.sh will catch a silent
+#: drift).
+SYM_BASELINE_STATES: Dict[str, int] = {
+    "n4_honest": 94_290,
+    "n4_silent": 11_019,
+    "n4_equivocator": 62_570,
+    "n4_nil_flood": 50_932,
+    "n4_partition_heal": 88_057,
+    "n7_honest": 74_873,
+}
 
 #: Unit-test / CLI-smoke scope: seconds, not minutes.
 TINY_SCOPE: Tuple[MCConfig, ...] = (
     MCConfig(name="tiny_honest", depth=6, max_round=1),
     MCConfig(name="tiny_equivocator", depth=5, max_round=1,
              behaviors=("equivocator", "honest", "honest", "honest")),
+    MCConfig(name="tiny_weighted", powers=(1, 1, 1, 3), depth=6,
+             max_round=1),
 )
 
 #: Deep scope for workstation runs (not CI-gated): more rounds, deeper
-#: schedules, a second fault in the n=7 set.
+#: schedules, a second fault in the n=7 set, a weighted n=7.
 FULL_SCOPE: Tuple[MCConfig, ...] = SMOKE_SCOPE + (
     MCConfig(name="n4_honest_deep", depth=12, max_round=2),
     MCConfig(name="n4_equivocator_deep", depth=11, max_round=2,
@@ -865,6 +1264,8 @@ FULL_SCOPE: Tuple[MCConfig, ...] = SMOKE_SCOPE + (
     MCConfig(name="n7_two_faults", n=7, depth=6, max_round=1,
              behaviors=("equivocator", "silent", "honest", "honest",
                         "honest", "honest", "honest")),
+    MCConfig(name="n7_weighted", n=7, depth=5, max_round=1,
+             behaviors=("honest",) * 7, powers=(1, 1, 1, 1, 1, 2, 3)),
 )
 
 SCOPES = {"tiny": TINY_SCOPE, "smoke": SMOKE_SCOPE, "full": FULL_SCOPE}
@@ -873,9 +1274,28 @@ SCOPES = {"tiny": TINY_SCOPE, "smoke": SMOKE_SCOPE, "full": FULL_SCOPE}
 def _scope_worker(task: dict) -> dict:
     """One exploration shard in a spawned interpreter (the agnes_lint
     --pass all pattern): configs are independent, so they parallelize
-    across cores; JSON-able dicts cross the process boundary."""
+    across cores; JSON-able dicts cross the process boundary.  `kind`
+    routes between the consensus domain and the serve-plane admission
+    domain (analysis/admission_mc.py) — same engine, same record
+    shape."""
+    if task["config"].get("kind") == "admission":
+        from agnes_tpu.analysis import admission_mc as am
+
+        cfg = am.AdmissionMCConfig.from_json(task["config"])
+        rep = am.explore_admission(cfg,
+                                   deadline_at=task["deadline_at"],
+                                   max_states=task.get("max_states"))
+        for ce in rep.violations:
+            try:
+                ce.minimized = am.minimize_admission(
+                    cfg, ce.schedule, ce.violation.property)
+            except AssertionError:
+                ce.minimized = None
+        out = rep.to_json()
+        out["kind"] = "admission"
+        return out
     cfg = MCConfig.from_json(task["config"])
-    rep = explore(cfg, por=task["por"],
+    rep = explore(cfg, por=task["por"], sym=task.get("sym", False),
                   deadline_at=task["deadline_at"],
                   max_states=task.get("max_states"))
     for ce in rep.violations:
@@ -884,18 +1304,32 @@ def _scope_worker(task: dict) -> dict:
                                     ce.violation.property)
         except AssertionError:
             ce.minimized = None     # non-deterministic repro: report raw
-    return rep.to_json()
+    out = rep.to_json()
+    out["kind"] = "consensus"
+    return out
 
 
 def run_scope(scope: str, workers: Optional[int] = None, por: bool = True,
               deadline_at: Optional[float] = None,
-              max_states: Optional[int] = None) -> dict:
-    """Explore every config of `scope`, frontier-sharded over spawned
-    workers; aggregate states/violations (the CLI/gate record)."""
+              max_states: Optional[int] = None,
+              sym: bool = True) -> dict:
+    """Explore every config of `scope` — the consensus envelope AND
+    the serve-plane admission envelope (admission_mc.ADMISSION_SCOPES)
+    — frontier-sharded over spawned workers; aggregate
+    states/violations (the CLI/gate record).  Consensus shards run
+    symmetry-reduced by default (`sym`); the aggregate report carries
+    the measured orbit reduction against the PR 6 unreduced baseline
+    (`SYM_BASELINE_STATES`) and the admission-model state total."""
+    from agnes_tpu.analysis.admission_mc import ADMISSION_SCOPES
+
     configs = SCOPES[scope]
-    tasks = [{"config": c.to_json(), "por": por,
+    adm_configs = ADMISSION_SCOPES.get(scope, ())
+    tasks = [{"config": c.to_json(), "por": por, "sym": sym,
               "deadline_at": deadline_at, "max_states": max_states}
              for c in configs]
+    tasks += [{"config": c.to_json(), "por": por,
+               "deadline_at": deadline_at, "max_states": max_states}
+              for c in adm_configs]
     t0 = time.perf_counter()
     if workers is None:
         workers = min(len(tasks), max(2, os.cpu_count() or 2))
@@ -910,13 +1344,28 @@ def run_scope(scope: str, workers: Optional[int] = None, por: bool = True,
     report = {
         "scope": scope,
         "por": por,
+        "sym": sym,
         "configs": {r["config"]: r for r in results},
         "states_explored": sum(r["states"] for r in results),
         "transitions": sum(r["transitions"] for r in results),
         "violations": sum(len(r["violations"]) for r in results),
         "complete": all(r["complete"] for r in results),
+        "consensus_states": sum(r["states"] for r in results
+                                if r["kind"] == "consensus"),
+        "admission_states": sum(r["states"] for r in results
+                                if r["kind"] == "admission"),
         "seconds": round(time.perf_counter() - t0, 1),
     }
+    # measured orbit reduction on the shared (PR 6 baseline) configs:
+    # only meaningful when those shards EXHAUSTED under symmetry
+    base = reduced = 0
+    for r in results:
+        if r["kind"] == "consensus" and r["complete"] and sym \
+                and r["config"] in SYM_BASELINE_STATES:
+            base += SYM_BASELINE_STATES[r["config"]]
+            reduced += r["states"]
+    report["sym_orbit_reduction"] = \
+        round(base / reduced, 2) if reduced else -1
     report["ok"] = report["violations"] == 0
     return report
 
@@ -939,8 +1388,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--workers", type=int, default=None)
     ap.add_argument("--no-por", action="store_true",
                     help="disable partial-order reduction (debug aid)")
+    ap.add_argument("--no-sym", action="store_true",
+                    help="disable symmetry reduction (debug aid; the "
+                         "orbit-reduction metric reads -1)")
     ap.add_argument("--self-test", action="store_true",
-                    help="run the doctored-executor mutation self-test")
+                    help="run the doctored-executor AND admission-"
+                         "mutant self-tests")
     ap.add_argument("--emit-corpus", metavar="DIR", default=None,
                     help="(re)generate the regression corpus into DIR")
     ap.add_argument("--max-states", type=int, default=None)
@@ -963,8 +1416,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     t0 = time.perf_counter()
     if args.self_test:
+        from agnes_tpu.analysis.admission_mc import self_test_admission
+
         mut = self_test(por=not args.no_por)
-        report = {"self_test": mut, "ok": True,
+        report = {"self_test": mut,
+                  "self_test_admission": self_test_admission(),
+                  "ok": True,
                   "seconds": round(time.perf_counter() - t0, 1)}
         print(json.dumps(report, sort_keys=True), flush=True)
         return 0
@@ -977,15 +1434,20 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     report = run_scope(args.scope, workers=args.workers,
                        por=not args.no_por, deadline_at=deadline_at,
-                       max_states=args.max_states)
+                       max_states=args.max_states,
+                       sym=not args.no_sym)
     from agnes_tpu.utils.metrics import (
+        MODELCHECK_ADMISSION_STATES,
         MODELCHECK_STATES_EXPLORED,
+        MODELCHECK_SYM_ORBIT_REDUCTION,
         MODELCHECK_VIOLATIONS,
     )
 
     report["metrics"] = {
         MODELCHECK_STATES_EXPLORED: report["states_explored"],
         MODELCHECK_VIOLATIONS: report["violations"],
+        MODELCHECK_SYM_ORBIT_REDUCTION: report["sym_orbit_reduction"],
+        MODELCHECK_ADMISSION_STATES: report["admission_states"],
     }
     report["deadline"] = {"source": deadline.source,
                           "budget_s": None if rem == float("inf")

@@ -24,14 +24,25 @@ The device side of the ABI is device/step.py's VotePhase/ExtEvent and
 the validator table from ValidatorSet.device_arrays().
 """
 
-from agnes_tpu.bridge.evidence import (  # noqa: F401
-    DeviceEvidence,
-    collect_device_evidence,
-    verify_evidence,
-)
-from agnes_tpu.bridge.ingest import VoteBatcher, WireVote  # noqa: F401
 from agnes_tpu.bridge.native_ingest import (  # noqa: F401
     NativeIngestLoop,
     pack_wire_votes,
 )
 from agnes_tpu.bridge.value_table import SlotMap, ValueTable  # noqa: F401
+
+# ingest (VoteBatcher densify -> device VotePhase) and evidence (the
+# slashing join over device flags) import jax at module top; the wire
+# codec / native loop / value table above are pure numpy+ctypes.
+# Resolving the jax-bearing members lazily keeps the admission path
+# and the pre-test model-checker gate jax-free (serve/__init__.py has
+# the same split).
+from agnes_tpu.utils.lazy import make_lazy_getattr  # noqa: E402
+
+__getattr__ = make_lazy_getattr(__name__, {
+    "DeviceEvidence": ("agnes_tpu.bridge.evidence", "DeviceEvidence"),
+    "collect_device_evidence": ("agnes_tpu.bridge.evidence",
+                                "collect_device_evidence"),
+    "verify_evidence": ("agnes_tpu.bridge.evidence", "verify_evidence"),
+    "VoteBatcher": ("agnes_tpu.bridge.ingest", "VoteBatcher"),
+    "WireVote": ("agnes_tpu.bridge.ingest", "WireVote"),
+}, globals())

@@ -29,10 +29,13 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-import jax.numpy as jnp
-
 from agnes_tpu.core.native_build import lib as _build_lib
-from agnes_tpu.device.step import VotePhase
+
+# jax + device.step are imported INSIDE build_phases (the only device-
+# boundary method): the wire codec (pack/unpack) and the loop's host
+# half must stay importable jax-free — the serve admission path and
+# the pre-test model-checker gate (analysis/admission_mc.py) depend
+# on it.
 
 REC_SIZE = 96
 
@@ -289,6 +292,10 @@ class NativeIngestLoop:
         """Stage -> (verify on device if signed) -> emit.  Returns
         [(phase, n_votes)] like VoteBatcher.build_phases; the phase
         arrays are zero-copy views into the C++ double buffer."""
+        import jax.numpy as jnp
+
+        from agnes_tpu.device.step import VotePhase
+
         L = _lib()
         self._used = True
         n = L.ag_ing_stage(self._h)

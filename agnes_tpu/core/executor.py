@@ -237,9 +237,7 @@ class ConsensusExecutor:
 
         self.height = start_height
         self.state = sm.State.new(start_height)
-        self.votes = VoteExecutor(height=start_height,
-                                  total_weight=vset.total_power,
-                                  edge_triggered=True)
+        self.votes = self._new_votes(start_height)
         self.wheel = TimerWheel()
         self.outbox: List[WireMessage] = []
         self.decisions: List[Decision] = []
@@ -259,6 +257,24 @@ class ConsensusExecutor:
         # the rotation cursor behind it is not clone-divergence-safe)
         self._proposer_frozen = False
         self._started = False
+
+    # -- tally construction / weighting (subclass seams) --------------------
+
+    def _new_votes(self, height: int) -> VoteExecutor:
+        """The per-height tally.  A seam so doctored executors (the
+        model checker's mutation registry, analysis/modelcheck.py) can
+        install a miscounting tally without copying the height-advance
+        logic."""
+        return VoteExecutor(height=height,
+                            total_weight=self.vset.total_power,
+                            edge_triggered=True)
+
+    def _vote_weight(self, v: Vote) -> int:
+        """Voting power an identified inbound vote counts with.  The
+        weight-blind mutant overrides this (and `_new_votes`) to count
+        heads instead of power — the committee-weight bug class the
+        quorum-cert monitor exists to catch."""
+        return self.vset[v.validator].voting_power
 
     # -- proposer schedule --------------------------------------------------
 
@@ -366,7 +382,7 @@ class ConsensusExecutor:
                             self.height, v.round, int(v.typ), v.value),
                         v.signature):
                     return  # forged or unsigned: never reaches the tally
-            weight = self.vset[v.validator].voting_power
+            weight = self._vote_weight(v)
         elif self.verify_signatures:
             # identity-free votes are a test-only surface (reference
             # parity in the pure core); a verifying executor must drop
@@ -464,9 +480,7 @@ class ConsensusExecutor:
                              if e not in seen)
         self.height += 1
         self.state = sm.State.new(self.height)
-        self.votes = VoteExecutor(height=self.height,
-                                  total_weight=self.vset.total_power,
-                                  edge_triggered=True)
+        self.votes = self._new_votes(self.height)
         self._enter_round(0)
 
     # -- evidence ------------------------------------------------------------
@@ -556,7 +570,7 @@ class ConsensusExecutor:
         n._started = self._started
         return n
 
-    def canonical_state(self) -> tuple:
+    def canonical_state(self, perm: Optional[List[int]] = None) -> tuple:
         """A canonical, hashable, int-only summary of everything that
         can influence this node's FUTURE behavior — the model checker's
         dedup key.  Deliberately excluded: outbox/decisions history
@@ -565,9 +579,19 @@ class ConsensusExecutor:
         wheel deadlines and dead timers (the asynchronous abstraction:
         any pending live timer may fire at any point, so only the SET
         of live (round, step) timers matters).  None-valued vote
-        values encode as -2 (NIL_ID is -1, real ids >= 0)."""
+        values encode as -2 (NIL_ID is -1, real ids >= 0).
+
+        `perm` (old validator index -> new index) relabels every
+        embedded validator index — the symmetry-reduction surface
+        (harness/simulator.Network.mc_canonical carries the soundness
+        contract).  Voting-power weights relabel for free: they live
+        in value-keyed buckets, and the group construction only
+        permutes equal-power validators."""
         def _v(x):
             return -2 if x is None else x
+
+        def _p(x):
+            return x if perm is None else perm[x]
 
         hv = self.votes.votes
         rounds = []
@@ -579,12 +603,12 @@ class ConsensusExecutor:
                 tuple(sorted(rv.prevotes.weights.items())),
                 rv.precommits.nil,
                 tuple(sorted(rv.precommits.weights.items())),
-                tuple(sorted((val, int(t), _v(v), w)
+                tuple(sorted((_p(val), int(t), _v(v), w)
                              for (val, t), (v, w) in rv.seen.items())),
                 tuple(sorted((int(t), w)
                              for t, w in rv._anon_weight.items())),
-                tuple(sorted((e.validator, int(e.typ), _v(e.first_value),
-                              _v(e.second_value))
+                tuple(sorted((_p(e.validator), int(e.typ),
+                              _v(e.first_value), _v(e.second_value))
                              for e in rv.equivocations)),
             ))
         lock = (self.state.locked.round, self.state.locked.value) \
@@ -603,7 +627,7 @@ class ConsensusExecutor:
             tuple(sorted(self.votes._skipped)),
             tuple(sorted((h, d.round, d.value)
                          for h, d in self.decided.items())),
-            tuple(sorted((e.height, e.round, int(e.typ), e.validator,
+            tuple(sorted((e.height, e.round, int(e.typ), _p(e.validator),
                           _v(e.first_value), _v(e.second_value))
                          for e in self.evidence)),
             tuple(sorted({(t.round, int(t.step))

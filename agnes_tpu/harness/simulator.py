@@ -481,40 +481,84 @@ class Network:
         return net
 
     @staticmethod
-    def _canon_msg(m) -> tuple:
+    def _canon_msg(m, perm: Optional[Sequence[int]] = None) -> tuple:
         if isinstance(m, Vote):
+            v = m.validator
+            if v is not None and perm is not None:
+                v = perm[v]
             return (0, int(m.typ), m.round,
                     -2 if m.value is None else m.value,
-                    -2 if m.validator is None else m.validator,
+                    -2 if v is None else v,
                     -2 if m.height is None else m.height)
         if isinstance(m, WireProposal):
-            return (1, m.height, m.round, m.value, m.pol_round, m.proposer)
+            p = m.proposer if perm is None else perm[m.proposer]
+            return (1, m.height, m.round, m.value, m.pol_round, p)
         raise TypeError(f"uncanonicalizable channel message {m!r}")
 
-    def mc_canonical(self) -> tuple:
+    def mc_canonical(self, perm: Optional[Sequence[int]] = None) -> tuple:
         """Canonical, int-only form of the global state: node states
         (executor.canonical_state), channel contents in per-link FIFO
         order, partition status, and the monitor trackers (included so
         two paths that agree on executor state but disagree on what
-        the monitors should expect never merge)."""
+        the monitors should expect never merge).
+
+        `perm` (old index -> new index) relabels the nodes — the
+        symmetry-reduction surface (analysis/modelcheck.Symmetry):
+        node i's state lands at position perm[i] with every embedded
+        validator index rewritten, channel (i, j) becomes
+        (perm[i], perm[j]).  Only sound for permutations that are true
+        automorphisms of the network (equal behavior/power, proposer
+        slots fixed, partition groups preserved) — the caller's
+        contract, enforced by the group construction there."""
         assert self._step_mode
+        if perm is None:
+            nodes = tuple(nd.canonical_state() for nd in self.nodes)
+            chans = tuple((i, j, tuple(self._canon_msg(m) for m in q))
+                          for (i, j), q in sorted(self._channels.items())
+                          if q)
+            group = None if self._group is None else tuple(self._group)
+            ev = tuple(tuple(sorted(s)) for s in self._expected_ev)
+        else:
+            by_pos = [None] * self.n
+            for i, nd in enumerate(self.nodes):
+                by_pos[perm[i]] = nd.canonical_state(perm)
+            nodes = tuple(by_pos)
+            chans = tuple(sorted(
+                (perm[i], perm[j],
+                 tuple(self._canon_msg(m, perm) for m in q))
+                for (i, j), q in self._channels.items() if q))
+            if self._group is None:
+                group = None
+            else:
+                g = [0] * self.n
+                for i in range(self.n):
+                    g[perm[i]] = self._group[i]
+                group = tuple(g)
+            ev_pos: List[tuple] = [()] * self.n
+            for i, s in enumerate(self._expected_ev):
+                ev_pos[perm[i]] = tuple(sorted(
+                    (perm[val], h, r, t) for (val, h, r, t) in s))
+            ev = tuple(ev_pos)
         return (
-            tuple(nd.canonical_state() for nd in self.nodes),
-            tuple((i, j, tuple(self._canon_msg(m) for m in q))
-                  for (i, j), q in sorted(self._channels.items()) if q),
-            None if self._group is None else tuple(self._group),
+            nodes,
+            chans,
+            group,
             self._partition_cycles,
             tuple(sorted((h, tuple(sorted(v)))
                          for h, v in self._proposed.items())),
-            tuple(tuple(sorted(s)) for s in self._expected_ev),
+            ev,
         )
 
-    def mc_digest(self) -> bytes:
+    def mc_digest(self, perm: Optional[Sequence[int]] = None) -> bytes:
         """16-byte stable digest of mc_canonical — the dedup key.
-        hashlib over the repr (ints/tuples only: deterministic across
-        processes and runs) rather than builtin hash: no PYTHONHASHSEED
-        sensitivity, negligible collision odds at corpus scale."""
+        The canonical form is pure ints/None/tuples with every
+        container SORTED, serialized through `marshal` (a canonical
+        byte encoding of exactly those types): no repr-format
+        dependence, no dict-insertion-order sensitivity, no
+        PYTHONHASHSEED sensitivity; negligible collision odds at
+        corpus scale."""
         import hashlib
+        import marshal
 
-        return hashlib.blake2b(repr(self.mc_canonical()).encode(),
+        return hashlib.blake2b(marshal.dumps(self.mc_canonical(perm), 2),
                                digest_size=16).digest()

@@ -46,7 +46,6 @@ pre-populate the cache.
 
 from agnes_tpu.serve.batcher import MicroBatcher, ShapeLadder  # noqa: F401
 from agnes_tpu.serve.cache import VerifiedCache  # noqa: F401
-from agnes_tpu.serve.pipeline import ServePipeline  # noqa: F401
 from agnes_tpu.serve.queue import (  # noqa: F401
     AdmissionQueue,
     AdmitResult,
@@ -55,8 +54,21 @@ from agnes_tpu.serve.queue import (  # noqa: F401
     REJECT_NEWEST,
     WireColumns,
 )
-from agnes_tpu.serve.service import Decision, VoteService  # noqa: F401
-from agnes_tpu.serve.threaded import (  # noqa: F401
-    ThreadedVoteService,
-    threaded_service,
-)
+
+# The dispatch-side members (pipeline/service/threaded) import jax at
+# module top; the admission side (queue/batcher/cache) is pure
+# numpy/stdlib and is what the jax-free pre-test gate consumes
+# (analysis/admission_mc.py, the harness/__init__ lazy-DeviceDriver
+# pattern) — resolve them on first attribute access instead of at
+# package import.
+from agnes_tpu.utils.lazy import make_lazy_getattr  # noqa: E402
+
+__getattr__ = make_lazy_getattr(__name__, {
+    "ServePipeline": ("agnes_tpu.serve.pipeline", "ServePipeline"),
+    "Decision": ("agnes_tpu.serve.service", "Decision"),
+    "VoteService": ("agnes_tpu.serve.service", "VoteService"),
+    "ThreadedVoteService": ("agnes_tpu.serve.threaded",
+                            "ThreadedVoteService"),
+    "threaded_service": ("agnes_tpu.serve.threaded",
+                         "threaded_service"),
+}, globals())

@@ -188,6 +188,30 @@ class VerifiedCache:
         with self._mu:
             self.counters["insert_skipped_noverdict"] += 1
 
+    # -- state-space surface (analysis/admission_mc.py) -----------------------
+
+    def mc_clone(self) -> "VerifiedCache":
+        """Copy for state-space branching (the admission model
+        checker): fresh leaf mutex, duplicated entry map (LRU order
+        preserved) and pruning index."""
+        c = VerifiedCache(self.max_bytes)
+        with self._mu:
+            c._entries = collections.OrderedDict(self._entries)
+            c._by_inst = {i: {h: set(s) for h, s in hts.items()}
+                          for i, hts in self._by_inst.items()}
+            c.counters = dict(self.counters)
+            c._last_prune = None if self._last_prune is None \
+                else self._last_prune.copy()
+        return c
+
+    def mc_canonical(self) -> tuple:
+        """Canonical form: entries in LRU order (recency is behavior —
+        it picks eviction victims).  Counters are monotone history,
+        not behavior, and stay out (see AdmissionQueue.mc_canonical)."""
+        with self._mu:
+            return tuple((k, v[0], v[1])
+                         for k, v in self._entries.items())
+
     # -- pruning --------------------------------------------------------------
 
     def prune_decided(self, heights: np.ndarray) -> int:

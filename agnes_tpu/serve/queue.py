@@ -336,6 +336,47 @@ class AdmissionQueue:
                            rejected_fairness, malformed, evicted,
                            pre_verified)
 
+    # -- state-space surface (analysis/admission_mc.py) ----------------------
+
+    def mc_clone(self) -> "AdmissionQueue":
+        """O(live state) copy for state-space branching (the serve-
+        plane admission model checker).  `_Chunk` objects are never
+        mutated after construction (drain REPLACES the head chunk,
+        split builds new ones), so the clone shares them; `cache` is
+        shared too — the model re-points it at its own cache clone.
+        Subclasses adding mutable state must extend this."""
+        q = type(self).__new__(type(self))
+        q.I = self.I
+        q.capacity = self.capacity
+        q.instance_cap = self.instance_cap
+        q.policy = self.policy
+        q.cache = self.cache
+        q._clock = self._clock
+        q._chunks = collections.deque(self._chunks)
+        q.depth = self.depth
+        q._inst_counts = self._inst_counts.copy()
+        q.counters = dict(self.counters)
+        return q
+
+    def mc_canonical(self) -> tuple:
+        """Canonical int-only form of the queued content — the model
+        checker's dedup-key contribution.  Rows in FIFO order;
+        signature bytes are excluded (the model's records are
+        unsigned; identity lives in the value column).  Counters are
+        deliberately NOT part of the canonical form: they are monotone
+        history (two states with identical content but different
+        reject histories behave identically), and including them would
+        block every state merge the explorer depends on."""
+        rows = []
+        for c in self._chunks:
+            inst, val, hts, rnd, typ, value = c.cols[:6]
+            ver = c.cols[7]
+            for j in range(len(c)):
+                rows.append((int(inst[j]), int(val[j]), int(hts[j]),
+                             int(rnd[j]), int(typ[j]), int(value[j]),
+                             int(ver[j])))
+        return (tuple(rows), self.depth)
+
     # -- drain ---------------------------------------------------------------
 
     def _pop(self, n: int, count_drained: bool = True) -> List[_Chunk]:

@@ -14,16 +14,21 @@ reference, present here):
                   mesh shape, timeouts, dtypes) + CLI parsing.
 """
 
-from agnes_tpu.utils.checkpoint import (  # noqa: F401
-    load_batcher,
-    load_driver,
-    load_executor_into,
-    load_native_loop,
-    save_batcher,
-    save_driver,
-    save_executor,
-    save_native_loop,
-)
 from agnes_tpu.utils.config import RunConfig  # noqa: F401
 from agnes_tpu.utils.metrics import Metrics  # noqa: F401
 from agnes_tpu.utils.tracing import Tracer, span  # noqa: F401
+
+# checkpoint.py imports jax at module top (device snapshot/resume);
+# budget/metrics/tracing/config are stdlib+numpy.  Resolving the
+# checkpoint members lazily keeps `utils.budget` importable jax-free —
+# the model-checker gate's deadline discovery and the serve admission
+# path both ride on that (serve/__init__.py has the same split).
+from agnes_tpu.utils.lazy import make_lazy_getattr  # noqa: E402
+
+__getattr__ = make_lazy_getattr(
+    __name__,
+    {name: ("agnes_tpu.utils.checkpoint", name)
+     for name in ("load_batcher", "load_driver", "load_executor_into",
+                  "load_native_loop", "save_batcher", "save_driver",
+                  "save_executor", "save_native_loop")},
+    globals())

@@ -1,0 +1,37 @@
+"""Lazy package re-exports (PEP 562) — one implementation.
+
+Several packages split their public surface into a numpy/stdlib half
+(eager, importable jax-free — the serve admission path and the
+pre-test model-checker gate depend on that) and a jax-bearing half
+(resolved on first attribute access): agnes_tpu.serve,
+agnes_tpu.bridge, agnes_tpu.utils.  Each builds its module-level
+``__getattr__`` with :func:`make_lazy_getattr` instead of hand-rolling
+the same resolver three times.
+
+Pure stdlib — this module must never import jax.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+
+def make_lazy_getattr(module_name: str,
+                      mapping: Dict[str, Tuple[str, str]],
+                      module_globals: dict) -> Callable[[str], object]:
+    """A module ``__getattr__`` resolving `mapping` entries
+    (attr -> (module, name)) on first access and caching the result in
+    `module_globals` (one resolution per process)."""
+
+    def __getattr__(name: str):
+        entry = mapping.get(name)
+        if entry is None:
+            raise AttributeError(
+                f"module {module_name!r} has no attribute {name!r}")
+        import importlib
+
+        value = getattr(importlib.import_module(entry[0]), entry[1])
+        module_globals[name] = value
+        return value
+
+    return __getattr__

@@ -131,6 +131,14 @@ RETRACE_UNEXPECTED = "retrace_unexpected"
 #: semantic gate ran and ran clean — the PR 4 pattern.
 MODELCHECK_STATES_EXPLORED = "modelcheck_states_explored"
 MODELCHECK_VIOLATIONS = "modelcheck_violations"
+#: ISSUE 7 additions: the measured orbit reduction of the
+#: symmetry-reduced smoke sweep against PR 6's unreduced visit counts
+#: on the shared configs (modelcheck.SYM_BASELINE_STATES; -1 = not
+#: measured, e.g. --no-sym or a deadline-sentinel partial), and the
+#: serve-plane admission model's distinct-state total
+#: (analysis/admission_mc.py)
+MODELCHECK_SYM_ORBIT_REDUCTION = "modelcheck_sym_orbit_reduction"
+MODELCHECK_ADMISSION_STATES = "modelcheck_admission_states"
 VOTES_INGESTED = "votes_ingested"
 VOTES_VERIFIED = "votes_verified"
 THRESHOLDS_CROSSED = "thresholds_crossed"

@@ -131,7 +131,14 @@ _ANALYSIS: dict = {"analysis_entries_audited": 0,
                    "modelcheck_states_explored": int(os.environ.get(
                        "AGNES_MODELCHECK_STATES_EXPLORED", -1)),
                    "modelcheck_violations": int(os.environ.get(
-                       "AGNES_MODELCHECK_VIOLATIONS", -1))}
+                       "AGNES_MODELCHECK_VIOLATIONS", -1)),
+                   # ISSUE 7: measured symmetry orbit reduction vs the
+                   # PR 6 unreduced baseline, and the serve-plane
+                   # admission model's state total (-1 = gate not run)
+                   "modelcheck_sym_orbit_reduction": float(os.environ.get(
+                       "AGNES_MODELCHECK_SYM_ORBIT_REDUCTION", -1)),
+                   "modelcheck_admission_states": int(os.environ.get(
+                       "AGNES_MODELCHECK_ADMISSION_STATES", -1))}
 
 
 def _harvest_audit(driver) -> None:

@@ -1,20 +1,26 @@
 #!/usr/bin/env python
 """agnes_modelcheck: exhaustive bounded model checking of the
-consensus core (agnes_tpu/analysis/modelcheck.py, ISSUE 6).
+consensus core (agnes_tpu/analysis/modelcheck.py, ISSUE 6) and the
+serve-plane admission layer (agnes_tpu/analysis/admission_mc.py,
+ISSUE 7).
 
 Explores EVERY delivery/timeout/partition schedule of the host plane
-within a bounded scope — N nodes x fault assignment x depth x rounds —
-with canonical-state dedup and partial-order reduction, checking the
-spec-level monitors (agreement, validity, quorum certificates,
-monotonicity, evidence completeness) on every reachable state.  Pure
-CPU, zero jax imports, ZERO XLA compiles: it shares the pre-test ci.sh
-gate slot with agnes_lint.
+within a bounded scope — N nodes x fault assignment x weight vector x
+depth x rounds — with canonical-state dedup, partial-order reduction,
+and SYMMETRY reduction (least-orbit relabeling of interchangeable
+honest nodes), checking the spec-level monitors (agreement, validity,
+weighted quorum certificates, monotonicity, evidence completeness) on
+every reachable state; the admission shards drive the real
+AdmissionQueue/VerifiedCache under conservation/starvation/P-bound/
+purity monitors.  Pure CPU, zero jax imports, ZERO XLA compiles: it
+shares the pre-test ci.sh gate slot with agnes_lint.
 
 Usage:
   scripts/agnes_modelcheck.py --scope smoke --json   # the ci.sh gate
   scripts/agnes_modelcheck.py --scope tiny           # seconds-fast
   scripts/agnes_modelcheck.py --self-test            # mutation drill
-  scripts/agnes_modelcheck.py --scope smoke --no-por # debug aid
+  scripts/agnes_modelcheck.py --scope smoke --no-por # debug aids
+  scripts/agnes_modelcheck.py --scope smoke --no-sym
 
 The CLI discovers its enclosing wall budget (AGNES_MODELCHECK_DEADLINE_S
 or an ancestor `timeout N`) and stops cleanly with complete=false

@@ -79,6 +79,7 @@ _WRAPPER = "test_zz_heavy_isolated.py"
 # degrades a timeout to "expensive tail cut", not "most of the suite
 # never ran".  Files keep their internal order; sort is stable.
 _CHEAP = (          # no XLA compiles (stdlib / numpy / ctypes / refs)
+    "test_admission_mc.py",
     "test_analysis.py",
     "test_bench_deadline.py", "test_budget.py", "test_capi_fuzz.py",
     "test_ed25519_ref.py", "test_executor.py", "test_modelcheck.py",

@@ -1,0 +1,307 @@
+"""Serve-plane admission model checker (analysis/admission_mc.py,
+ISSUE 7) — model soundness, mutation detection, corpus determinism,
+and the replay of admission schedules through the REAL ServePipeline
+with a stubbed dispatch (the PR 4/5 registry-stub pattern).
+
+The model itself is pure numpy/stdlib with ZERO jax imports (asserted
+below); the serve-replay half imports jax for driver/batcher
+construction but performs ZERO XLA compiles (dispatch stubbed), so the
+file sits in conftest._CHEAP.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from agnes_tpu.analysis import admission_mc as am
+from agnes_tpu.analysis import modelcheck as mc
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "corpus",
+                          "admission")
+
+
+# ---------------------------------------------------------------------------
+# zero-jax guarantee (the ci.sh gate slot depends on it)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_model_is_jax_free():
+    code = (
+        "import sys\n"
+        "from agnes_tpu.analysis import admission_mc as am\n"
+        "rep = am.explore_admission(am.AdmissionMCConfig("
+        "name='t', depth=5))\n"
+        "assert rep.states > 10 and not rep.violations\n"
+        "assert 'jax' not in sys.modules, 'jax leaked into the model'\n"
+        "print('JAXFREE-OK')\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0 and "JAXFREE-OK" in out.stdout, (
+        out.stdout, out.stderr)
+
+
+# ---------------------------------------------------------------------------
+# honest model: exhaustive-clean, deterministic, conserving
+# ---------------------------------------------------------------------------
+
+
+def test_tiny_scope_explores_clean_and_deterministic():
+    cfg = am.ADMISSION_TINY[0]
+    a = am.explore_admission(cfg, collect_digests=True)
+    b = am.explore_admission(cfg, collect_digests=True)
+    assert a.complete and not a.violations
+    assert a.states > 1000
+    assert (a.states, a.transitions, a.digests) == \
+        (b.states, b.transitions, b.digests)
+
+
+def test_drop_oldest_evictions_stay_conserved():
+    """drop_oldest sheds admitted records — the conservation monitor
+    must count them as counted drops, not losses."""
+    cfg = am.AdmissionMCConfig(
+        name="evict", capacity=2, policy="drop_oldest", depth=6,
+        max_copies=2, target=1,
+        templates=((0, 0, 0, 0), (1, 1, 0, 0), (1, 2, 0, 0)))
+    sys_, viols = am.run_admission_with_monitors(
+        cfg, [("s", 0), ("s", 1), ("s", 2), ("b",)])
+    assert not viols
+    assert sum(sys_.evicted) == 1        # capacity 2, third submit shed
+    assert sys_.queue.counters["evicted"] == 1
+
+
+def test_held_window_reentry_and_split_purity():
+    """The held-vote window milestone by hand: a future-round record
+    holds through pumps, re-enters on ("w",), and the dedup round trip
+    dispatches identical bytes unsigned — with every unsigned row a
+    cache hit."""
+    cfg = am.ADMISSION_SMOKE[0]
+    sched = [("s", 3), ("b",), ("b",)]     # held: round 1, window 0
+    sys_, viols = am.run_admission_with_monitors(cfg, sched)
+    assert not viols
+    assert sys_.dispatched[3] == 0 and len(sys_.pending) == 1
+    sys_.run_schedule([("w",), ("b",)])
+    assert sys_.dispatched[3] == 1
+    # dedup round trip
+    sys2, viols2 = am.run_admission_with_monitors(
+        cfg, [("s", 0), ("s", 1), ("b",), ("v",),
+              ("s", 0), ("s", 1), ("b",)])
+    assert not viols2
+    unsigned = [(p, rows) for p, signed, _c, rows in sys2.dispatch_log
+                if not signed]
+    assert unsigned, "cache hits should ride an unsigned dispatch"
+    for p, rows in unsigned:
+        assert p in (2, 3)
+        assert all(ver for _k, ver in rows)
+
+
+# ---------------------------------------------------------------------------
+# mutation self-test: every monitor has teeth
+# ---------------------------------------------------------------------------
+
+
+def test_admission_self_test_end_to_end():
+    out = am.self_test_admission()
+    assert set(out) == set(am.ADMISSION_MUTANTS)
+    for name, r in out.items():
+        assert r["minimized_len"] <= r["schedule_len"]
+        ce = r["counterexample"]
+        assert ce["schedule"], name
+        # 1-minimality of the lossy counterexample is cheap to prove
+    name = "lose_drained_record"
+    sys_cls, prop, cfg = am.ADMISSION_MUTANTS[name]
+    ce = out[name]["counterexample"]
+    small = [am.AdmissionSystem.action_from_json(a)
+             for a in ce["schedule"]]
+    for i in range(len(small)):
+        trial = small[:i] + small[i + 1:]
+        assert not trial or not am.admission_reproduces(
+            cfg, trial, prop, system_cls=sys_cls)
+
+
+def test_starvation_monitor_catches_lifo_queue():
+    sys_cls, prop, cfg = am.ADMISSION_MUTANTS["starve_oldest_record"]
+    rep = am.explore_admission(cfg, system_cls=sys_cls)
+    caught = [c for c in rep.violations if c.violation.property == prop]
+    assert caught, f"missed starvation in {rep.states} states"
+    small = am.minimize_admission(cfg, caught[0].schedule, prop,
+                                  system_cls=sys_cls)
+    assert am.admission_reproduces(cfg, small, prop,
+                                   system_cls=sys_cls)
+    _, honest = am.run_admission_with_monitors(cfg, small)
+    assert not honest
+
+
+# ---------------------------------------------------------------------------
+# regression corpus (tests/corpus/admission/*.json)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_corpus_exists_and_covers():
+    entries = mc.load_corpus(CORPUS_DIR)
+    names = {e["name"] for e in entries}
+    assert len(entries) >= 6, names
+    assert {n for n in names if n.startswith("adm_mut_")} == {
+        f"adm_mut_{m}" for m in am.ADMISSION_MUTANTS}
+    assert "adm_dedup_roundtrip" in names
+    assert "adm_held_window_flush" in names
+    assert all(e["kind"] == "admission" for e in entries)
+
+
+@pytest.mark.parametrize("entry", mc.load_corpus(CORPUS_DIR),
+                         ids=lambda e: e["name"])
+def test_admission_corpus_replays_deterministically(entry):
+    sys_, _ = am.replay_admission_entry(entry)
+    sys2, _ = am.replay_admission_entry(entry)
+    assert sys_.mc_digest() == sys2.mc_digest()
+
+
+# ---------------------------------------------------------------------------
+# serve-plane replay: the model's schedules through the REAL
+# ServePipeline (stubbed dispatch — zero XLA compiles)
+# ---------------------------------------------------------------------------
+
+
+def _real_service(cfg: am.AdmissionMCConfig):
+    """A VoteService assembled from the REAL queue/batcher/pipeline
+    with step_async stubbed (test_serve_cache.py pattern) and a
+    1-round batcher window so the model's held-vote semantics map
+    onto the real hold-back path."""
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        validator_pubkeys,
+    )
+    from agnes_tpu.serve import ShapeLadder, VerifiedCache, VoteService
+
+    from agnes_tpu.crypto.ed25519_ref import verify as ref_verify
+
+    I = cfg.n_instances
+    V = max(t[1] for t in cfg.templates) + 1
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bat = VoteBatcher(I, V, n_slots=4, n_rounds=1)
+
+    def host_verify(b, pubkeys):
+        # the real batcher batch-verifies host-fallback subsets on the
+        # JAX plane — a multi-minute Ed25519 trace on this box and a
+        # compile this zero-compile file must not pay.  The model's
+        # records carry REAL ref-signer signatures, so verify them
+        # with the pure-python ref instead: same verdicts, no XLA.
+        from agnes_tpu.crypto.encoding import vote_signing_bytes
+
+        out = np.zeros(len(b), bool)
+        for j in range(len(b)):
+            msg = vote_signing_bytes(
+                int(b.height[j]), int(b.round[j]), int(b.typ[j]),
+                None if int(b.value[j]) < 0 else int(b.value[j]))
+            pk = bytes(np.asarray(pubkeys[int(b.validator[j])],
+                                  np.uint8))
+            out[j] = ref_verify(pk, msg, bytes(b.signature[j]))
+        return out
+
+    bat._verify = host_verify
+    window = {"base": np.zeros(I, np.int64)}
+    svc = VoteService(
+        d, bat, validator_pubkeys(deterministic_seeds(V)),
+        dedup_cache=VerifiedCache() if cfg.dedup else None,
+        capacity=cfg.capacity, instance_cap=cfg.instance_cap,
+        overload_policy=cfg.policy, target_votes=cfg.target,
+        max_delay_s=0.0,
+        ladder=ShapeLadder.plan(I, V, min_rung=4),
+        window_predictor=lambda: (window["base"].copy(),
+                                  np.zeros(I, np.int64)))
+    dispatches = []
+
+    def stub(phases, lanes=None, exts=None, donate=True):
+        dispatches.append(
+            (len(phases), lanes is None,
+             tuple(np.asarray(p.slots).tobytes() for p in phases)))
+        d.last_step_rejects = (None if lanes is None
+                               else np.zeros((), np.int64))
+
+    d.step_async = stub
+    return svc, window, dispatches
+
+
+def _replay_on_serve(cfg: am.AdmissionMCConfig, actions):
+    """Drive the real serve plane through an admission schedule:
+    submit/pump/settle/window map onto the production calls."""
+    sys_model = am.AdmissionSystem(cfg)      # for the wire bytes
+    svc, window, dispatches = _real_service(cfg)
+    for a in actions:
+        act = am.AdmissionSystem.action_from_json(a) \
+            if a and a[0] in am._ACT_CODES else tuple(a)
+        if act[0] == "s":
+            svc.submit(sys_model._wire[act[1]])
+        elif act[0] == "b":
+            batch = svc._close_batch()
+            svc._pump_batch(batch)
+            svc.pipeline.dispatch_staged()
+        elif act[0] == "v":
+            svc.poll_decisions()
+        elif act[0] == "w":
+            window["base"][:] = window["base"] + 1
+    return svc, dispatches
+
+
+@pytest.mark.parametrize(
+    "entry",
+    [e for e in mc.load_corpus(CORPUS_DIR)],
+    ids=lambda e: e["name"])
+def test_admission_corpus_replays_through_real_serve_plane(entry):
+    """Every admission corpus schedule drives the REAL pipeline
+    bit-identically across two runs, respects the P in {2, 3} bound
+    on every stubbed dispatch, keeps admitted-vote conservation, and
+    rides unsigned entries only for cache-verified traffic."""
+    cfg = am.AdmissionMCConfig.from_json(entry["config"])
+    svc, disp1 = _replay_on_serve(cfg, entry["actions"])
+    _svc2, disp2 = _replay_on_serve(cfg, entry["actions"])
+    assert disp1 == disp2, "serve replay not bit-identical"
+    # the warmed-shape P bound applies to the signed-lane and
+    # preverified entries; host-fallback builds (past-round spill
+    # after a window advance) legitimately dispatch other P on the
+    # host-verified path — scope the assertion the way the production
+    # warmup does
+    if svc.pipeline.host_fallback_builds == 0:
+        for n_phases, unsigned, _blobs in disp1:
+            assert n_phases in (2, 3), (entry["name"], n_phases)
+    # conservation on the real plane: every admitted vote is either
+    # dispatched, still queued, pending, or held — no silent loss.
+    # Votes the batcher routed to its past-round HOST tally are
+    # consumed there (and deduplicated), so exact equality holds only
+    # when that path stayed empty.
+    q = svc.queue.counters
+    admitted = q["admitted"]
+    accounted = (svc.pipeline.dispatched_votes + svc.queue.depth
+                 + svc.batcher.pending_votes + svc.batcher.held_votes)
+    if not svc.batcher._host_tally \
+            and svc.batcher.rejected_signature == 0:
+        assert admitted == accounted, (entry["name"], admitted,
+                                       accounted, dict(q))
+    else:
+        assert admitted >= accounted, (entry["name"], admitted,
+                                       accounted)
+    assert svc.batcher.rejected_malformed == 0, entry["name"]
+    # absent host fallbacks, unsigned dispatches exist only where the
+    # pipeline dispatched pre-verified rows (the split-rung purity
+    # story); host-fallback builds also ride the unsigned entries but
+    # their rows were HOST-verified, which is its own covered path
+    if any(u for _p, u, _b in disp1) \
+            and svc.pipeline.host_fallback_builds == 0:
+        assert svc.pipeline.preverified_votes > 0
+        assert svc.cache is not None and svc.cache.counters["hits"] > 0
+
+
+def test_serve_replay_dedup_roundtrip_goes_unsigned():
+    """The milestone in the flesh: fresh bytes dispatch signed; after
+    settle, identical bytes dispatch UNSIGNED on the real pipeline."""
+    entry = next(e for e in mc.load_corpus(CORPUS_DIR)
+                 if e["name"] == "adm_dedup_roundtrip")
+    cfg = am.AdmissionMCConfig.from_json(entry["config"])
+    _svc, disp = _replay_on_serve(cfg, entry["actions"])
+    assert any(unsigned for _p, unsigned, _b in disp)
+    assert any(not unsigned for _p, unsigned, _b in disp)

@@ -99,6 +99,185 @@ def test_run_schedule_skips_unenabled_actions():
 
 
 # ---------------------------------------------------------------------------
+# digest hardening (ISSUE 7 satellite): canonical encoding, not repr
+# ---------------------------------------------------------------------------
+
+
+def test_digest_insensitive_to_container_insertion_order():
+    """mc_digest must hash a SORTED canonical encoding: rebuilding the
+    network's dicts in permuted insertion order (channels, proposed
+    values, tally rounds/weights) must not change the digest."""
+    cfg = mc.MCConfig(name="perm", depth=0, max_round=2)
+    net, _sched = _walk(cfg, seed=5, steps=50)
+    d0 = net.mc_digest()
+    # permute every dict the canonical form walks
+    net._channels = dict(reversed(list(net._channels.items())))
+    net._proposed = {h: set(v) for h, v in
+                     reversed(list(net._proposed.items()))}
+    for nd in net.nodes:
+        hv = nd.votes.votes
+        hv.rounds = dict(reversed(list(hv.rounds.items())))
+        for rv in hv.rounds.values():
+            rv.prevotes.weights = dict(
+                reversed(list(rv.prevotes.weights.items())))
+            rv.seen = dict(reversed(list(rv.seen.items())))
+    assert net.mc_digest() == d0
+    # and across independent constructions of the same state
+    net2 = mc.build_network(cfg)
+    net2.run_schedule(_sched)
+    assert net2.mc_digest() == d0
+
+
+# ---------------------------------------------------------------------------
+# symmetry reduction (ISSUE 7 tentpole): orbit equivalence, caps, POR
+# composition
+# ---------------------------------------------------------------------------
+
+
+SYM_CONFIGS = (
+    mc.MCConfig(name="sym_honest", depth=6, max_round=1),
+    mc.MCConfig(name="sym_part", depth=5, max_round=1,
+                partition=((0, 1), (2, 3))),
+    mc.MCConfig(name="sym_n7", n=7, depth=3, max_round=1,
+                behaviors=("honest",) * 7),
+)
+
+
+@pytest.mark.parametrize("cfg", SYM_CONFIGS, ids=lambda c: c.name)
+def test_symmetry_reaches_identical_orbit_set(cfg):
+    """The reduced search must visit EXACTLY the canonical orbits of
+    the full search — fewer states, same coverage (and both clean)."""
+    a = mc.explore(cfg, sym=True, por=True, collect_digests=True)
+    b = mc.explore(cfg, sym=False, por=True, collect_orbit_digests=True)
+    assert a.complete and b.complete
+    assert a.sym_perms > 1
+    assert a.digests == b.orbit_digests
+    assert a.states < b.states              # the reduction is real
+    assert a.states == len(b.orbit_digests)
+    assert not a.violations and not b.violations
+
+
+def test_symmetry_exploration_is_deterministic():
+    cfg = mc.MCConfig(name="sym_det", depth=5, max_round=1)
+    a = mc.explore(cfg, sym=True, collect_digests=True)
+    b = mc.explore(cfg, sym=True, collect_digests=True)
+    assert (a.states, a.transitions, a.digests) == \
+        (b.states, b.transitions, b.digests)
+
+
+def test_symmetry_group_shape():
+    """n=4 equal-power honest: proposer slots pin nodes {0, 1}, nodes
+    {2, 3} swap (|G| = 2).  n=7 at a depth below the decision bound:
+    only height-0 proposers {0, 1} pin, five nodes permute (capped at
+    24 perms).  Weighted n4: the asymmetric rotation pins everything."""
+    s4 = mc.build_symmetry(mc.MCConfig(name="g4", depth=10, max_round=1))
+    assert len(s4.perms) == 2 and s4.h_cap == 1
+    assert s4.perms[1] == (0, 1, 3, 2)
+    s7 = mc.build_symmetry(mc.MCConfig(
+        name="g7", n=7, depth=5, max_round=1,
+        behaviors=("honest",) * 7))
+    assert len(s7.perms) == 24 and s7.h_cap == 0
+    sw = mc.build_symmetry(mc.MCConfig(
+        name="gw", depth=10, max_round=1, powers=(1, 1, 1, 3)))
+    assert len(sw.perms) == 1
+
+
+def test_symmetry_cap_tripwire_fires_loud(monkeypatch):
+    """If a state escapes the envelope the group was built for, the
+    exploration must RAISE (merges would be unsound), not silently
+    report reduced numbers."""
+    import dataclasses as dc
+
+    cfg = mc.MCConfig(name="cap", depth=4, max_round=1)
+    real = mc.build_symmetry
+
+    def doctored(c, executor_cls=None, max_perms=24):
+        return dc.replace(real(c, executor_cls, max_perms), h_cap=-1)
+
+    monkeypatch.setattr(mc, "build_symmetry", doctored)
+    with pytest.raises(mc.SymmetryCapError):
+        mc.explore(cfg, sym=True)
+
+
+def test_por_x_symmetry_flags_same_violations_as_full():
+    """Composition soundness on the mutant configs: POR x symmetry
+    must flag the same property as the full (no-POR, no-sym)
+    exploration, while visiting strictly fewer states on the honest
+    configs (the mutants stop at first violation, so only coverage —
+    not counts — is comparable there)."""
+    for name, (mut_cls, prop, cfg) in mc.MUTANTS.items():
+        reduced = mc.explore(cfg, executor_cls=mut_cls, por=True,
+                             sym=True)
+        full = mc.explore(cfg, executor_cls=mut_cls, por=False,
+                          sym=False)
+        assert any(c.violation.property == prop
+                   for c in reduced.violations), name
+        assert any(c.violation.property == prop
+                   for c in full.violations), name
+    cfg = mc.MCConfig(name="porsym", depth=5, max_round=1)
+    reduced = mc.explore(cfg, por=True, sym=True)
+    full = mc.explore(cfg, por=False, sym=False)
+    assert not reduced.violations and not full.violations
+    assert reduced.states < full.states
+    assert reduced.transitions < full.transitions
+
+
+def test_sym_baseline_covers_shared_smoke_configs():
+    """The orbit-reduction metric's baseline names exactly the PR 6
+    smoke configs still present in the scope (the weighted additions
+    are new, not baselined)."""
+    names = {c.name for c in mc.SMOKE_SCOPE}
+    assert set(mc.SYM_BASELINE_STATES) <= names
+    assert len(mc.SYM_BASELINE_STATES) == 6
+
+
+# ---------------------------------------------------------------------------
+# weighted validator power (ISSUE 7 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def test_weighted_config_roundtrips_and_moves_quorum():
+    cfg = mc.MCConfig(name="w", powers=(1, 1, 1, 3), depth=6)
+    assert mc.MCConfig.from_json(cfg.to_json()) == cfg
+    net = mc.build_network(cfg)
+    assert net.vset.total_power == 6
+    assert sorted(v.voting_power for v in net.vset) == [1, 1, 1, 3]
+    # the three weight-1 validators are a head-count majority but NOT
+    # a weighted quorum — the boundary the weight-blind mutant trips
+    from agnes_tpu.core.round_votes import is_quorum
+    lights = sum(v.voting_power for v in net.vset
+                 if v.voting_power == 1)
+    assert not is_quorum(lights, net.vset.total_power)
+    assert is_quorum(lights + 3, net.vset.total_power)
+
+
+def test_weight_blind_mutant_caught_minimized_and_honest_clean():
+    name = "decide_weight_blind_quorum"
+    mut_cls, prop, cfg = mc.MUTANTS[name]
+    rep = mc.explore(cfg, executor_cls=mut_cls)
+    caught = [c for c in rep.violations if c.violation.property == prop]
+    assert caught, f"monitors missed the {name} mutant"
+    small = mc.minimize(cfg, caught[0].schedule, prop,
+                        executor_cls=mut_cls)
+    assert mc.reproduces(cfg, small, prop, executor_cls=mut_cls)
+    # the minimized schedule is clean under CORRECT weighting: the
+    # violation is the head-count tally's, not the checker's
+    _, honest = mc.run_with_monitors(cfg, small)
+    assert not honest
+    # the cert monitor saw the real arithmetic: weight below +2/3
+    detail = caught[0].violation.detail
+    assert "< +2/3" in detail or "weight" in detail
+
+
+def test_weighted_smoke_slice_explores_clean():
+    cfg = mc.MCConfig(name="w_slice", powers=(1, 1, 1, 3), depth=6,
+                      max_round=1)
+    rep = mc.explore(cfg)
+    assert rep.complete and not rep.violations
+    assert rep.states > 500
+
+
+# ---------------------------------------------------------------------------
 # exploration: determinism, POR soundness, clean smoke slices
 # ---------------------------------------------------------------------------
 
@@ -232,7 +411,7 @@ def test_self_test_end_to_end():
 def test_corpus_exists_and_covers_the_fault_space():
     entries = mc.load_corpus(CORPUS_DIR)
     names = {e["name"] for e in entries}
-    assert len(entries) >= 8, names
+    assert len(entries) >= 12, names
     behaviors = {b for e in entries for b in e["config"]["behaviors"]}
     assert {"equivocator", "nil_flood"} <= behaviors
     assert any(e["config"]["partition"] for e in entries)
@@ -240,9 +419,17 @@ def test_corpus_exists_and_covers_the_fault_space():
     assert any(e["expect"]["evidence"] for e in entries)
     assert any(any(r >= 1 for r, _v in e["expect"]["decided"].values())
                for e in entries if e["expect"]["decided"])
+    # weighted milestones (ISSUE 7): asymmetric power vectors whose
+    # +2/3 boundary falls between vote counts, with decisions
+    weighted = [e for e in entries
+                if e["config"].get("powers")
+                and len(set(e["config"]["powers"])) > 1]
+    assert len(weighted) >= 2, names
+    assert any(e["expect"]["decided"] for e in weighted)
     assert {n for n in names if n.startswith("mc_mut_")} == {
         "mc_mut_decide_without_quorum",
-        "mc_mut_drop_equivocation_evidence"}
+        "mc_mut_drop_equivocation_evidence",
+        "mc_mut_decide_weight_blind_quorum"}
 
 
 @pytest.mark.parametrize("entry", mc.load_corpus(CORPUS_DIR),
@@ -273,6 +460,8 @@ def _run_cli(*args, timeout=240):
 
 
 def test_cli_tiny_scope_json():
+    from agnes_tpu.analysis.admission_mc import ADMISSION_TINY
+
     rc, rep = _run_cli("--scope", "tiny", "--json", "--workers", "1")
     assert rc == 0
     assert rep["ok"] and rep["complete"]
@@ -281,13 +470,24 @@ def test_cli_tiny_scope_json():
     assert rep["metrics"]["modelcheck_states_explored"] == \
         rep["states_explored"]
     assert rep["metrics"]["modelcheck_violations"] == 0
-    assert set(rep["configs"]) == {c.name for c in mc.TINY_SCOPE}
+    # ISSUE 7: the scope sweeps BOTH domains and reports their splits
+    assert rep["admission_states"] > 1000
+    assert rep["consensus_states"] + rep["admission_states"] == \
+        rep["states_explored"]
+    assert rep["metrics"]["modelcheck_admission_states"] == \
+        rep["admission_states"]
+    assert "modelcheck_sym_orbit_reduction" in rep["metrics"]
+    assert set(rep["configs"]) == {c.name for c in mc.TINY_SCOPE} \
+        | {c.name for c in ADMISSION_TINY}
 
 
 def test_cli_self_test():
-    rc, rep = _run_cli("--self-test")
+    from agnes_tpu.analysis.admission_mc import ADMISSION_MUTANTS
+
+    rc, rep = _run_cli("--self-test", timeout=360)
     assert rc == 0 and rep["ok"]
     assert set(rep["self_test"]) == set(mc.MUTANTS)
+    assert set(rep["self_test_admission"]) == set(ADMISSION_MUTANTS)
 
 
 def test_cli_deadline_sentinel():
