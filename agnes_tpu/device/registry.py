@@ -34,7 +34,9 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-from typing import Callable, Dict, Optional, Tuple
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 #: modules whose import populates the full registry (ordered; heavy
 #: crypto modules last).  entries()/ensure_populated() import these.
@@ -107,8 +109,97 @@ def get(name: str) -> EntrySpec:
 
 def jit_entry(name: str) -> Callable:
     """The dispatchable object for `name` — the driver/pipeline seam
-    (tests override() this to stub device dispatch)."""
+    (tests override() this to stub device dispatch).  Identity-
+    preserving: returns exactly the registered jit (the lint's
+    `is_registered_jit` and tests' `is` assertions depend on it);
+    dispatch-path callers that want the first call TIMED go through
+    `timed_entry` instead."""
     return get(name).jit
+
+
+# -- first-dispatch compile wall (ISSUE 8 satellite) -------------------------
+#
+# The FIRST call of a jit entry in a process pays trace + compile
+# synchronously (execution stays async), so its host wall IS the
+# compile cost to within dispatch noise — the number that turns the
+# next silent-double-compile class of bug (the PR 3 217s stall) into
+# a `compile_ms_<entry>` gauge in drain reports and bench verdicts
+# instead of a mystery.  First-write-wins per entry name; recording
+# fires the `on_compile` observers (flight recorders) exactly once.
+
+_COMPILE_MS: Dict[str, float] = {}
+_COMPILE_CBS: List[Callable[[str, float], None]] = []
+_COMPILE_LOCK = threading.Lock()    # guards the first-write-wins
+
+
+def compile_ms() -> Dict[str, float]:
+    """{entry name -> first-dispatch wall ms} observed so far."""
+    return dict(_COMPILE_MS)
+
+
+def compile_gauges() -> Dict[str, float]:
+    """The same view under the metrics well-known gauge names
+    (`compile_ms_<entry>`) — what drain reports, heartbeat lines and
+    the /metrics endpoint carry."""
+    return {f"compile_ms_{k}": round(v, 1)
+            for k, v in _COMPILE_MS.items()}
+
+
+def on_compile(cb: Callable[[str, float], None]) -> None:
+    """Observe first-dispatch recordings (cb(name, wall_ms)); each
+    entry fires at most once per process.  Observers are exception-
+    contained — telemetry must never fail a dispatch."""
+    _COMPILE_CBS.append(cb)
+
+
+def record_compile_ms(name: str, wall_ms: float) -> bool:
+    """First-write-wins; True iff this call recorded `name`.  The
+    check+write is locked so two threads racing an entry's first
+    dispatch (warmup vs a dispatch loop) cannot both record — and the
+    observers fire at most once per entry, outside the lock."""
+    with _COMPILE_LOCK:
+        if name in _COMPILE_MS:
+            return False
+        _COMPILE_MS[name] = float(wall_ms)
+        cbs = list(_COMPILE_CBS)
+    for cb in cbs:
+        try:
+            cb(name, float(wall_ms))
+        except Exception:  # noqa: BLE001 — observers never fail a
+            pass           # dispatch
+    return True
+
+
+def reset_compile_ms() -> None:
+    """Test seam: forget recorded walls (process-lifetime data)."""
+    _COMPILE_MS.clear()
+
+
+def timed_call(name: str, fn: Callable, *args, **kwargs):
+    """Call `fn`; if `name` has no recorded wall yet, time this call
+    and record it.  Steady state (name recorded) is a dict lookup."""
+    if name in _COMPILE_MS:
+        return fn(*args, **kwargs)
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    record_compile_ms(name, (time.perf_counter() - t0) * 1e3)
+    return out
+
+
+def timed_entry(name: str) -> Callable:
+    """`jit_entry(name)`, wrapped so the entry's FIRST dispatch in the
+    process records `compile_ms_<name>`.  Once recorded the raw jit is
+    returned — zero steady-state overhead.  The driver and the serve
+    warmup dispatch through this; `jit_entry` stays identity-
+    preserving for the auditor/lint/override seams."""
+    spec = get(name)
+    if spec.name in _COMPILE_MS:
+        return spec.jit
+
+    def first_timed(*args, **kwargs):
+        return timed_call(spec.name, spec.jit, *args, **kwargs)
+
+    return first_timed
 
 
 def names() -> Tuple[str, ...]:

@@ -44,8 +44,11 @@ from agnes_tpu.core.state_machine import EventTag
 # Dispatch entries resolve through the registry at call time (ONE
 # name -> jit table shared with ServePipeline.warmup, the jaxpr
 # auditor and the retrace tripwire; tests registry.override() a name
-# to stub device dispatch with zero compiles).
-_jit = _registry.jit_entry
+# to stub device dispatch with zero compiles).  timed_entry: the
+# FIRST dispatch of each entry records its wall as compile_ms_<entry>
+# (trace+compile dominates that call — registry.compile_ms, ISSUE 8);
+# once recorded it returns the raw jit, zero steady-state overhead.
+_jit = _registry.timed_entry
 
 
 @dataclass
@@ -120,6 +123,11 @@ class DeviceDriver:
         # can gate dedup-cache insertion on "this dispatch's verify
         # rejected nothing" (serve/cache.py poisoning safety)
         self.last_step_rejects = None
+        # optional utils/flightrec.FlightRecorder (ISSUE 8): when set
+        # (VoteService wires its own through; bench arms a global one)
+        # every step_async dispatch and retrace trip leaves a
+        # structured event in the crash-surviving ring
+        self.flightrec = None
         self.mesh = mesh
         if mesh is not None:
             from agnes_tpu.parallel import (
@@ -267,7 +275,16 @@ class DeviceDriver:
         if self.sentinel is not None:
             from agnes_tpu.analysis.retrace import signature
 
-            self.sentinel.observe(entry, signature(args, statics))
+            try:
+                self.sentinel.observe(entry, signature(args, statics))
+            except Exception:
+                # an armed-set trip is ALSO a flight-recorder event:
+                # the heartbeat trail must date the unexpected trace
+                # even if the raising dispatch takes the process down
+                if self.flightrec is not None:
+                    self.flightrec.event("retrace_unexpected",
+                                         entry=entry)
+                raise
 
     # -- phase builders ------------------------------------------------------
 
@@ -401,7 +418,8 @@ class DeviceDriver:
                                    int(np.asarray(lanes.real).sum()))
 
     def step_async(self, phases, lanes=None, exts=None,
-                   donate: bool = True) -> "jnp.ndarray":
+                   donate: bool = True,
+                   tick: Optional[int] = None) -> "jnp.ndarray":
         """The serve plane's dispatch entry: queue a fused step
         sequence and return the moment dispatch is queued — message
         collection is ALWAYS deferred (regardless of `defer_collect`;
@@ -429,7 +447,12 @@ class DeviceDriver:
         pipeline does), not from `empty_phase()` whose height leaf IS
         `state.height`; an aliased donation degrades to a copy (jax
         warns) instead of corrupting, but the point of this entry is
-        to avoid that copy."""
+        to avoid that copy.
+
+        `tick` is the serve plane's monotonic tick id (ISSUE 8): it
+        identifies this dispatch in the flight-recorder trail (and,
+        via the pipeline's tracer flow events, in chrome-trace), so a
+        postmortem can name the exact tick a wedged run died in."""
         phases_st, exts_st, P = self._stack_seq(phases, exts)
         state, tally = self.state, self.tally
         if donate:
@@ -442,6 +465,11 @@ class DeviceDriver:
             state, tally = _dealias_buffers(state, tally)
         n_rejected = None
         if isinstance(lanes, DenseSignedPhases):
+            entry_name = ("sharded_step_seq_signed" if self.mesh
+                          is not None else
+                          "consensus_step_seq_signed_dense_donated"
+                          if donate else
+                          "consensus_step_seq_signed_dense")
             fn = self._dense_dispatch_fn(int(lanes.sig.shape[0]),
                                          donate=donate)
             out = fn(state, tally, exts_st, phases_st, lanes)
@@ -454,8 +482,9 @@ class DeviceDriver:
                     "the packed-lane signed layout is single-device; "
                     "on a mesh feed step_async DenseSignedPhases "
                     "(VoteBatcher.build_phases_device_dense)")
-            name = ("consensus_step_seq_signed_donated" if donate
-                    else "consensus_step_seq_signed")
+            name = entry_name = (
+                "consensus_step_seq_signed_donated" if donate
+                else "consensus_step_seq_signed")
             chunk = self._resolve_lane_chunk(int(lanes.pub.shape[0]))
             args = (state, tally, exts_st, phases_st, lanes,
                     self.powers, self.total, self.proposer_flag,
@@ -469,14 +498,18 @@ class DeviceDriver:
             args = (state, tally, exts_st, phases_st, self.powers,
                     self.total, self.proposer_flag, self.propose_value)
             if self.mesh is not None:
+                entry_name = "sharded_step_seq"
                 self._observe("sharded_step_seq", args,
                               (self.advance_height, donate))
                 fn = self._make_sharded_seq(
                     self.mesh, advance_height=self.advance_height,
                     donate=donate)
+                fn = partial(_registry.timed_call,
+                             "sharded_step_seq", fn)
             else:
-                name = ("consensus_step_seq_donated" if donate
-                        else "consensus_step_seq")
+                name = entry_name = (
+                    "consensus_step_seq_donated" if donate
+                    else "consensus_step_seq")
                 self._observe(name, args, (self.advance_height,))
                 fn = partial(_jit(name),
                              advance_height=self.advance_height)
@@ -484,6 +517,9 @@ class DeviceDriver:
             n_votes = int(sum(int(np.asarray(p.mask).sum())  # lint: allow (host-built phases)
                               for p in phases))
         self.last_step_rejects = n_rejected
+        if self.flightrec is not None:
+            self.flightrec.event("dispatch", tick=tick, votes=n_votes,
+                                 entry=entry_name)
         return self._finish_step(out, P, n_votes, n_rejected,
                                  force_defer=True)
 
@@ -549,8 +585,10 @@ class DeviceDriver:
                         self.proposer_flag, self.propose_value)
                 self._observe("sharded_step_seq_signed", args,
                               (self.advance_height, chunk, donate))
-                # jit reshards the host-built arrays per the in_specs
-                return fn(*args)
+                # jit reshards the host-built arrays per the in_specs;
+                # timed_call records the first dispatch's compile wall
+                return _registry.timed_call("sharded_step_seq_signed",
+                                            fn, *args)
 
             return dispatch
         name = ("consensus_step_seq_signed_dense_donated" if donate

@@ -72,6 +72,7 @@ class _StagedBatch:
     # lanes, inserted at settle iff the dispatch rejected zero lanes
     cache_keys: Optional[tuple] = None
     preverified: bool = False  # unsigned build of dedup-cache hits
+    tick: int = 0              # monotonic lifecycle id (ISSUE 8)
 
 
 @dataclass
@@ -81,6 +82,7 @@ class _Inflight:
     t_dispatch: float
     cache_keys: Optional[tuple] = None
     rejects: object = None     # deferred device rejected-lane count
+    tick: int = 0
 
 
 class ServePipeline:
@@ -119,6 +121,8 @@ class ServePipeline:
                  dense: Optional[bool] = None,
                  cache=None,
                  tracer: Optional[Tracer] = None,
+                 metrics=None,
+                 flightrec=None,
                  clock=time.monotonic):
         """`cache` (serve/cache.VerifiedCache, shared with the
         AdmissionQueue) enables the SPLIT-RUNG dispatch (ISSUE 5):
@@ -140,6 +144,22 @@ class ServePipeline:
         self.dense = (dense if dense is not None
                       else getattr(driver, "mesh", None) is not None)
         self.tracer = tracer
+        self.flightrec = flightrec
+        # observability plane (ISSUE 8): a monotonic TICK id per staged
+        # build, threaded through dispatch (step_async) and settle so
+        # the tracer's flow events and the flight recorder's
+        # tick_open/tick_close events name one connected lifecycle; and
+        # the dispatch/settle wall histograms on the shared registry
+        self.tick_seq = 0
+        if metrics is not None:
+            from agnes_tpu.utils.metrics import (
+                SERVE_DISPATCH_WALL_S,
+                SERVE_SETTLE_WALL_S,
+            )
+            self._h_dispatch = metrics.histogram(SERVE_DISPATCH_WALL_S)
+            self._h_settle = metrics.histogram(SERVE_SETTLE_WALL_S)
+        else:
+            self._h_dispatch = self._h_settle = None
         self._clock = clock
         self._staged: List[_StagedBatch] = []
         self._inflight: List[_Inflight] = []
@@ -176,6 +196,14 @@ class ServePipeline:
 
         return (self.tracer.span(name) if self.tracer is not None
                 else contextlib.nullcontext())
+
+    def _event(self, kind: str, **fields) -> None:
+        if self.flightrec is not None:
+            self.flightrec.event(kind, **fields)
+
+    def _next_tick(self) -> int:
+        self.tick_seq += 1
+        return self.tick_seq
 
     # -- window --------------------------------------------------------------
 
@@ -332,6 +360,15 @@ class ServePipeline:
             self.offladder_builds += 1
         if not phases:
             return False
+        tick = self._next_tick()
+        # rung chosen for this build: the padded lane count on the
+        # packed-lane signed path, else the vote count (dense/unsigned
+        # compile keys carry no rung)
+        rung = (int(lanes.pub.shape[0])
+                if (not self.dense and lanes is not None) else None)
+        self._event("tick_open", tick=tick,
+                    votes=sum(n for _, n in phases), rung=rung,
+                    signed=lanes is not None)
         # Entry policy: signed builds ALWAYS prepend the empty entry
         # phase (their lanes were packed with phase_offset=1, and the
         # honest steady state advances heights every batch anyway —
@@ -348,7 +385,8 @@ class ServePipeline:
         self._staged.append(_StagedBatch(
             phases=[p for p, _ in phases], lanes=lanes, entry=entry,
             entry_heights=hts if entry else None,
-            n_votes=n_votes, t_first=t_first, cache_keys=keys))
+            n_votes=n_votes, t_first=t_first, cache_keys=keys,
+            tick=tick))
         return True
 
     def _stage_preverified(self, hts: np.ndarray, t_first: float,
@@ -374,10 +412,13 @@ class ServePipeline:
             chunk = groups[k:k + 2]
             n_votes = sum(n for _, n in chunk)
             self._entry_h = hts.copy()
+            tick = self._next_tick()
+            self._event("tick_open", tick=tick, votes=n_votes,
+                        rung=None, signed=False, preverified=True)
             self._staged.append(_StagedBatch(
                 phases=[p for p, _ in chunk], lanes=None, entry=True,
                 entry_heights=hts, n_votes=n_votes, t_first=t_first,
-                preverified=True))
+                preverified=True, tick=tick))
         return True
 
     def dispatch_staged(self) -> int:
@@ -394,21 +435,30 @@ class ServePipeline:
         total = 0
         for k, st in enumerate(staged):
             try:
+                t0 = self._clock()
                 with self._span("serve.dispatch"):
+                    if self.tracer is not None:
+                        # flow step: this tick crossed onto the
+                        # dispatch thread (submit emitted the start)
+                        self.tracer.flow("tick", st.tick, "t")
                     phases = st.phases
                     if st.entry:
                         phases = [self._entry_phase(st.entry_heights)] \
                             + phases
                     self.driver.step_async(phases, st.lanes,
-                                           donate=self.donate)
+                                           donate=self.donate,
+                                           tick=st.tick)
             except BaseException:
                 self._staged = staged[k:] + self._staged
                 raise
+            if self._h_dispatch is not None:
+                self._h_dispatch.record(self._clock() - t0)
             self._inflight.append(_Inflight(
                 t_first=st.t_first, n_votes=st.n_votes,
                 t_dispatch=self._clock(), cache_keys=st.cache_keys,
                 rejects=getattr(self.driver, "last_step_rejects",
-                                None)))
+                                None),
+                tick=st.tick))
             self.dispatched_batches += 1
             self.dispatched_votes += st.n_votes
             if st.preverified:
@@ -442,9 +492,18 @@ class ServePipeline:
         per-lane verdict, so a batch containing any forged signature
         caches nothing — which is exactly what keeps an adversarial
         replay of a REJECTED signature uncacheable forever."""
+        t0 = self._clock()
         with self._span("serve.collect"):
             self.driver.collect()
+        if self._h_settle is not None:
+            self._h_settle.record(self._clock() - t0)
         done, self._inflight = self._inflight, []
+        now = self._clock()
+        for b in done:
+            if self.tracer is not None:
+                self.tracer.flow("tick", b.tick, "f")   # lifecycle end
+            self._event("tick_close", tick=b.tick, votes=b.n_votes,
+                        e2e_s=round(now - b.t_first, 6))
         if self.cache is not None:
             for b in done:
                 if b.cache_keys is None:
@@ -525,7 +584,7 @@ class ServePipeline:
             else:
                 name = ("consensus_step_seq_signed_donated"
                         if self.donate else "consensus_step_seq_signed")
-                fn = registry.jit_entry(name)
+                fn = registry.timed_entry(name)
                 for r in self.ladder.rungs:
                     lanes = SignedLanes(
                         pub=jnp.zeros((r, 32), jnp.int32),
@@ -565,7 +624,7 @@ class ServePipeline:
                     name = ("consensus_step_seq_donated" if self.donate
                             else "consensus_step_seq")
                     d._observe(name, args, (d.advance_height,))
-                    out = registry.jit_entry(name)(
+                    out = registry.timed_entry(name)(
                         *args, advance_height=d.advance_height)
                 jax.block_until_ready(out.state)
                 warmed += 1

@@ -239,6 +239,12 @@ class AdmissionQueue:
                 f"instance_cap must be positive: {instance_cap}")
         self.policy = policy
         self.cache = cache
+        # optional utils.metrics.Histogram: submit -> drain wait per
+        # drained chunk (ISSUE 8 `serve_admit_wait_s`; VoteService
+        # wires the shared registry's histogram in).  A plain
+        # duck-typed `.record(seconds, n)` sink — this module stays
+        # numpy+stdlib either way.
+        self.wait_hist = None
         self._clock = clock
         # deque: a realistic frontend submits a few records per peer
         # per call, so one micro-batch spans hundreds of chunks — a
@@ -352,6 +358,7 @@ class AdmissionQueue:
         q.policy = self.policy
         q.cache = self.cache
         q._clock = self._clock
+        q.wait_hist = self.wait_hist
         q._chunks = collections.deque(self._chunks)
         q.depth = self.depth
         q._inst_counts = self._inst_counts.copy()
@@ -411,6 +418,13 @@ class AdmissionQueue:
         n = self.depth if max_records is None else min(self.depth,
                                                        int(max_records))
         chunks = self._pop(n)
+        if self.wait_hist is not None:
+            # submit -> drain wait, chunk granularity: every record of
+            # a chunk was admitted in one submit, so (now - chunk.ts)
+            # weighted by the chunk's records IS the per-record wait
+            now = self._clock()
+            for c in chunks:
+                self.wait_hist.record(now - c.ts, len(c))
         t_first = min(c.ts for c in chunks)
         if len(chunks) == 1:
             cols = chunks[0].cols

@@ -36,7 +36,13 @@ import numpy as np
 from agnes_tpu.serve.batcher import MicroBatcher, ShapeLadder
 from agnes_tpu.serve.queue import AdmissionQueue, AdmitResult, REJECT_NEWEST
 from agnes_tpu.serve.pipeline import ServePipeline
-from agnes_tpu.utils.metrics import Metrics
+from agnes_tpu.utils.metrics import (
+    COMPILE_MS_PREFIX,
+    Metrics,
+    SERVE_ADMIT_WAIT_S,
+    SERVE_BATCH_CLOSE_AGE_S,
+    SERVE_E2E_DECISION_S,
+)
 from agnes_tpu.utils.tracing import Tracer
 
 # serve-plane metric names (counters unless noted)
@@ -80,6 +86,31 @@ SERVE_SUBMIT_BUSY_FRAC = "serve_submit_busy_frac"
 SERVE_DISPATCH_BUSY_FRAC = "serve_dispatch_busy_frac"
 
 
+#: compile-event fan-out (ISSUE 8): ONE registry observer for the
+#: whole process, forwarding first-dispatch compile recordings to a
+#: WeakSet of flight recorders — dead recorders fall out on GC (no
+#: discarded service is retained), and the registry's observer list
+#: never grows past one entry however many services come and go
+_COMPILE_RECORDERS = None          # weakref.WeakSet, created lazily
+
+
+def _notify_compile(name: str, ms: float) -> None:
+    for rec in list(_COMPILE_RECORDERS or ()):
+        rec.event("compile", entry=name, ms=round(ms, 1))
+
+
+def _watch_compiles(flightrec) -> None:
+    global _COMPILE_RECORDERS
+    if _COMPILE_RECORDERS is None:
+        import weakref
+
+        from agnes_tpu.device import registry as _registry
+
+        _COMPILE_RECORDERS = weakref.WeakSet()
+        _registry.on_compile(_notify_compile)
+    _COMPILE_RECORDERS.add(flightrec)
+
+
 class Decision(NamedTuple):
     """One newly latched instance decision, decoded for the consumer
     boundary (slot -> value id via the batcher's slot map)."""
@@ -106,8 +137,16 @@ class VoteService:
                  dedup_cache=None,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
+                 flightrec=None,
                  clock=time.monotonic):
-        """`dedup_cache` enables the verified-vote dedup layer
+        """`flightrec` (utils/flightrec.FlightRecorder) arms the
+        always-on observability trail (ISSUE 8): tick open/close,
+        rung chosen, rejects by cause, retrace trips and thread
+        failures land in its bounded ring, and a Heartbeat over the
+        same recorder leaves a crash-surviving NDJSON trail.  The
+        recorder is also handed to the driver (dispatch events).
+
+        `dedup_cache` enables the verified-vote dedup layer
         (ISSUE 5): pass a serve/cache.VerifiedCache (or True for a
         default-budget one).  Admission then digest-screens every
         admitted record, cache hits dispatch on the verify-free
@@ -139,6 +178,8 @@ class VoteService:
                     I, V, local_shape=driver._local_shape())
             else:
                 ladder = ShapeLadder.plan(I, V)
+        self.metrics = metrics or Metrics()
+        self.flightrec = flightrec
         # default queue: two full both-classes ticks — enough to
         # absorb a burst while one tick is in flight, small enough
         # that overload surfaces as rejects, not as unbounded memory
@@ -147,16 +188,33 @@ class VoteService:
                                     instance_cap=instance_cap,
                                     policy=overload_policy,
                                     cache=self.cache, clock=clock)
+        # serve latency histograms (ISSUE 8): admission wait recorded
+        # by the queue at drain; close age + submit->decision here;
+        # dispatch/settle walls inside the pipeline — one registry
+        self.queue.wait_hist = self.metrics.histogram(SERVE_ADMIT_WAIT_S)
+        self._h_close_age = self.metrics.histogram(
+            SERVE_BATCH_CLOSE_AGE_S)
+        self._h_e2e = self.metrics.histogram(SERVE_E2E_DECISION_S)
         self.micro = MicroBatcher(self.queue, ladder,
                                   target_votes=target_votes,
                                   max_delay_s=max_delay_s, clock=clock)
         self.pipeline = ServePipeline(driver, batcher, pubkeys, ladder,
                                       window_predictor=window_predictor,
                                       donate=donate, cache=self.cache,
-                                      tracer=tracer, clock=clock)
+                                      tracer=tracer,
+                                      metrics=self.metrics,
+                                      flightrec=flightrec, clock=clock)
         self.driver = driver
+        if flightrec is not None and \
+                getattr(driver, "flightrec", None) is None:
+            driver.flightrec = flightrec      # dispatch/retrace events
+        if flightrec is not None:
+            # first-dispatch compile walls are flight events too: the
+            # heartbeat trail dates an unexpected mid-serve compile
+            # (one process-wide observer + a recorder WeakSet — see
+            # _watch_compiles; no duplicate events, no retention)
+            _watch_compiles(flightrec)
         self.batcher = batcher
-        self.metrics = metrics or Metrics()
         self.tracer = tracer
         self._clock = clock
         self._reported = np.zeros(I, bool)
@@ -180,6 +238,13 @@ class VoteService:
             return AdmitResult(0, n, 0, tail, 0)
         if self.tracer is not None:
             with self.tracer.span("serve.submit"):
+                # flow START for the tick these records will ride: the
+                # pipeline's next staged build (an approximation under
+                # concurrency — reading tick_seq unlocked is benign,
+                # the arrow still lands on the right lifecycle for the
+                # alternating submit/pump protocol the trace shows)
+                self.tracer.flow("tick",
+                                 self.pipeline.tick_seq + 1, "s")
                 res = self.queue.submit(wire_bytes)
         else:
             res = self.queue.submit(wire_bytes)
@@ -195,6 +260,11 @@ class VoteService:
             # (the queue looks up exactly the admitted set)
             m.count(SERVE_CACHE_HITS, res.pre_verified)
             m.count(SERVE_CACHE_MISSES, res.accepted - res.pre_verified)
+        if self.flightrec is not None and res.rejected:
+            self.flightrec.event(
+                "reject", overflow=res.rejected_overflow,
+                fairness=res.rejected_fairness,
+                malformed=res.rejected_malformed)
         m.gauge(SERVE_QUEUE_DEPTH, self.queue.depth)
         return res
 
@@ -222,6 +292,11 @@ class VoteService:
     def _pump_batch(self, batch) -> dict:
         """Pipeline half of a tick: dispatch staged, densify `batch`."""
         n_batch = len(batch) if batch is not None else 0
+        if n_batch:
+            # oldest-record age at close (size- OR deadline-closed):
+            # the batching delay component of end-to-end latency
+            self._h_close_age.record(self._clock() - batch.t_first,
+                                     n_batch)
         dispatched, staged = self.pipeline.pump(batch)
         m = self.metrics
         if n_batch:
@@ -247,6 +322,12 @@ class VoteService:
             # settled batches to now (admission -> decision visible)
             self.metrics.gauge(SERVE_E2E_LATENCY_S,
                                now - min(b.t_first for b in done))
+            # ... and the DISTRIBUTION (ISSUE 8): per settled batch,
+            # oldest-record submit -> decisions visible, weighted by
+            # the batch's votes — the p50/p99 the drain report and
+            # bench verdicts carry
+            for b in done:
+                self._h_e2e.record(now - b.t_first, b.n_votes)
         self.metrics.gauge(SERVE_INFLIGHT, 0)
         self.metrics.gauge(SERVE_ADMIT_RATE,
                            self.metrics.interval_rate(SERVE_ADMITTED))
@@ -343,6 +424,19 @@ class VoteService:
         if delta > 0:
             self.metrics.count(SERVE_VOTES_DISPATCHED, delta)
         decisions = self.poll_decisions()
+        # per-entry first-dispatch compile walls into the registry's
+        # gauges so the final snapshot (and any scrape) carries them
+        from agnes_tpu.device import registry as _registry
+
+        for name, ms in _registry.compile_ms().items():
+            self.metrics.gauge(COMPILE_MS_PREFIX + name, round(ms, 1))
+        # WINDOWED final snapshot (the ISSUE 8 satellite): the shared
+        # interval window, so a long-lived service's drain rates
+        # describe the last window instead of a decayed lifetime
+        # average; serve_rates_window is carved from the SAME snapshot
+        # so the two can never disagree (bench's own verdict records
+        # keep their lifetime semantics — they never read this)
+        snap = self.metrics.snapshot(window=True)
         st = self.driver.stats
         report = {
             "decisions_total": st.decisions_total,
@@ -363,7 +457,31 @@ class VoteService:
             "preverified_votes": self.pipeline.preverified_votes,
             "serve_cache": (self.cache.snapshot()
                             if self.cache is not None else None),
-            "metrics": self.metrics.snapshot(),
-            "serve_rates_window": self.metrics.interval_rates(),
+            "metrics": snap,
+            "serve_rates_window": {k: v for k, v in snap.items()
+                                   if k.endswith("_per_sec")},
+            # the latency distributions, spelled out (p50/p90/p99/max/
+            # count per histogram) — what a hardware round's artifact
+            # quotes as its tail-latency numbers
+            "latency": {name: h.snapshot()
+                        for name, h in self.metrics.hists.items()},
         }
         return report
+
+    # -- export surface (ISSUE 8) --------------------------------------------
+
+    def start_metrics_server(self, port: int = 0,
+                             host: str = "127.0.0.1"):
+        """Attach a `/metrics` Prometheus endpoint over this service's
+        registry (utils/metrics_http.MetricsServer, jax-free stdlib).
+        Returns the started server; `server.port` is the bound port
+        (port 0 = ephemeral), `server.stop()` shuts it down.  The
+        scrape includes the per-entry `compile_ms_<entry>` gauges."""
+        from agnes_tpu.device import registry as _registry
+        from agnes_tpu.utils.metrics_http import MetricsServer
+
+        server = MetricsServer(
+            self.metrics, host=host, port=port,
+            extra_sources=(_registry.compile_gauges,))
+        server.start()
+        return server

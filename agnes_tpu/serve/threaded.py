@@ -106,6 +106,13 @@ class ThreadedVoteService:
             if self.failure is None:
                 self.failure = e
             self.service.metrics.count(SERVE_THREAD_FAILURES)
+            fr = getattr(self.service, "flightrec", None)
+            if fr is not None:
+                # the crash-surviving trail names the dead loop — a
+                # wedged host's heartbeat dates and attributes it
+                fr.event("thread_failure",
+                         thread=threading.current_thread().name,
+                         error=repr(e))
             self._stop.set()
             self.inbox.close()       # refuse producers immediately
 
@@ -136,6 +143,10 @@ class ThreadedVoteService:
 
     def _submit_loop(self) -> None:
         m = self.service.metrics
+        if self.service.tracer is not None:
+            # label this row in chrome-trace (stable-id metadata —
+            # the ISSUE 8 tracer satellite)
+            self.service.tracer.name_thread(self._submit_t.name)
         busy = 0.0
         win_t0 = self._clock()
         while not (self._stop.is_set() and self.inbox.depth == 0):
@@ -153,6 +164,8 @@ class ThreadedVoteService:
 
     def _dispatch_loop(self) -> None:
         m = self.service.metrics
+        if self.service.tracer is not None:
+            self.service.tracer.name_thread(self._dispatch_t.name)
         busy = 0.0
         win_t0 = self._clock()
         while True:

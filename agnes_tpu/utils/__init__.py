@@ -7,9 +7,18 @@ reference, present here):
                   arrays snapshot and re-upload).
   metrics.py      counters/gauges off the hot loops (votes verified,
                   thresholds crossed, decisions/sec) with one-line
-                  JSON export — the north-star metrics are built in.
-  tracing.py      host spans (chrome-trace JSON for perfetto) +
+                  JSON export — the north-star metrics are built in —
+                  plus the log-bucket latency Histogram (ISSUE 8).
+  tracing.py      host spans (chrome-trace JSON for perfetto, bounded
+                  ring, stable thread ids, tick flow events) +
                   jax.named_scope helpers for device kernels.
+  flightrec.py    flight recorder: bounded event ring + the crash-
+                  surviving heartbeat NDJSON (stdlib-only; bench.py
+                  loads it by file path before the probe guard).
+  metrics_http.py jax-free /metrics Prometheus endpoint over a
+                  Metrics registry (VoteService.start_metrics_server).
+  metrics_cli.py  the `agnes-metrics` heartbeat postmortem /
+                  schema-check CLI (scripts/agnes_metrics.py shim).
   config.py       the typed run configuration (validators, instances,
                   mesh shape, timeouts, dtypes) + CLI parsing.
 """

@@ -1,0 +1,352 @@
+"""Flight recorder: bounded in-memory event ring + a crash-surviving
+heartbeat file (ISSUE 8 tentpole, layer 2).
+
+Three straight hardware rounds wedged and died leaving nothing but a
+sentinel record (ROADMAP "Scoreboard reality") — every metric in the
+system was an end-of-run snapshot, so a SIGKILLed or wedged run
+yielded zero evidence about *where* it wedged.  This module is the
+always-on fix:
+
+* **FlightRecorder** — a bounded ring of structured events (tick
+  open/close, rung chosen, rejects by cause, retrace-unexpected,
+  compile observed, thread failure, ...).  Thread-safe, fixed memory,
+  per-kind monotone counters that survive ring eviction.
+* **Heartbeat** — a daemon thread appending ONE NDJSON line per
+  interval to an on-disk file: interval-windowed rates + histogram
+  quantiles + gauges (via caller-supplied `sources` callables, e.g.
+  ``Metrics.snapshot(window=True)``), the recorder's per-kind event
+  counts, and the in-flight stage.  Every line is flushed to the
+  kernel before the thread sleeps, so an outright SIGKILL still
+  leaves a parseable trail whose LAST LINE DATES THE WEDGE.  The file
+  is atomically rotated (``os.replace`` to ``<path>.1``) when it
+  outgrows ``max_bytes``.
+* **Schema helpers** — `validate_heartbeat_line` / `read_heartbeat` /
+  `last_line_age_s` / `render_postmortem`: the parsing half, shared by
+  the `agnes-metrics` CLI (utils/metrics_cli.py) and the ci.sh gate's
+  schema check.
+
+STDLIB-ONLY BY CONTRACT (like utils/budget.py): bench.py loads this
+module by FILE PATH before the probe guard runs, i.e. before jax — or
+even numpy-bearing agnes modules — may be imported.  Keep it that way.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: heartbeat line schema version
+SCHEMA_VERSION = 1
+
+#: required heartbeat keys -> accepted types (the ci.sh gate and
+#: `agnes-metrics --check` validate every line against this)
+REQUIRED_KEYS = {
+    "v": int,
+    "kind": str,
+    "seq": int,
+    "t": (int, float),          # wall-clock epoch seconds
+    "pid": int,
+    "uptime_s": (int, float),
+}
+
+
+class FlightRecorder:
+    """Bounded ring of structured events (module docstring).
+
+    `event(kind, **fields)` is the one producer call: a dict append
+    under a leaf mutex — cheap enough for the serve plane's
+    never-wait-on-device sections.  The ring holds the newest
+    `capacity` events (older ones evicted and counted in `dropped`);
+    `counts()` are per-kind MONOTONE totals independent of eviction,
+    which is what the heartbeat line reports."""
+
+    def __init__(self, capacity: int = 4096):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive: {capacity}")
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque()
+        self._counts: Dict[str, int] = {}
+        self._last: Dict[str, dict] = {}
+        self.dropped = 0
+        self._mu = threading.Lock()
+
+    def event(self, kind: str, **fields) -> None:
+        ev = {"kind": kind, "t": round(time.time(), 3)}
+        ev.update(fields)
+        with self._mu:
+            if len(self._ring) >= self.capacity:
+                self._ring.popleft()
+                self.dropped += 1
+            self._ring.append(ev)
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            self._last[kind] = ev
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def counts(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._counts)
+
+    def last(self, kind: str) -> Optional[dict]:
+        with self._mu:
+            ev = self._last.get(kind)
+            return dict(ev) if ev is not None else None
+
+    def tail(self, n: Optional[int] = None,
+             kind: Optional[str] = None) -> List[dict]:
+        """Newest-last snapshot of the ring (optionally one kind)."""
+        with self._mu:
+            evs = [dict(e) for e in self._ring
+                   if kind is None or e["kind"] == kind]
+        return evs if n is None else evs[-n:]
+
+
+def _json_safe(obj):
+    """Best-effort JSON-safe copy: a heartbeat line must NEVER fail to
+    serialize (a crashing telemetry thread is worse than a lossy
+    field)."""
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    try:                       # numpy scalars et al. without importing
+        return float(obj)      # numpy here (stdlib-only contract)
+    except Exception:  # noqa: BLE001
+        return repr(obj)
+
+
+class Heartbeat:
+    """Appends one NDJSON heartbeat line per interval (module
+    docstring).  `sources` is a MUTABLE sequence of zero-arg callables
+    returning dicts, re-read every beat — callers append sources as
+    subsystems come up (bench registers the serve probe's metrics
+    snapshot when the probe builds its service).  A source that raises
+    is counted in `source_errors`, never fatal."""
+
+    def __init__(self, path: str, interval_s: float = 1.0,
+                 recorder: Optional[FlightRecorder] = None,
+                 sources=None, max_bytes: int = 8_000_000):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be positive: {interval_s}")
+        self.path = str(path)
+        self.interval_s = float(interval_s)
+        self.recorder = recorder
+        self.sources = sources if sources is not None else []
+        self.max_bytes = int(max_bytes)
+        self.seq = 0
+        self.source_errors = 0
+        self._t0 = time.monotonic()
+        self._last_beat: Optional[float] = None      # monotonic
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._mu = threading.Lock()
+
+    # -- line production -----------------------------------------------------
+
+    def _line(self) -> dict:
+        line = {
+            "v": SCHEMA_VERSION,
+            "kind": "hb",
+            "seq": self.seq,
+            "t": round(time.time(), 3),
+            "pid": os.getpid(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "interval_s": self.interval_s,
+        }
+        if self.recorder is not None:
+            line["events"] = self.recorder.counts()
+            line["events_dropped"] = self.recorder.dropped
+        for src in list(self.sources):
+            try:
+                d = src()
+            except Exception:  # noqa: BLE001 — telemetry never kills
+                self.source_errors += 1
+                continue
+            if isinstance(d, dict):
+                line.update(_json_safe(d))
+        if self.source_errors:
+            line["source_errors"] = self.source_errors
+        return line
+
+    def _rotate_locked(self) -> None:
+        try:
+            if os.path.getsize(self.path) > self.max_bytes:
+                os.replace(self.path, self.path + ".1")   # atomic
+        except OSError:
+            pass
+
+    def beat(self) -> dict:
+        """Append one line NOW (the thread's tick; also callable
+        directly — tests and shutdown paths use it)."""
+        with self._mu:
+            self._rotate_locked()
+            line = self._line()
+            self.seq += 1
+            payload = json.dumps(line, sort_keys=True, default=repr)
+            with open(self.path, "a") as f:
+                f.write(payload + "\n")
+                f.flush()       # into the kernel: survives SIGKILL
+            self._last_beat = time.monotonic()
+        return line
+
+    def last_line_age(self) -> Optional[float]:
+        """Seconds since the last appended line (None = never beat)."""
+        with self._mu:
+            last = self._last_beat
+        return None if last is None else time.monotonic() - last
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Heartbeat":
+        if self._thread is None:
+            self.beat()         # even a run killed in second 0 leaves
+            self._thread = threading.Thread(         # a dated line
+                target=self._loop, daemon=True,
+                name="agnes-heartbeat")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.beat()
+            except Exception:  # noqa: BLE001 — a telemetry thread
+                pass           # must never take the host down
+
+    def stop(self, final_beat: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 5)
+        if final_beat:
+            try:
+                self.beat()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# -- parsing / schema (the agnes-metrics CLI + ci.sh gate half) --------------
+
+def validate_heartbeat_line(obj) -> List[str]:
+    """Schema problems of one parsed line (empty list = valid)."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"not an object: {type(obj).__name__}"]
+    for key, types in REQUIRED_KEYS.items():
+        if key not in obj:
+            problems.append(f"missing required key {key!r}")
+        elif not isinstance(obj[key], types) or isinstance(obj[key],
+                                                           bool):
+            problems.append(
+                f"key {key!r} has type {type(obj[key]).__name__}")
+    if not problems and obj["v"] > SCHEMA_VERSION:
+        problems.append(f"schema version {obj['v']} from the future")
+    return problems
+
+
+def read_heartbeat(path: str) -> Tuple[List[dict],
+                                       List[Tuple[int, str]]]:
+    """Parse an NDJSON heartbeat file -> (lines, bad) where `bad` is
+    [(1-based line number, problem)].  A final TRUNCATED line (the
+    process died mid-write) is reported in `bad`, not raised."""
+    lines: List[dict] = []
+    bad: List[Tuple[int, str]] = []
+    with open(path) as f:
+        for i, raw in enumerate(f, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                bad.append((i, "unparseable JSON"))
+                continue
+            problems = validate_heartbeat_line(obj)
+            if problems:
+                bad.append((i, "; ".join(problems)))
+            else:
+                lines.append(obj)
+    return lines, bad
+
+
+def last_line_age_s(path: str,
+                    now: Optional[float] = None) -> Optional[float]:
+    """Age (seconds) of the newest VALID line's wall timestamp — the
+    number that dates a wedge post-mortem.  None when the file is
+    missing or holds no valid line."""
+    try:
+        lines, _ = read_heartbeat(path)
+    except OSError:
+        return None
+    if not lines:
+        return None
+    now = time.time() if now is None else now
+    return now - lines[-1]["t"]
+
+
+def _fmt_t(t: float) -> str:
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(t))
+
+
+def render_postmortem(path: str,
+                      now: Optional[float] = None) -> str:
+    """Human post-mortem summary of a heartbeat file — what the next
+    wedged-round investigation reads FIRST (the `agnes-metrics` CLI's
+    default output)."""
+    now = time.time() if now is None else now
+    lines, bad = read_heartbeat(path)
+    out = [f"heartbeat {path}: {len(lines)} valid line(s), "
+           f"{len(bad)} bad"]
+    for i, why in bad[:5]:
+        out.append(f"  BAD line {i}: {why}")
+    if not lines:
+        out.append("  no valid lines — nothing to reconstruct")
+        return "\n".join(out)
+    first, last = lines[0], lines[-1]
+    age = now - last["t"]
+    interval = float(last.get("interval_s", 0)) or None
+    out.append(f"  run: pid {last['pid']}, first beat "
+               f"{_fmt_t(first['t'])}, last beat {_fmt_t(last['t'])} "
+               f"(uptime {last['uptime_s']:.1f}s, {len(lines)} beats)")
+    stale = interval is not None and age > 2 * interval
+    out.append(f"  last line age: {age:.1f}s"
+               + (f" — STALE (> 2x the {interval:.1f}s interval): "
+                  f"the process died or wedged around "
+                  f"{_fmt_t(last['t'])}" if stale else
+                  " (fresh: within two heartbeat intervals)"))
+    if "stage" in last:
+        out.append(f"  stage at last beat: {last['stage']}")
+    ev = last.get("events")
+    if isinstance(ev, dict) and ev:
+        top = sorted(ev.items(), key=lambda kv: -kv[1])
+        out.append("  events: " + ", ".join(
+            f"{k}={v}" for k, v in top[:10])
+            + (f" (+{last.get('events_dropped', 0)} evicted from the "
+               f"ring)" if last.get("events_dropped") else ""))
+    rates = {k: v for k, v in last.items()
+             if k.endswith("_per_sec") and isinstance(v, (int, float))
+             and v > 0}
+    if rates:
+        top = sorted(rates.items(), key=lambda kv: -kv[1])
+        out.append("  rates over the last window: " + ", ".join(
+            f"{k}={v:g}" for k, v in top[:8]))
+    quants = {k: v for k, v in last.items()
+              if (k.endswith("_p50") or k.endswith("_p99"))
+              and isinstance(v, (int, float)) and v > 0}
+    if quants:
+        out.append("  latency quantiles at last beat: " + ", ".join(
+            f"{k}={v:.6g}s" for k, v in sorted(quants.items())))
+    comp = {k: v for k, v in last.items()
+            if k.startswith("compile_ms_")
+            and isinstance(v, (int, float))}
+    if comp:
+        top = sorted(comp.items(), key=lambda kv: -kv[1])
+        out.append("  first-dispatch compile walls: " + ", ".join(
+            f"{k[len('compile_ms_'):]}={v:.0f}ms" for k, v in top[:8]))
+    return "\n".join(out)

@@ -21,10 +21,141 @@ Two rate families, because they answer different questions:
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+
+class Histogram:
+    """Thread-safe, mergeable log-bucket latency histogram (ISSUE 8).
+
+    Fixed bucket table: ``SUB`` sub-buckets per octave (relative bucket
+    width 2**(1/SUB) ~ 19%) spanning [2**LO_EXP, 2**HI_EXP) — with the
+    defaults ~60 ns to ~18 h, which covers everything from a lock hold
+    to a wedged-tunnel stall.  Values outside clamp into the edge
+    buckets (counted, never lost).  The hot path is one ``log2``, one
+    integer index and one increment under a leaf mutex: no allocation,
+    no device access, safe inside the serve plane's never-wait-on-
+    device sections.
+
+    Mergeable by construction — every histogram shares the one static
+    bucket table, so ``merge`` is element-wise addition: per-thread
+    histograms can be folded into one scrape with zero loss (the
+    N-thread conservation tests/test_observability.py asserts).
+
+    Quantiles come from the bucket geometric midpoint, so a reported
+    p99 is within one bucket width (~19%) of the exact order
+    statistic — the right trade for a fixed-size always-on recorder.
+    """
+
+    SUB = 4                    # sub-buckets per octave
+    LO_EXP = -24               # 2**-24 s ~ 60 ns
+    HI_EXP = 16                # 2**16 s ~ 18 h
+    NB = (HI_EXP - LO_EXP) * SUB
+
+    __slots__ = ("name", "counts", "n", "total", "vmax", "_mu")
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.counts = [0] * self.NB
+        self.n = 0
+        self.total = 0.0
+        self.vmax = 0.0
+        self._mu = threading.Lock()
+
+    @classmethod
+    def _index(cls, value: float) -> int:
+        if value <= 0.0:
+            return 0
+        i = int(math.floor(math.log2(value) * cls.SUB)) \
+            - cls.LO_EXP * cls.SUB
+        return 0 if i < 0 else (cls.NB - 1 if i >= cls.NB else i)
+
+    @classmethod
+    def bucket_upper(cls, i: int) -> float:
+        """Upper edge of bucket `i` (seconds)."""
+        return 2.0 ** (cls.LO_EXP + (i + 1) / cls.SUB)
+
+    @classmethod
+    def _bucket_mid(cls, i: int) -> float:
+        return 2.0 ** (cls.LO_EXP + (i + 0.5) / cls.SUB)
+
+    def record(self, value: float, n: int = 1) -> None:
+        """Record `value` (seconds) `n` times — `n` lets a per-batch
+        measurement stand for its votes without a per-vote loop."""
+        i = self._index(value)
+        with self._mu:
+            self.counts[i] += n
+            self.n += n
+            self.total += value * n
+            if value > self.vmax:
+                self.vmax = value
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold `other` into self (element-wise; both share the static
+        bucket table).  Returns self."""
+        with other._mu:
+            counts = list(other.counts)
+            n, total, vmax = other.n, other.total, other.vmax
+        with self._mu:
+            for i, c in enumerate(counts):
+                if c:
+                    self.counts[i] += c
+            self.n += n
+            self.total += total
+            if vmax > self.vmax:
+                self.vmax = vmax
+        return self
+
+    def quantile(self, q: float) -> float:
+        """The q-quantile (0 < q <= 1) as the geometric midpoint of
+        the bucket holding the target order statistic; 0.0 when
+        empty.  q=1 reports the exact tracked max."""
+        with self._mu:
+            if self.n == 0:
+                return 0.0
+            if q >= 1.0:
+                return self.vmax
+            target = max(1, math.ceil(q * self.n))
+            acc = 0
+            for i, c in enumerate(self.counts):
+                acc += c
+                if acc >= target:
+                    return min(self._bucket_mid(i), self.vmax)
+            return self.vmax
+
+    def snapshot(self) -> dict:
+        """p50/p90/p99/max/count/mean — the scrape/report view."""
+        with self._mu:
+            n, total, vmax = self.n, self.total, self.vmax
+        return {
+            "count": n,
+            "mean": (total / n) if n else 0.0,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+            "max": vmax,
+        }
+
+    def prom_buckets(self) -> Tuple[List[Tuple[float, int]], float, int]:
+        """Prometheus histogram view: ([(upper_edge_s, CUMULATIVE
+        count)], sum, count) over the occupied bucket range (plus
+        +Inf, which the renderer adds).  Consistent under the mutex."""
+        with self._mu:
+            counts = list(self.counts)
+            total, n = self.total, self.n
+        lo = next((i for i, c in enumerate(counts) if c), None)
+        if lo is None:
+            return [], total, n
+        hi = max(i for i, c in enumerate(counts) if c)
+        out: List[Tuple[float, int]] = []
+        acc = sum(counts[:lo])
+        for i in range(lo, hi + 1):
+            acc += counts[i]
+            out.append((self.bucket_upper(i), acc))
+        return out, total, n
 
 
 @dataclass
@@ -45,12 +176,17 @@ class Metrics:
 
     counters: Dict[str, int] = field(default_factory=dict)
     gauges: Dict[str, float] = field(default_factory=dict)
+    hists: Dict[str, Histogram] = field(default_factory=dict)
     _t0: float = field(default_factory=time.perf_counter)
     # per-name interval windows: name -> (count at last call, t of
-    # last call); a shared window for interval_rates() lives under a
-    # key no counter can collide with
+    # last call); all-counter windows for interval_rates()/
+    # snapshot(window=True) live in _win_all KEYED BY CONSUMER
+    # ("shared" default) — two independent scrape loops (e.g. the
+    # drain report and the flight-recorder heartbeat) must not close
+    # each other's windows
     _win: Dict[str, Tuple[int, float]] = field(default_factory=dict)
-    _win_all: Optional[Tuple[Dict[str, int], float]] = None
+    _win_all: Dict[str, Tuple[Dict[str, int], float]] = \
+        field(default_factory=dict)
     _lock: threading.RLock = field(default_factory=threading.RLock,
                                    repr=False, compare=False)
 
@@ -61,6 +197,20 @@ class Metrics:
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
             self.gauges[name] = value
+
+    def histogram(self, name: str) -> Histogram:
+        """Get-or-create the named latency histogram.  The Histogram
+        itself is thread-safe (leaf mutex), so hot paths hold a
+        REFERENCE and record without touching the registry lock."""
+        with self._lock:
+            h = self.hists.get(name)
+            if h is None:
+                h = self.hists[name] = Histogram(name)
+        return h
+
+    def observe(self, name: str, value: float, n: int = 1) -> None:
+        """Record `value` into the named histogram (creating it)."""
+        self.histogram(name).record(value, n)
 
     def elapsed(self) -> float:
         return time.perf_counter() - self._t0
@@ -89,29 +239,69 @@ class Metrics:
 
     def interval_rates(self) -> Dict[str, float]:
         """One windowed snapshot of EVERY counter: `{name}_per_sec`
-        deltas since the previous interval_rates() call, sharing one
-        window (a consistent scrape line).  Does not disturb the
-        per-name interval_rate windows."""
+        deltas since the previous interval_rates()/snapshot(window=
+        True) call on the SAME window key ("shared" here — a
+        consistent scrape line).  Does not disturb the per-name
+        interval_rate windows."""
         with self._lock:
-            now = time.perf_counter()
-            base, last_t = self._win_all or ({}, self._t0)
-            dt = now - last_t
-            out = {}
-            for name, c in self.counters.items():
-                d = c - base.get(name, 0)
-                out[f"{name}_per_sec"] = (round(d / dt, 2) if dt > 0
-                                          else 0.0)
-            self._win_all = (dict(self.counters), now)
+            return self._windowed_rates_locked("shared")
+
+    def _windowed_rates_locked(self, key: str) -> Dict[str, float]:
+        """Close the `key` window and return its per_sec deltas
+        (caller holds the registry lock)."""
+        now = time.perf_counter()
+        base, last_t = self._win_all.get(key) or ({}, self._t0)
+        dt = now - last_t
+        out = {}
+        for name, c in self.counters.items():
+            d = c - base.get(name, 0)
+            out[f"{name}_per_sec"] = (round(d / dt, 2) if dt > 0
+                                      else 0.0)
+        self._win_all[key] = (dict(self.counters), now)
         return out
 
-    def snapshot(self) -> dict:
+    def snapshot(self, window: bool = False,
+                 window_key: str = "shared") -> dict:
+        """Counters + gauges + histogram quantiles in one dict.
+
+        `window=False` (default) derives every `{name}_per_sec` from
+        the LIFETIME `rate()` — right for a bench that starts,
+        measures, exits, and exactly the trap the module docstring
+        warns about for anything long-lived.  `window=True` derives
+        them from an interval window instead: the serve drain report
+        and the flight-recorder heartbeat use this so a long-lived
+        service's rates describe the last window, not a decayed
+        lifetime average.  `window_key` names the window — each
+        INDEPENDENT periodic consumer must use its own key (the
+        heartbeat passes "heartbeat") or it would close the "shared"
+        window under the drain report / interval_rates() and corrupt
+        their rates.  Counter/gauge values themselves are lifetime
+        totals either way."""
         with self._lock:
             out = dict(self.counters)
             out.update(self.gauges)
             out["elapsed_s"] = round(self.elapsed(), 4)
-            for name in self.counters:
-                out[f"{name}_per_sec"] = round(self.rate(name), 2)
+            if window:
+                out.update(self._windowed_rates_locked(window_key))
+            else:
+                for name in self.counters:
+                    out[f"{name}_per_sec"] = round(self.rate(name), 2)
+            hists = list(self.hists.items())
+        for name, h in hists:            # hist mutexes: outside _lock
+            snap = h.snapshot()
+            out[f"{name}_count"] = snap["count"]
+            for q in ("p50", "p90", "p99", "max"):
+                out[f"{name}_{q}"] = round(snap[q], 6)
         return out
+
+    def export_view(self) -> Tuple[Dict[str, int], Dict[str, float],
+                                   Dict[str, Histogram]]:
+        """Consistent (counters, gauges, hists) copies for an exporter
+        (utils/metrics_http.py) — the one sanctioned way to read the
+        registry from outside without reaching for `_lock`."""
+        with self._lock:
+            return dict(self.counters), dict(self.gauges), \
+                dict(self.hists)
 
     def json_line(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)
@@ -139,6 +329,35 @@ MODELCHECK_VIOLATIONS = "modelcheck_violations"
 #: (analysis/admission_mc.py)
 MODELCHECK_SYM_ORBIT_REDUCTION = "modelcheck_sym_orbit_reduction"
 MODELCHECK_ADMISSION_STATES = "modelcheck_admission_states"
+#: ISSUE 8 observability plane — serve latency HISTOGRAMS (seconds;
+#: log-bucket `Histogram`s living in `Metrics.hists`, quantiles
+#: surfaced as `{name}_{p50,p90,p99,max,count}` snapshot keys and as
+#: Prometheus histogram series on the /metrics endpoint):
+#:   serve_admit_wait_s           submit -> drain wait per admitted
+#:                                record (chunk granularity)
+#:   serve_batch_close_age_s      oldest-record age when a micro-batch
+#:                                closes (size- or deadline-closed)
+#:   serve_dispatch_wall_s        host wall of queueing one staged
+#:                                build's fused dispatch (step_async)
+#:   serve_settle_wall_s          wall of the settle-side collect()
+#:                                (the one host<->device sync point)
+#:   serve_submit_to_decision_s   end-to-end: oldest admitted record
+#:                                of a settled batch -> its decisions
+#:                                visible, weighted by the batch's
+#:                                votes
+SERVE_ADMIT_WAIT_S = "serve_admit_wait_s"
+SERVE_BATCH_CLOSE_AGE_S = "serve_batch_close_age_s"
+SERVE_DISPATCH_WALL_S = "serve_dispatch_wall_s"
+SERVE_SETTLE_WALL_S = "serve_settle_wall_s"
+SERVE_E2E_DECISION_S = "serve_submit_to_decision_s"
+#: per-entry first-dispatch wall gauges, `compile_ms_<entry>` (ISSUE 8
+#: satellite): the registry times the FIRST dispatch of every entry in
+#: the process (trace + compile dominates that call), so the next
+#: silent-double-compile class of bug is a number in the drain report
+#: and the bench verdict record, not a 217s mystery stall
+#: (device/registry.py `compile_ms()`; -1 never appears — an entry
+#: that was not dispatched has no key)
+COMPILE_MS_PREFIX = "compile_ms_"
 VOTES_INGESTED = "votes_ingested"
 VOTES_VERIFIED = "votes_verified"
 THRESHOLDS_CROSSED = "thresholds_crossed"

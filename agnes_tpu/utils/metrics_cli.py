@@ -1,0 +1,97 @@
+"""agnes-metrics: render a flight-recorder heartbeat NDJSON into a
+human postmortem summary (ISSUE 8 tentpole, layer 3).
+
+The workflow after the NEXT wedged hardware round: the crash-safe
+bench verdict record carries `heartbeat_path`; point this CLI at it
+and read where the run was when it died —
+
+  agnes-metrics BENCH_heartbeat.ndjson           # postmortem summary
+  agnes-metrics --check heartbeat.ndjson         # schema gate (ci.sh)
+  agnes-metrics --json heartbeat.ndjson          # machine summary
+
+`--check` exits nonzero when the file is missing, holds zero valid
+lines, or any line fails the schema (utils/flightrec.REQUIRED_KEYS) —
+the ci.sh serve-smoke gate runs it over the smoke's heartbeat so a
+format regression fails CI, not the next post-mortem.
+
+JAX-FREE: imports only stdlib + utils.flightrec (itself stdlib-only),
+so the CLI works on a box whose accelerator stack is the thing being
+post-mortemed.  Console entry point `agnes-metrics` (pyproject) with
+the historical `scripts/agnes_metrics.py` shim, like agnes-lint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from agnes_tpu.utils.flightrec import (
+    read_heartbeat,
+    render_postmortem,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="agnes-metrics",
+        description="render / schema-check a flight-recorder "
+                    "heartbeat NDJSON")
+    ap.add_argument("path", help="heartbeat NDJSON file")
+    ap.add_argument("--check", action="store_true",
+                    help="schema gate: exit nonzero unless every line "
+                         "parses and validates and at least one valid "
+                         "line exists")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable summary instead of prose")
+    args = ap.parse_args(argv)
+
+    try:
+        lines, bad = read_heartbeat(args.path)
+    except OSError as e:
+        print(f"agnes-metrics: cannot read {args.path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.check:
+        # ONE bad line that is the FILE'S LAST is the expected
+        # artifact of abrupt death mid-write (SIGKILL / os._exit
+        # while the heartbeat thread writes) — the exact scenario the
+        # recorder exists to survive.  Tolerate precisely that; any
+        # interior bad line, or a trail with no valid line, fails.
+        with open(args.path) as f:
+            n_raw = sum(1 for raw in f if raw.strip())
+        trailing = (len(bad) == 1 and bool(lines)
+                    and bad[0][0] == n_raw)
+        for i, why in bad:
+            print(f"BAD line {i}: {why}"
+                  + (" (trailing — tolerated as a death-cut line)"
+                     if trailing else ""), file=sys.stderr)
+        if (bad and not trailing) or not lines:
+            print(f"heartbeat check FAILED: {len(lines)} valid, "
+                  f"{len(bad)} bad line(s) in {args.path}",
+                  file=sys.stderr)
+            return 1
+        print(f"heartbeat check OK: {len(lines)} valid line(s), "
+              f"schema v{lines[-1]['v']}, last seq {lines[-1]['seq']}"
+              + (", 1 trailing death-cut line tolerated" if trailing
+                 else ""))
+        return 0
+
+    if args.as_json:
+        summary = {
+            "path": args.path,
+            "valid_lines": len(lines),
+            "bad_lines": len(bad),
+            "first": lines[0] if lines else None,
+            "last": lines[-1] if lines else None,
+        }
+        print(json.dumps(summary, sort_keys=True))
+        return 0 if lines else 1
+
+    print(render_postmortem(args.path))
+    return 0 if lines and not bad else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

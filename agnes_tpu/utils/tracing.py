@@ -5,17 +5,37 @@ chrome://tracing / perfetto JSON array format.  Device side: `span`
 wraps `jax.named_scope`, so kernel regions show up named in XLA/JAX
 profiler dumps (`jax.profiler.trace` being the heavyweight option).
 The reference has no instrumentation anywhere (SURVEY.md §5).
+
+ISSUE 8 hardening for always-on service use:
+
+* **Bounded.**  `spans` is a ring of `max_events` entries (oldest
+  evicted, `dropped_events` counted) — the unbounded list grew without
+  limit on a long-lived service.
+* **Stable thread ids.**  `threading.get_ident() & 0xFFFF` collided
+  across recycled idents; threads now get small SEQUENTIAL ids in
+  first-seen order, and `write()` emits chrome-trace `thread_name`
+  metadata events so the submit/dispatch threads are labeled rows in
+  the viewer (`name_thread()` overrides the auto-captured name).
+* **Flow events.**  `flow(name, fid, phase)` records chrome-trace
+  flow events (`ph` s/t/f) keyed by a tick id, so one vote tick's
+  submit -> dispatch -> settle lifecycle renders as ONE connected
+  arrow chain across threads instead of disjoint spans
+  (serve/pipeline.py threads the tick id through).
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Deque, Dict, Optional
+
+#: flow-event phases: start / step / end (chrome-trace ph values)
+FLOW_START, FLOW_STEP, FLOW_END = "s", "t", "f"
 
 
 @dataclass
@@ -24,15 +44,49 @@ class _Span:
     ts_us: float
     dur_us: float
     tid: int
+    ph: str = "X"                  # "X" span | "s"/"t"/"f" flow event
+    fid: Optional[int] = None      # flow (tick) id for flow events
 
 
 @dataclass
 class Tracer:
     """Collects host spans; `write(path)` emits chrome-trace JSON."""
 
-    spans: List[_Span] = field(default_factory=list)
+    max_events: int = 65536
+    spans: Deque[_Span] = None
+    dropped_events: int = 0
     _t0: float = field(default_factory=time.perf_counter)
     _lock: threading.Lock = field(default_factory=threading.Lock)
+    _tids: Dict[int, int] = field(default_factory=dict)
+    _thread_names: Dict[int, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.spans is None:
+            self.spans = collections.deque()
+
+    def _tid_locked(self) -> int:
+        """Small stable id of the calling thread (first-seen order);
+        captures the thread's name on first sight.  Caller holds the
+        lock."""
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            tid = self._tids[ident] = len(self._tids) + 1
+            self._thread_names.setdefault(
+                tid, threading.current_thread().name)
+        return tid
+
+    def name_thread(self, name: str) -> None:
+        """Label the CALLING thread's row in the trace viewer (e.g.
+        the serve host names its submit/dispatch loops)."""
+        with self._lock:
+            self._thread_names[self._tid_locked()] = name
+
+    def _append_locked(self, span: _Span) -> None:
+        if len(self.spans) >= self.max_events:
+            self.spans.popleft()
+            self.dropped_events += 1
+        self.spans.append(span)
 
     @contextlib.contextmanager
     def span(self, name: str):
@@ -42,23 +96,59 @@ class Tracer:
         finally:
             end = time.perf_counter()
             with self._lock:
-                self.spans.append(_Span(
+                self._append_locked(_Span(
                     name=name,
                     ts_us=(start - self._t0) * 1e6,
                     dur_us=(end - start) * 1e6,
-                    tid=threading.get_ident() & 0xFFFF))
+                    tid=self._tid_locked()))
+
+    def flow(self, name: str, fid: int, phase: str) -> None:
+        """Record one flow event (`phase` in "s"/"t"/"f") on the
+        calling thread — the cross-thread correlation arrow for flow
+        id `fid` (the serve plane's tick id)."""
+        assert phase in (FLOW_START, FLOW_STEP, FLOW_END), phase
+        now = time.perf_counter()
+        with self._lock:
+            self._append_locked(_Span(
+                name=name, ts_us=(now - self._t0) * 1e6, dur_us=0.0,
+                tid=self._tid_locked(), ph=phase, fid=int(fid)))
 
     def write(self, path: str) -> None:
-        events = [{"name": s.name, "ph": "X", "ts": s.ts_us,
-                   "dur": s.dur_us, "pid": os.getpid(), "tid": s.tid}
-                  for s in self.spans]
+        pid = os.getpid()
+        with self._lock:
+            spans = list(self.spans)
+            names = dict(self._thread_names)
+        events = [{"ph": "M", "name": "thread_name", "pid": pid,
+                   "tid": tid, "args": {"name": name}}
+                  for tid, name in sorted(names.items())]
+        for s in spans:
+            if s.ph == "X":
+                events.append({"name": s.name, "ph": "X", "ts": s.ts_us,
+                               "dur": s.dur_us, "pid": pid,
+                               "tid": s.tid})
+            else:
+                ev = {"name": s.name, "ph": s.ph, "ts": s.ts_us,
+                      "pid": pid, "tid": s.tid, "cat": "tick",
+                      "id": s.fid}
+                if s.ph == FLOW_END:
+                    ev["bp"] = "e"     # bind to enclosing slice's end
+                events.append(ev)
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             json.dump({"traceEvents": events}, f)
         os.replace(tmp, path)
 
     def total_us(self, name: str) -> float:
-        return sum(s.dur_us for s in self.spans if s.name == name)
+        with self._lock:
+            return sum(s.dur_us for s in self.spans
+                       if s.name == name and s.ph == "X")
+
+    def flow_phases(self, fid: int) -> set:
+        """The flow phases recorded for `fid` (test/debug helper):
+        a fully correlated tick shows {"s", "t", "f"}."""
+        with self._lock:
+            return {s.ph for s in self.spans if s.fid == fid
+                    and s.ph != "X"}
 
 
 @contextlib.contextmanager

@@ -87,15 +87,16 @@ else:
     _here = os.getcwd()
 
 
-def _load_budget():
-    """utils/budget.py by FILE PATH: importing agnes_tpu.utils proper
-    would pull jax via the package __init__ and initialize a backend —
-    exactly what the probe guard exists to avoid.  budget.py's module
-    level is stdlib-only by contract."""
+def _load_stdlib_module(fname: str, alias: str):
+    """A utils/*.py module by FILE PATH: importing agnes_tpu.utils
+    proper would pull jax via the package __init__ and initialize a
+    backend — exactly what the probe guard exists to avoid.  The
+    loaded module's top level must be stdlib-only by contract
+    (budget.py, flightrec.py)."""
     import importlib.util
 
-    path = os.path.join(_here, "agnes_tpu", "utils", "budget.py")
-    spec = importlib.util.spec_from_file_location("_agnes_budget", path)
+    path = os.path.join(_here, "agnes_tpu", "utils", fname)
+    spec = importlib.util.spec_from_file_location(alias, path)
     mod = importlib.util.module_from_spec(spec)
     # dataclass creation resolves cls.__module__ through sys.modules
     sys.modules[spec.name] = mod
@@ -103,7 +104,12 @@ def _load_budget():
     return mod
 
 
-_budget = _load_budget()
+_budget = _load_stdlib_module("budget.py", "_agnes_budget")
+#: flight recorder + heartbeat (ISSUE 8): armed alongside the deadline
+#: watchdog BEFORE the probe guard can hang, so even a wedged-probe or
+#: SIGKILLed round leaves an on-disk NDJSON trail whose last line
+#: dates the wedge (utils/flightrec.py; stdlib-only like budget)
+_flightrec = _load_stdlib_module("flightrec.py", "_agnes_flightrec")
 
 NORTH_STAR = 1_000_000  # votes/sec/chip (BASELINE.json north_star)
 
@@ -117,6 +123,32 @@ _STAGE = "probe-guard"
 _EMITTED = False
 _LEASE = None
 _PROBE_PROC = None         # in-flight probe child; reaped on any exit
+
+#: the always-on flight recorder: serve probes hand it to their
+#: drivers/services (dispatch, tick, reject, retrace, compile events);
+#: the heartbeat thread snapshots its per-kind counts every interval
+_FLIGHTREC = _flightrec.FlightRecorder(capacity=4096)
+#: heartbeat sources — a MUTABLE list the probes append to (e.g. a
+#: serve probe registers its Metrics windowed snapshot when its
+#: service comes up); read fresh every beat
+_HB_SOURCES: list = []
+_HEARTBEAT = None          # armed in the __main__ guard below
+_PROBE_SOURCE: dict = {"fn": None}
+
+
+def _set_probe_source(fn) -> None:
+    """Install a probe's metrics snapshot as THE live heartbeat
+    source: the new probe's source REPLACES the previous probe's, so
+    a finished service (and the driver + device buffers its closure
+    retains) is released instead of being snapshotted forever — and
+    stale dead-probe counters never shadow the live probe's on a
+    heartbeat line."""
+    old = _PROBE_SOURCE["fn"]
+    if old is not None and old in _HB_SOURCES:
+        _HB_SOURCES.remove(old)
+    _PROBE_SOURCE["fn"] = fn
+    if fn is not None:
+        _HB_SOURCES.append(fn)
 
 #: retrace-audit counters accumulated by the serve probes (their
 #: drivers run with the recompile tripwire armed, ISSUE 4): distinct
@@ -210,7 +242,37 @@ def _emit_sentinel(note: str) -> None:
            "note": note}
     if _RESULTS:
         rec["partial"] = dict(_RESULTS)
+    rec.update(_heartbeat_record())
     print(json.dumps(rec), flush=True)
+
+
+def _heartbeat_record() -> dict:
+    """Heartbeat keys for every verdict record (real or sentinel): the
+    trail's path and its last line's age, so a wedged round's artifact
+    points the post-mortem (`agnes-metrics <path>`) at the evidence."""
+    if _HEARTBEAT is None:
+        return {}
+    try:
+        age = _HEARTBEAT.last_line_age()
+        return {"heartbeat_path": _HEARTBEAT.path,
+                "heartbeat_age_s": (round(age, 1) if age is not None
+                                    else -1)}
+    except Exception:  # noqa: BLE001 — telemetry never blocks a verdict
+        return {"heartbeat_path": _HEARTBEAT.path,
+                "heartbeat_age_s": -1}
+
+
+def _compile_record() -> dict:
+    """`compile_ms_<entry>` keys for the verdict records (ISSUE 8
+    satellite): per-entry first-dispatch walls from the registry.
+    Empty before the heavy imports (sentinel paths) — guarded so a
+    wedged pre-import process can still emit."""
+    try:
+        from agnes_tpu.device import registry
+
+        return registry.compile_gauges()
+    except Exception:  # noqa: BLE001
+        return {}
 
 
 def _deadline_signal(signum: int) -> None:
@@ -540,6 +602,32 @@ if __name__ == "__main__":
     # (main thread blocked in a single long C++ call)
     _alarm = _budget.install_deadline_signals(_deadline_signal, _DEADLINE)
     _arm_deadline_watchdog(_alarm)
+    # the flight recorder's heartbeat arms HERE, with the watchdog —
+    # before anything can hang — so a wedged probe, a minutes-long XLA
+    # compile or an outright SIGKILL all leave a dated NDJSON trail
+    # (the verdict record carries its path; AGNES_HEARTBEAT_PATH
+    # overrides for CI gates)
+    import tempfile
+
+    _hb_path = os.environ.get("AGNES_HEARTBEAT_PATH") or os.path.join(
+        tempfile.gettempdir(),
+        f"agnes_bench_heartbeat_{os.getpid()}.ndjson")
+    _HB_SOURCES.append(lambda: {
+        "stage": _STAGE,
+        "deadline_remaining_s": (
+            round(_DEADLINE.remaining(), 1)
+            if _DEADLINE.remaining() != float("inf") else -1)})
+    try:
+        _HEARTBEAT = _flightrec.Heartbeat(
+            _hb_path,
+            interval_s=float(os.environ.get(
+                "AGNES_HEARTBEAT_INTERVAL_S", "5")),
+            recorder=_FLIGHTREC, sources=_HB_SOURCES).start()
+    except Exception:  # noqa: BLE001 — an unwritable heartbeat path
+        _HEARTBEAT = None         # must never cost the verdict
+    print(f"[bench] heartbeat: "
+          f"{_HEARTBEAT.path if _HEARTBEAT else 'DISARMED'}",
+          file=sys.stderr, flush=True)
     print(f"[bench] deadline: {_DEADLINE.source}, "
           f"remaining {_DEADLINE.remaining():.0f}s, "
           f"alarm in {_alarm:.0f}s" if _alarm else
@@ -1098,7 +1186,14 @@ def _pipeline_serve(n_instances: int, n_validators: int,
         max_delay_s=1e9,                       # size-closed batches
         ladder=ShapeLadder.plan(I, V, min_rung=rung),
         window_predictor=lambda: (np.zeros(I, np.int64),
-                                  np.full(I, cur["h"], np.int64)))
+                                  np.full(I, cur["h"], np.int64)),
+        flightrec=_FLIGHTREC)
+    # heartbeat lines now carry the serve registry's windowed rates,
+    # gauges and latency quantiles (ISSUE 8: telemetry while it
+    # runs).  Own window key: the heartbeat's per-interval consumption
+    # must not close the "shared" window under the drain report.
+    _set_probe_source(lambda: svc.metrics.snapshot(
+        window=True, window_key="heartbeat"))
     inst = np.repeat(np.arange(I), V)
     val = np.tile(np.arange(V), I)
 
@@ -1132,6 +1227,37 @@ def _pipeline_serve(n_instances: int, n_validators: int,
     assert d.rejected_signature_device == 0
     rep = svc.drain()
     assert rep["queue"]["rejected_overflow"] == 0
+    assert rep["latency"]["serve_submit_to_decision_s"]["count"] > 0
+    if os.environ.get("AGNES_SERVE_SMOKE_METRICS"):
+        # ci.sh gate [3b]: prove the /metrics endpoint serves ONE
+        # clean scrape over the live registry — parsed, and the
+        # headline admission counter round-trips exactly
+        from urllib.request import urlopen
+
+        from agnes_tpu.utils.metrics_http import parse_prometheus
+
+        srv = svc.start_metrics_server()
+        try:
+            text = urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=30).read().decode()
+        finally:
+            srv.stop()
+        parsed = parse_prometheus(text)
+        _EXTRA_RECORD.update({
+            "metrics_scrape_ok": bool(
+                parsed.get("serve_submitted")
+                == svc.metrics.counters.get("serve_submitted")
+                and parsed.get("serve_submit_to_decision_s_count",
+                               0) > 0),
+            "metrics_scrape_series": len(parsed),
+        })
+    _EXTRA_RECORD.update({
+        "serve_submit_to_decision_p50_s":
+            rep["metrics"]["serve_submit_to_decision_s_p50"],
+        "serve_submit_to_decision_p99_s":
+            rep["metrics"]["serve_submit_to_decision_s_p99"],
+    })
     _harvest_audit(d)
     return 2 * n * heights / dt
 
@@ -1186,7 +1312,10 @@ def _pipeline_serve_mesh(n_instances: int, n_validators: int,
                                       local_shape=d._local_shape(),
                                       min_rung=rung),
         window_predictor=lambda: (np.zeros(I, np.int64),
-                                  np.full(I, cur["h"], np.int64)))
+                                  np.full(I, cur["h"], np.int64)),
+        flightrec=_FLIGHTREC)
+    _set_probe_source(lambda: svc.metrics.snapshot(
+        window=True, window_key="heartbeat"))
     tsvc = ThreadedVoteService(svc, idle_wait_s=1e-4).start()
     inst = np.repeat(np.arange(I), V)
     val = np.tile(np.arange(V), I)
@@ -1300,7 +1429,14 @@ def _pipeline_serve_dedup(n_instances: int, n_validators: int,
             ladder=ShapeLadder.plan(I, V, min_rung=rung),
             dedup_cache=VerifiedCache() if dedup else None,
             window_predictor=lambda: (np.zeros(I, np.int64),
-                                      np.full(I, cur["h"], np.int64)))
+                                      np.full(I, cur["h"], np.int64)),
+            flightrec=_FLIGHTREC)
+        # same telemetry contract as the other serve probes: a wedge
+        # inside this probe must leave per-interval serve rates /
+        # latency quantiles on the heartbeat trail (the dedup-off
+        # replay re-points the source at ITS service)
+        _set_probe_source(lambda: svc.metrics.snapshot(
+            window=True, window_key="heartbeat"))
 
         def run_height(h):
             cur["h"] = h
@@ -1443,6 +1579,8 @@ def _smoke_main(stage: str, metric: str, value_key: str, unit: str,
                  f"{time.perf_counter() - t0:.0f}s"),
         **_EXTRA_RECORD,
         **_ANALYSIS,
+        **_compile_record(),
+        **_heartbeat_record(),
     }), flush=True)
     _EMITTED = True
 
@@ -1506,6 +1644,12 @@ def main() -> None:
         except Exception:
             traceback.print_exc(file=sys.stderr)
             out = -1
+        finally:
+            # the finished stage's heartbeat source goes with it: a
+            # dead probe's service (and its device buffers) must not
+            # be retained — or keep reporting stale counters — through
+            # the remaining stages
+            _set_probe_source(None)
         print(f"[bench] {name} -> {out} ({time.perf_counter()-t0:.0f}s)",
               file=sys.stderr, flush=True)
         return out
@@ -1556,6 +1700,8 @@ def main() -> None:
         "bridge_votes_per_sec": bridge,
         "value_flood_votes_per_sec": flood,
         **_ANALYSIS,
+        **_compile_record(),
+        **_heartbeat_record(),
     }), flush=True)
     _EMITTED = True        # real verdict delivered; sentinel stands down
 

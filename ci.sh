@@ -202,7 +202,12 @@ echo "=== [3b/4] serve smoke gate (CPU, tiny shape ladder) ==="
 # the r5 failure mode) fails.
 SERVE_DIR="$(mktemp -d)"
 SERVE_RC=0
+# ISSUE 8: the smoke runs with the flight recorder's heartbeat ON
+# (1 s interval, file in the gate dir) and self-scrapes its /metrics
+# endpoint once — the observability asserts below ride this one run
 AGNES_BENCH_SERVE_SMOKE=1 AGNES_TPU_LEASE_PATH="$SERVE_DIR/tpu.lease" \
+  AGNES_HEARTBEAT_PATH="$SERVE_DIR/heartbeat.ndjson" \
+  AGNES_HEARTBEAT_INTERVAL_S=1 AGNES_SERVE_SMOKE_METRICS=1 \
   timeout -k 10 900 python bench.py > "$SERVE_DIR/serve.json" \
   2> "$SERVE_DIR/serve.err" || SERVE_RC=$?
 if [ "$SERVE_RC" -ne 0 ]; then
@@ -222,6 +227,50 @@ kind = "-1 sentinel (deadline contract)" if rec["value"] == -1 \
     else f"{rec['value']:.0f} votes/s"
 print(f"serve smoke gate OK: {kind}")
 PY
+echo "=== [3b'/4] observability gate (heartbeat schema + /metrics scrape) ==="
+# ISSUE 8: whatever the smoke's outcome (real value or deadline
+# sentinel), the flight recorder must have left a heartbeat NDJSON at
+# the armed path and EVERY line must pass the schema check (the same
+# parser `agnes-metrics` uses on a wedged round's trail); on a real
+# (non-sentinel) smoke the record must also prove one clean /metrics
+# scrape, the submit->decision p50/p99, and per-entry compile_ms —
+# real-value-or-sentinel, like gates [3c]/[3d].
+timeout -k 5 60 python scripts/agnes_metrics.py --check \
+  "$SERVE_DIR/heartbeat.ndjson"
+python - "$SERVE_DIR/serve.json" "$SERVE_DIR/heartbeat.ndjson" <<'PY'
+import json, sys
+rec = json.loads([l for l in open(sys.argv[1]).read().strip()
+                  .splitlines() if l][-1])
+assert rec.get("heartbeat_path"), rec
+hb = []
+for l in open(sys.argv[2]):
+    l = l.strip()
+    if not l:
+        continue
+    try:
+        hb.append(json.loads(l))
+    except ValueError:
+        pass   # trailing death-cut line: --check above already vetted
+assert hb, "heartbeat file holds no valid line"
+if rec["value"] == -1:
+    print(f"observability gate OK: {len(hb)} heartbeat line(s); "
+          f"scrape/latency asserts skipped (deadline sentinel)")
+else:
+    assert rec.get("metrics_scrape_ok") is True, rec
+    assert rec.get("serve_submit_to_decision_p50_s", 0) > 0, rec
+    assert rec.get("serve_submit_to_decision_p99_s", 0) > 0, rec
+    comp = [k for k in rec if k.startswith("compile_ms_")]
+    assert comp, "verdict record carries no compile_ms_<entry> keys"
+    print(f"observability gate OK: {len(hb)} heartbeat line(s), "
+          f"clean scrape of {rec['metrics_scrape_series']} series, "
+          f"e2e p50 {rec['serve_submit_to_decision_p50_s']:.4f}s / "
+          f"p99 {rec['serve_submit_to_decision_p99_s']:.4f}s, "
+          f"{len(comp)} compile_ms entries")
+PY
+# the human postmortem view, straight onto the gate log (what the
+# next wedged-round investigation will run against the round's trail)
+timeout -k 5 60 python scripts/agnes_metrics.py \
+  "$SERVE_DIR/heartbeat.ndjson" || true
 
 echo "=== [3c/4] mesh serve smoke gate (faked 2-device CPU mesh) ==="
 # ISSUE 3: the serve plane on a MESH — ThreadedVoteService event loop

@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""agnes_metrics: heartbeat postmortem / schema-check CLI (repo shim).
+
+The CLI logic lives in agnes_tpu/utils/metrics_cli.py (importable, so
+the `agnes-metrics` console entry point resolves from the installed
+package); this shim keeps the `scripts/agnes_metrics.py` invocation
+(ci.sh serve-smoke gate, docs) working from a repo checkout — the
+same arrangement as scripts/agnes_lint.py.  Everything imported here
+is jax-free stdlib, so the shim runs on a box whose accelerator
+stack is wedged.
+
+Usage:
+  scripts/agnes_metrics.py BENCH_heartbeat.ndjson      # postmortem
+  scripts/agnes_metrics.py --check heartbeat.ndjson    # schema gate
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from agnes_tpu.utils.metrics_cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,7 +84,8 @@ _CHEAP = (          # no XLA compiles (stdlib / numpy / ctypes / refs)
     "test_bench_deadline.py", "test_budget.py", "test_capi_fuzz.py",
     "test_ed25519_ref.py", "test_executor.py", "test_modelcheck.py",
     "test_native_core.py",
-    "test_native_ingest.py", "test_round_votes.py",
+    "test_native_ingest.py", "test_observability.py",
+    "test_round_votes.py",
     "test_serve.py", "test_serve_cache.py", "test_serve_threaded.py",
     "test_state_machine.py",
     "test_tpu_holders.py",

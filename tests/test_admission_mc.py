@@ -216,7 +216,7 @@ def _real_service(cfg: am.AdmissionMCConfig):
                                   np.zeros(I, np.int64)))
     dispatches = []
 
-    def stub(phases, lanes=None, exts=None, donate=True):
+    def stub(phases, lanes=None, exts=None, donate=True, tick=None):
         dispatches.append(
             (len(phases), lanes is None,
              tuple(np.asarray(p.slots).tobytes() for p in phases)))

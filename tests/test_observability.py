@@ -1,0 +1,612 @@
+"""ISSUE 8 observability plane: log-bucket latency histograms
+(quantile correctness vs a numpy reference, N-thread merge
+conservation), the bounded tracer ring + stable thread ids + flow
+events, the flight recorder's ring bounds and its crash-surviving
+heartbeat (a SIGKILLed child leaves a fresh parseable last line —
+the test_bench_deadline child-process pattern), the /metrics
+Prometheus endpoint (scrape parses, counters round-trip), tick-id
+correlation across submit -> dispatch -> settle on a stubbed serve
+tick, and the registry's first-dispatch compile-wall recording.
+
+Everything here runs with ZERO XLA compiles (device dispatch is
+stubbed; tier-1 cheap, conftest _CHEAP)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from agnes_tpu.utils import flightrec as fr
+from agnes_tpu.utils.metrics import (
+    Histogram,
+    Metrics,
+    SERVE_ADMIT_WAIT_S,
+    SERVE_BATCH_CLOSE_AGE_S,
+    SERVE_DISPATCH_WALL_S,
+    SERVE_E2E_DECISION_S,
+    SERVE_SETTLE_WALL_S,
+)
+from agnes_tpu.utils.metrics_http import (
+    MetricsServer,
+    parse_prometheus,
+    render_prometheus,
+)
+from agnes_tpu.utils.tracing import Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: one bucket's relative width (quantile error bound of the fixed
+#: log-bucket table) with a little slack for the numpy interpolation
+_BUCKET_RATIO = 2 ** (1.0 / Histogram.SUB) * 1.05
+
+
+# -- histogram ----------------------------------------------------------------
+
+def test_histogram_quantiles_vs_numpy_reference():
+    rng = np.random.default_rng(7)
+    vals = rng.lognormal(mean=-6.0, sigma=1.5, size=8000)
+    h = Histogram("lat")
+    for v in vals:
+        h.record(float(v))
+    assert h.n == len(vals)
+    assert h.vmax == float(vals.max())
+    assert abs(h.total - float(vals.sum())) < 1e-9 * vals.sum()
+    for q in (0.5, 0.9, 0.99):
+        ref = float(np.quantile(vals, q))
+        got = h.quantile(q)
+        assert 1 / _BUCKET_RATIO < got / ref < _BUCKET_RATIO, \
+            (q, ref, got)
+    assert h.quantile(1.0) == float(vals.max())     # exact max
+    snap = h.snapshot()
+    assert snap["count"] == len(vals) and snap["p99"] >= snap["p50"]
+
+
+def test_histogram_edge_values_clamp_not_lost():
+    h = Histogram()
+    h.record(0.0)                      # <= 0 clamps to bucket 0
+    h.record(1e-30)
+    h.record(1e9)                      # clamps to the top bucket
+    assert h.n == 3
+    buckets, total, n = h.prom_buckets()
+    assert n == 3 and buckets[-1][1] == 3        # cumulative reaches n
+
+
+def test_histogram_n_thread_merge_conservation():
+    """Per-thread histograms merged == one histogram fed everything:
+    bucket-for-bucket, plus count/sum/max — nothing lost or doubled."""
+    rng = np.random.default_rng(3)
+    chunks = [rng.lognormal(-5, 1.0, 500) for _ in range(4)]
+    parts = [Histogram(f"t{i}") for i in range(4)]
+
+    def worker(h, vals):
+        for v in vals:
+            h.record(float(v))
+
+    ts = [threading.Thread(target=worker, args=(h, c))
+          for h, c in zip(parts, chunks)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    merged = Histogram("merged")
+    for h in parts:
+        merged.merge(h)
+    ref = Histogram("ref")
+    for c in chunks:
+        for v in c:
+            ref.record(float(v))
+    assert merged.counts == ref.counts
+    assert merged.n == ref.n == 2000
+    assert merged.vmax == ref.vmax
+    assert abs(merged.total - ref.total) < 1e-9
+
+
+def test_histogram_shared_across_threads_conserves():
+    h = Histogram()
+    ts = [threading.Thread(
+        target=lambda: [h.record(0.001) for _ in range(1000)])
+        for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert h.n == 4000
+
+
+# -- Metrics: windowed snapshot (satellite) -----------------------------------
+
+def test_snapshot_window_uses_shared_interval_window():
+    m = Metrics()
+    m.count("x", 100)
+    m.snapshot(window=True)            # close the first window
+    m.count("x", 50)
+    s2 = m.snapshot(window=True)
+    assert s2["x"] == 150              # counters stay lifetime totals
+    assert s2["x_per_sec"] > 0         # rate covers the 50-delta window
+    s3 = m.snapshot(window=True)       # empty window right after
+    assert s3["x_per_sec"] == 0.0
+    # lifetime semantics unchanged (what bench's records rely on)
+    assert m.snapshot()["x_per_sec"] > 0
+
+
+def test_snapshot_window_keys_are_independent():
+    """Two periodic consumers (drain report vs heartbeat) must not
+    close each other's windows: the heartbeat's per-interval
+    consumption on its own key leaves the shared window covering the
+    whole run."""
+    m = Metrics()
+    m.count("x", 10)
+    hb = m.snapshot(window=True, window_key="heartbeat")
+    assert hb["x_per_sec"] > 0
+    s = m.snapshot(window=True)        # shared window: still intact
+    assert s["x_per_sec"] > 0
+    # and vice versa: the shared close did not reset the hb window
+    m.count("x", 5)
+    assert m.snapshot(window=True,
+                      window_key="heartbeat")["x_per_sec"] > 0
+
+
+def test_metrics_histogram_registry_and_snapshot_keys():
+    m = Metrics()
+    m.observe("lat_s", 0.01, 3)
+    assert m.histogram("lat_s").n == 3
+    snap = m.snapshot()
+    assert snap["lat_s_count"] == 3
+    for q in ("p50", "p90", "p99", "max"):
+        assert snap[f"lat_s_{q}"] > 0
+
+
+# -- tracer (satellite): ring, stable tids, flows -----------------------------
+
+def test_tracer_ring_bound_and_dropped_counter():
+    tr = Tracer(max_events=8)
+    for i in range(20):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr.spans) == 8
+    assert tr.dropped_events == 12
+
+
+def test_tracer_stable_tids_and_thread_name_metadata(tmp_path):
+    tr = Tracer()
+    with tr.span("main-span"):
+        pass
+
+    def side():
+        tr.name_thread("serve-submit")
+        with tr.span("side-span"):
+            pass
+
+    t = threading.Thread(target=side)
+    t.start()
+    t.join()
+    tr.flow("tick", 5, "s")
+    tr.flow("tick", 5, "t")
+    tr.flow("tick", 5, "f")
+    path = str(tmp_path / "t.json")
+    tr.write(path)
+    doc = json.load(open(path))
+    meta = {e["tid"]: e["args"]["name"]
+            for e in doc["traceEvents"] if e["ph"] == "M"}
+    # SMALL sequential ids, not hashed idents
+    assert set(meta) == {1, 2}
+    assert "serve-submit" in meta.values()
+    flows = [e for e in doc["traceEvents"] if e["ph"] in "stf"]
+    assert {e["ph"] for e in flows} == {"s", "t", "f"}
+    assert all(e["id"] == 5 for e in flows)
+    assert [e for e in flows if e["ph"] == "f"][0]["bp"] == "e"
+    assert tr.flow_phases(5) == {"s", "t", "f"}
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_flightrec_ring_bounds_and_monotone_counts():
+    rec = fr.FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.event("tick_open", tick=i)
+    rec.event("reject", overflow=3)
+    assert len(rec) == 8
+    assert rec.dropped == 13
+    assert rec.counts() == {"tick_open": 20, "reject": 1}
+    assert rec.last("tick_open")["tick"] == 19
+    assert [e["tick"] for e in rec.tail(kind="tick_open")] == \
+        list(range(13, 20))
+    with pytest.raises(ValueError):
+        fr.FlightRecorder(capacity=0)
+
+
+def test_heartbeat_lines_schema_and_sources(tmp_path):
+    path = str(tmp_path / "hb.ndjson")
+    rec = fr.FlightRecorder()
+    rec.event("compile", entry="e", ms=12.0)
+    m = Metrics()
+    m.count("serve_submitted", 5)
+    hb = fr.Heartbeat(path, interval_s=0.5, recorder=rec,
+                      sources=[lambda: m.snapshot(window=True),
+                               lambda: {"stage": "probe"}])
+    hb.beat()
+    hb.beat()
+    lines, bad = fr.read_heartbeat(path)
+    assert bad == [] and len(lines) == 2
+    last = lines[-1]
+    assert fr.validate_heartbeat_line(last) == []
+    assert last["seq"] == 1 and last["events"] == {"compile": 1}
+    assert last["serve_submitted"] == 5 and last["stage"] == "probe"
+    # a raising source is counted, never fatal
+    hb.sources.append(lambda: 1 / 0)
+    hb.beat()
+    lines, bad = fr.read_heartbeat(path)
+    assert bad == [] and lines[-1]["source_errors"] == 1
+
+
+def test_heartbeat_schema_rejects_malformed():
+    assert fr.validate_heartbeat_line([]) != []
+    assert any("missing" in p for p in
+               fr.validate_heartbeat_line({"v": 1}))
+    good = {"v": 1, "kind": "hb", "seq": 0, "t": 1.0, "pid": 1,
+            "uptime_s": 0.0}
+    assert fr.validate_heartbeat_line(good) == []
+    assert fr.validate_heartbeat_line({**good, "seq": "zero"}) != []
+    assert fr.validate_heartbeat_line({**good, "v": 99}) != []
+
+
+def test_heartbeat_atomic_rotation(tmp_path):
+    path = str(tmp_path / "hb.ndjson")
+    hb = fr.Heartbeat(path, interval_s=1.0, max_bytes=200)
+    for _ in range(8):
+        hb.beat()
+    assert os.path.exists(path + ".1")
+    lines, bad = fr.read_heartbeat(path)       # both halves parse
+    lines1, bad1 = fr.read_heartbeat(path + ".1")
+    assert bad == bad1 == [] and lines and lines1
+
+
+def test_heartbeat_survives_sigkill_with_fresh_last_line(tmp_path):
+    """The acceptance criterion: SIGKILL the process mid-run; the
+    heartbeat NDJSON's last line must be schema-valid and no older
+    than two heartbeat intervals (the child-process pattern of
+    tests/test_bench_deadline.py)."""
+    interval = 0.25
+    path = str(tmp_path / "hb.ndjson")
+    child = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from agnes_tpu.utils.flightrec import FlightRecorder, "
+        "Heartbeat\n"
+        "rec = FlightRecorder()\n"
+        f"hb = Heartbeat({path!r}, interval_s={interval}, "
+        "recorder=rec, sources=[lambda: {'stage': 'spin'}])\n"
+        "hb.start()\n"
+        "while True:\n"
+        "    rec.event('tick_open', tick=1)\n"
+        "    time.sleep(0.01)\n")
+    p = subprocess.Popen([sys.executable, "-c", child],
+                         stderr=subprocess.DEVNULL)
+    try:
+        # wait until the heartbeat is demonstrably alive (>= 2 lines),
+        # then catch it FRESH so the age assert below is about the
+        # recorder's guarantee, not this test's polling latency
+        deadline = time.monotonic() + 30
+        fresh = False
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                lines, _ = fr.read_heartbeat(path)
+                age = fr.last_line_age_s(path)
+                if len(lines) >= 2 and age is not None \
+                        and age < interval:
+                    fresh = True
+                    break
+            time.sleep(0.02)
+        assert fresh, "heartbeat never became fresh"
+        t_kill = time.time()
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    lines, bad = fr.read_heartbeat(path)
+    assert lines, "no valid heartbeat lines survived the kill"
+    # at most one trailing casualty (a line the kill cut mid-write)
+    assert len(bad) <= 1, bad
+    last = lines[-1]
+    assert fr.validate_heartbeat_line(last) == []
+    assert last["stage"] == "spin" and last["events"]["tick_open"] > 0
+    assert t_kill - last["t"] <= 2 * interval, \
+        f"last line {t_kill - last['t']:.2f}s stale at kill time"
+    # the postmortem renderer reads the same trail
+    post = fr.render_postmortem(path)
+    assert "stage at last beat: spin" in post
+
+
+# -- /metrics endpoint --------------------------------------------------------
+
+def test_metrics_endpoint_scrape_parses_and_roundtrips(tmp_path):
+    m = Metrics()
+    m.count("serve_submitted", 42)
+    m.count("serve_admitted", 40)
+    m.gauge("serve_queue_depth", 3.0)
+    h = m.histogram(SERVE_E2E_DECISION_S)
+    for v in (0.001, 0.002, 0.004, 0.4):
+        h.record(v)
+    srv = MetricsServer(m, extra_sources=(
+        lambda: {"compile_ms_consensus_step": 1234.5},))
+    port = srv.start()
+    try:
+        from urllib.request import urlopen
+
+        text = urlopen(f"http://127.0.0.1:{port}/metrics",
+                       timeout=10).read().decode()
+    finally:
+        srv.stop()
+    parsed = parse_prometheus(text)
+    assert parsed["serve_submitted"] == 42.0
+    assert parsed["serve_admitted"] == 40.0
+    assert parsed["serve_queue_depth"] == 3.0
+    assert parsed["compile_ms_consensus_step"] == 1234.5
+    assert parsed[f"{SERVE_E2E_DECISION_S}_count"] == 4.0
+    assert parsed[f'{SERVE_E2E_DECISION_S}_bucket{{le="+Inf"}}'] == 4.0
+    # cumulative bucket counts are monotone and end at _count
+    cum = [v for k, v in parsed.items()
+           if k.startswith(f"{SERVE_E2E_DECISION_S}_bucket")]
+    assert cum == sorted(cum) and cum[-1] == 4.0
+    # renderer emits TYPE lines for every family
+    assert "# TYPE serve_submitted counter" in text
+    assert f"# TYPE {SERVE_E2E_DECISION_S} histogram" in text
+
+
+def test_metrics_endpoint_404_off_path():
+    m = Metrics()
+    srv = MetricsServer(m)
+    port = srv.start()
+    try:
+        from urllib.error import HTTPError
+        from urllib.request import urlopen
+
+        with pytest.raises(HTTPError):
+            urlopen(f"http://127.0.0.1:{port}/other", timeout=10)
+    finally:
+        srv.stop()
+
+
+# -- tick correlation through a stubbed serve tick ----------------------------
+
+def _stub_service(tracer=None, rec=None):
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.serve import ShapeLadder, VoteService
+
+    I, V = 2, 4
+    d = DeviceDriver(I, V)
+    bat = VoteBatcher(I, V, n_slots=4)
+    svc = VoteService(
+        d, bat, None, ladder=ShapeLadder.plan(I, V, min_rung=8),
+        capacity=64, target_votes=8, max_delay_s=0.0,
+        window_predictor=lambda: (np.zeros(I, np.int64),
+                                  np.zeros(I, np.int64)),
+        tracer=tracer, flightrec=rec)
+    ticks = []
+
+    def stub(phases, lanes=None, exts=None, donate=True, tick=None):
+        ticks.append(tick)
+
+    d.step_async = stub
+    return svc, d, ticks
+
+
+def _honest_wire(I=2, V=4):
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+    n = I * V
+    return pack_wire_votes(inst, val, np.zeros(n), np.zeros(n),
+                           np.zeros(n), np.full(n, 7))
+
+
+def test_tick_id_correlates_submit_dispatch_settle():
+    """One serve tick, registry-level dispatch stubbed: the SAME
+    monotonic tick id must appear in the submit-side flow start, the
+    dispatch-side flow step, the settle-side flow end, the
+    step_async call, and the flight recorder's tick_open/tick_close
+    events — one connected lifecycle (ISSUE 8 tentpole)."""
+    tracer = Tracer()
+    rec = fr.FlightRecorder()
+    svc, d, ticks = _stub_service(tracer=tracer, rec=rec)
+    svc.submit(_honest_wire())
+    svc.pump()                         # stage (tick 1 opens)
+    svc.pump()                         # dispatch tick 1
+    svc.poll_decisions()               # settle tick 1
+    assert ticks == [1]                # step_async saw the tick id
+    assert tracer.flow_phases(1) == {"s", "t", "f"}
+    opens = rec.tail(kind="tick_open")
+    closes = rec.tail(kind="tick_close")
+    assert [e["tick"] for e in opens] == [1]
+    assert [e["tick"] for e in closes] == [1]
+    assert closes[0]["votes"] == 8 and closes[0]["e2e_s"] >= 0
+    # a second tick gets the NEXT id
+    svc.submit(_honest_wire())
+    svc.pump()
+    svc.pump()
+    svc.poll_decisions()
+    assert ticks == [1, 2]
+    assert tracer.flow_phases(2) == {"s", "t", "f"}
+
+
+def test_serve_latency_histograms_populate_and_drain_reports_them():
+    svc, d, _ = _stub_service()
+    svc.submit(_honest_wire())
+    svc.pump()
+    svc.pump()
+    svc.poll_decisions()
+    m = svc.metrics
+    for name in (SERVE_ADMIT_WAIT_S, SERVE_BATCH_CLOSE_AGE_S,
+                 SERVE_DISPATCH_WALL_S, SERVE_SETTLE_WALL_S,
+                 SERVE_E2E_DECISION_S):
+        assert m.histogram(name).n > 0, name
+    # admission wait weighted per record: all 8 admitted records
+    assert m.histogram(SERVE_ADMIT_WAIT_S).n == 8
+    assert m.histogram(SERVE_E2E_DECISION_S).n == 8
+    rep = svc.drain()
+    lat = rep["latency"]
+    assert lat[SERVE_E2E_DECISION_S]["count"] == 8
+    assert lat[SERVE_E2E_DECISION_S]["p99"] >= 0
+    # drain metrics are the WINDOWED snapshot (the satellite): its
+    # per_sec keys mirror into serve_rates_window from the same dict
+    assert rep["serve_rates_window"] == {
+        k: v for k, v in rep["metrics"].items()
+        if k.endswith("_per_sec")}
+    # quantile keys ride the snapshot for scrapes/heartbeats
+    assert f"{SERVE_E2E_DECISION_S}_p50" in rep["metrics"]
+
+
+def test_rejects_and_thread_failures_land_in_flight_ring():
+    rec = fr.FlightRecorder()
+    svc, d, _ = _stub_service(rec=rec)
+    # overflow: capacity 64 -> a 96-record submit rejects 32
+    big = b"".join(_honest_wire() for _ in range(12))
+    res = svc.submit(big)
+    assert res.rejected > 0
+    ev = rec.last("reject")
+    assert ev is not None and ev["overflow"] == res.rejected_overflow
+
+
+def test_compile_observer_single_and_weakly_held():
+    """The whole process registers exactly ONE registry compile
+    observer however many services come and go; recorders are held
+    WEAKLY (a discarded service's recorder is not retained), events
+    reach every live recorder exactly once."""
+    import gc
+
+    from agnes_tpu.device import registry
+    from agnes_tpu.serve import service as svc_mod
+
+    rec = fr.FlightRecorder()
+    n0 = len(registry._COMPILE_CBS)
+    _stub_service(rec=rec)
+    _stub_service(rec=rec)
+    dead = fr.FlightRecorder()
+    _stub_service(rec=dead)
+    assert len(registry._COMPILE_CBS) <= n0 + 1
+    n_live = len(svc_mod._COMPILE_RECORDERS)
+    del dead
+    gc.collect()
+    assert len(svc_mod._COMPILE_RECORDERS) == n_live - 1
+    saved = registry.compile_ms()
+    registry.reset_compile_ms()
+    try:
+        registry.record_compile_ms("__obs_test__", 7.0)
+        ev = rec.last("compile")
+        assert ev is not None and ev["entry"] == "__obs_test__"
+        assert rec.counts()["compile"] == 1        # exactly once
+    finally:
+        registry.reset_compile_ms()
+        for k, v in saved.items():
+            registry.record_compile_ms(k, v)
+
+
+# -- registry compile-wall recording (satellite) ------------------------------
+
+def test_registry_records_first_dispatch_wall_once():
+    from agnes_tpu.device import registry
+
+    name = "consensus_step_seq"
+    calls = []
+    with registry.override(name, jit=lambda *a, **kw: calls.append(1)):
+        saved = registry.compile_ms()
+        registry.reset_compile_ms()
+        try:
+            got = {}
+            registry.on_compile(lambda n, ms: got.setdefault(n, ms))
+            fn = registry.timed_entry(name)
+            fn()
+            assert name in registry.compile_ms()
+            assert got[name] == registry.compile_ms()[name]
+            first = registry.compile_ms()[name]
+            fn()                       # second call: no re-record
+            assert registry.compile_ms()[name] == first
+            # once recorded, timed_entry returns the RAW jit
+            assert registry.timed_entry(name) is registry.get(name).jit
+            # jit_entry stays identity-preserving (the lint/override
+            # seam) — never a wrapper
+            assert registry.jit_entry(name) is registry.get(name).jit
+            assert registry.compile_gauges()[
+                f"compile_ms_{name}"] == round(first, 1)
+        finally:
+            registry.reset_compile_ms()
+            for k, v in saved.items():
+                registry.record_compile_ms(k, v)
+    assert len(calls) == 2
+
+
+def test_step_async_emits_dispatch_event_with_tick_and_entry():
+    import jax.numpy as jnp
+
+    from agnes_tpu.device import registry
+    from agnes_tpu.device.encoding import DeviceMessage, I32
+    from agnes_tpu.device.step import N_STAGES, StepOutputs
+    from agnes_tpu.harness.device_driver import DeviceDriver
+
+    def stub_seq(state, tally, exts, phases, powers, total, pf, pv,
+                 advance_height=False):
+        P, I = phases.mask.shape[:2]
+        z = jnp.zeros((P, N_STAGES, I), I32)
+        return StepOutputs(state=state, tally=tally,
+                           msgs=DeviceMessage(tag=z, round=z, value=z,
+                                              aux=z))
+
+    d = DeviceDriver(2, 4)
+    rec = fr.FlightRecorder()
+    d.flightrec = rec
+    with registry.override("consensus_step_seq_donated", jit=stub_seq):
+        d.step_async([d.empty_phase()], tick=42)
+    ev = rec.last("dispatch")
+    assert ev is not None
+    assert ev["tick"] == 42
+    assert ev["entry"] == "consensus_step_seq_donated"
+
+
+# -- agnes-metrics CLI --------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "agnes_metrics.py"), *args],
+        capture_output=True, text=True, timeout=60)
+
+
+def test_agnes_metrics_cli_check_and_postmortem(tmp_path):
+    path = str(tmp_path / "hb.ndjson")
+    hb = fr.Heartbeat(path, interval_s=0.5,
+                      sources=[lambda: {"stage": "bench_pipeline"}])
+    hb.beat()
+    hb.beat()
+    r = _run_cli("--check", path)
+    assert r.returncode == 0, r.stderr
+    assert "heartbeat check OK" in r.stdout
+    r = _run_cli(path)
+    assert r.returncode == 0, r.stderr
+    assert "stage at last beat: bench_pipeline" in r.stdout
+    r = _run_cli("--json", path)
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["valid_lines"] == 2
+    # ONE TRAILING bad line is the abrupt-death artifact (a line the
+    # kill cut mid-write) — tolerated, the trail still checks out
+    with open(path, "a") as f:
+        f.write("not json at all\n")
+    r = _run_cli("--check", path)
+    assert r.returncode == 0, r.stderr
+    assert "tolerated" in r.stdout
+    # an INTERIOR bad line is corruption, not a death cut: FAIL
+    hb.beat()
+    r = _run_cli("--check", path)
+    assert r.returncode == 1
+    assert "BAD line" in r.stderr
+    # missing file: distinct error code
+    assert _run_cli("--check", str(tmp_path / "nope")).returncode == 2

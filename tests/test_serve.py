@@ -408,8 +408,8 @@ def test_pipeline_offladder_split_held_reentry():
         window_predictor=lambda: (np.full(I, box["base"], np.int64),
                                   np.zeros(I, np.int64)))
     lanes_seen = []
-    d.step_async = (lambda phases, lanes=None, exts=None, donate=True:
-                    lanes_seen.append(lanes))
+    d.step_async = (lambda phases, lanes=None, exts=None, donate=True,
+                    tick=None: lanes_seen.append(lanes))
 
     def wire(val_lo, round_):
         """Both classes of a half-tick: validators [val_lo, val_lo+4)
@@ -471,7 +471,7 @@ def test_pipeline_dispatch_failure_restores_staged_builds():
                                   np.zeros(I, np.int64)))
     calls = {"n": 0}
 
-    def flaky(phases, lanes=None, exts=None, donate=True):
+    def flaky(phases, lanes=None, exts=None, donate=True, tick=None):
         calls["n"] += 1
         if calls["n"] == 1:
             raise RuntimeError("transient dispatch error")

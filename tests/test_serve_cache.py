@@ -173,7 +173,7 @@ def _service(I=2, V=4, cache=True, **kw):
                       **kw)
     dispatches = []
 
-    def stub(phases, lanes=None, exts=None, donate=True):
+    def stub(phases, lanes=None, exts=None, donate=True, tick=None):
         dispatches.append(lanes)
         # mimic the real entry: rejected-lane handle per dispatch
         # (None for unsigned), overridable via d._forced_rejects
@@ -306,8 +306,8 @@ def test_preverified_multi_round_burst_chunks_to_warmed_shapes():
     n = I * V
     svc, d, bat, _ = _service(I, V)
     shapes = []
-    d.step_async = (lambda phases, lanes=None, exts=None, donate=True:
-                    shapes.append((len(phases), lanes)))
+    d.step_async = (lambda phases, lanes=None, exts=None, donate=True,
+                    tick=None: shapes.append((len(phases), lanes)))
     inst = np.repeat(np.arange(I), V)
     val = np.tile(np.arange(V), I)
     wire = b"".join(

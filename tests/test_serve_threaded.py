@@ -50,7 +50,7 @@ def _stubbed_service(I=4, V=8, **kw):
     svc = VoteService(d, bat, None, **kw)
     dispatched = []
 
-    def stub(phases, lanes=None, exts=None, donate=True):
+    def stub(phases, lanes=None, exts=None, donate=True, tick=None):
         dispatched.append(sum(int(np.asarray(p.mask).sum())
                               for p in phases))
 
@@ -132,7 +132,8 @@ def test_threaded_poll_decisions_exactly_once():
     svc, d, _ = _stubbed_service(I, V)
     bat = svc.batcher
 
-    def deciding_stub(phases, lanes=None, exts=None, donate=True):
+    def deciding_stub(phases, lanes=None, exts=None, donate=True,
+                      tick=None):
         d.stats.decided[:] = True      # the device latched everyone
         d.stats.decision_value[:] = 0
         d.stats.decision_round[:] = 0
@@ -180,7 +181,7 @@ def test_threaded_loop_failure_fails_closed():
     surfaces the exception in its report."""
     svc, d, _ = _stubbed_service()
 
-    def boom(phases, lanes=None, exts=None, donate=True):
+    def boom(phases, lanes=None, exts=None, donate=True, tick=None):
         raise RuntimeError("synthetic XLA death")
 
     d.step_async = boom

@@ -184,8 +184,13 @@ def test_tracer_chrome_trace(tmp_path):
     tr.write(path)
     with open(path) as f:
         doc = json.load(f)
-    names = [e["name"] for e in doc["traceEvents"]]
-    assert set(names) == {"outer", "inner", "device_scope"}
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {e["name"] for e in spans} == {"outer", "inner",
+                                          "device_scope"}
+    # ISSUE 8: stable per-thread ids + thread_name metadata rows
+    assert meta and all(e["name"] == "thread_name" for e in meta)
+    assert {e["tid"] for e in spans} <= {e["tid"] for e in meta}
     assert tr.total_us("outer") >= tr.total_us("inner")
 
 
