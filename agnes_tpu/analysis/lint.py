@@ -66,6 +66,23 @@ def _has_pragma(lines, lineno: int) -> bool:
     return lineno - 1 < len(lines) and PRAGMA in lines[lineno - 1]
 
 
+def package_modules(repo_root: str) -> List[str]:
+    """Every .py file of the package tree, repo-relative, sorted —
+    THE scan-root derivation every repo-wide pass shares (ISSUE 9:
+    scan roots used to be hand-maintained per pass, and the post-PR4
+    modules — analysis/admission_mc.py, utils/flightrec.py,
+    utils/metrics_http.py — silently fell outside lockcheck's list;
+    deriving from the tree means a new module is scanned the moment
+    the file exists)."""
+    pkg_root = os.path.join(repo_root, "agnes_tpu")
+    out: List[str] = []
+    for root, dirs, names in os.walk(pkg_root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        out.extend(os.path.relpath(os.path.join(root, n), repo_root)
+                   for n in names if n.endswith(".py"))
+    return sorted(out)
+
+
 # -- LINT001: host syncs in hot paths ----------------------------------------
 
 class _HotPathVisitor(ast.NodeVisitor):
@@ -114,10 +131,18 @@ class _HotPathVisitor(ast.NodeVisitor):
 def check_hot_paths(repo_root: str,
                     hot_paths: Optional[Dict[str, Set[str]]] = None
                     ) -> List[Finding]:
+    """LINT001 needs per-FUNCTION knowledge (which bodies run between
+    dispatches), so HOT_PATHS stays a curated map — but a key naming a
+    module that no longer exists is silent rot, reported as a finding
+    instead of skipped."""
     findings: List[Finding] = []
     for rel, hot in (hot_paths or HOT_PATHS).items():
         path = os.path.join(repo_root, rel)
         if not os.path.exists(path):
+            findings.append(Finding(
+                "lint", "LINT001", rel,
+                "HOT_PATHS names a module that does not exist — the "
+                "curated hot-path map has rotted; update lint.HOT_PATHS"))
             continue
         with open(path) as fh:
             src = fh.read()
@@ -188,35 +213,29 @@ def check_import_time_jits(repo_root: str,
         importer = importlib.import_module
 
     findings: List[Finding] = []
-    pkg_root = os.path.join(repo_root, "agnes_tpu")
-    for root, _, names in os.walk(pkg_root):
-        for name in sorted(names):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(root, name)
-            rel = os.path.relpath(path, repo_root)
-            with open(path) as fh:
-                src = fh.read()
-            jits = _module_level_jits(ast.parse(src, filename=rel))
-            if not jits:
-                continue
-            mod_name = rel[:-3].replace(os.sep, ".")
-            try:
-                mod = importer(mod_name)
-            except Exception as e:  # noqa: BLE001 — unimportable module
+    for rel in package_modules(repo_root):
+        with open(os.path.join(repo_root, rel)) as fh:
+            src = fh.read()
+        jits = _module_level_jits(ast.parse(src, filename=rel))
+        if not jits:
+            continue
+        mod_name = rel[:-3].replace(os.sep, ".")
+        try:
+            mod = importer(mod_name)
+        except Exception as e:  # noqa: BLE001 — unimportable module
+            findings.append(Finding(
+                "lint", "LINT002", rel,
+                f"module defines import-time jit(s) but failed to "
+                f"import for registration check: {e!r}"))
+            continue
+        for jname, lineno in jits:
+            obj = getattr(mod, jname, None)
+            if obj is None or not registered_check(obj):
                 findings.append(Finding(
-                    "lint", "LINT002", rel,
-                    f"module defines import-time jit(s) but failed to "
-                    f"import for registration check: {e!r}"))
-                continue
-            for jname, lineno in jits:
-                obj = getattr(mod, jname, None)
-                if obj is None or not registered_check(obj):
-                    findings.append(Finding(
-                        "lint", "LINT002", f"{rel}:{lineno}",
-                        f"import-time jit {jname!r} is not a "
-                        f"registered entry (device/registry.py) — the "
-                        f"jaxpr auditor cannot enumerate it"))
+                    "lint", "LINT002", f"{rel}:{lineno}",
+                    f"import-time jit {jname!r} is not a "
+                    f"registered entry (device/registry.py) — the "
+                    f"jaxpr auditor cannot enumerate it"))
     return findings
 
 
@@ -244,18 +263,12 @@ class _StaticKwVisitor(ast.NodeVisitor):
 
 def check_static_kwargs(repo_root: str) -> List[Finding]:
     findings: List[Finding] = []
-    pkg_root = os.path.join(repo_root, "agnes_tpu")
-    for root, _, names in os.walk(pkg_root):
-        for name in sorted(names):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(root, name)
-            rel = os.path.relpath(path, repo_root)
-            with open(path) as fh:
-                src = fh.read()
-            v = _StaticKwVisitor(rel, src)
-            v.visit(ast.parse(src, filename=rel))
-            findings.extend(v.findings)
+    for rel in package_modules(repo_root):
+        with open(os.path.join(repo_root, rel)) as fh:
+            src = fh.read()
+        v = _StaticKwVisitor(rel, src)
+        v.visit(ast.parse(src, filename=rel))
+        findings.extend(v.findings)
     return findings
 
 

@@ -177,13 +177,22 @@ def check_paths(paths) -> List[Finding]:
 
 
 def default_paths(repo_root: str) -> List[str]:
-    """The surfaces the two-lock discipline (and the no-bare-acquire
-    rule) applies to."""
+    """EVERY module of the package tree (lint.package_modules — the
+    shared scan-root derivation).  The old hand-maintained list (the
+    serve dir + utils/metrics.py) silently missed every threaded
+    module added after it was written — utils/flightrec.py's heartbeat
+    thread, utils/metrics_http.py's server, analysis/admission_mc.py —
+    exactly the modules where a bare .acquire() or an order inversion
+    would hide.  The rules are attribute-name-scoped (`_admission`/
+    `_device`) and pragma-tolerant, so the widened scan stays
+    false-positive-free; a new module is covered the moment the file
+    exists."""
     import os
 
-    return [os.path.join(repo_root, "agnes_tpu", "serve"),
-            os.path.join(repo_root, "agnes_tpu", "utils",
-                         "metrics.py")]
+    from agnes_tpu.analysis.lint import package_modules
+
+    return [os.path.join(repo_root, rel)
+            for rel in package_modules(repo_root)]
 
 
 # -- runtime instrumented-lock mode -------------------------------------------

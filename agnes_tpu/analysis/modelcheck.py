@@ -23,7 +23,15 @@ Exhaustive WITHIN the bounds of an `MCConfig`, nothing beyond them:
   * rounds <= `max_round` (rounds only advance off TIMEOUT_PRECOMMIT
     fires, which the action enumerator caps);
   * heights <= `max_height` (states where every node has advanced past
-    the bound stop expanding).
+    the bound stop expanding);
+  * a FIXED validator-set epoch schedule (`epochs`, ISSUE 9): per-
+    height-boundary power tables mirroring the device plane's
+    `set_validators` contract — tallies, DecisionCerts and the quorum
+    monitor are all indexed by the epoch live at the vote's height;
+  * at most `churn_budget` sleepy-churn naps (ISSUE 9, TOB-SVD's
+    sleepy model): ("s", j)/("w", j) actions — deliveries to an
+    asleep node hold, its timers freeze, a wake releases both —
+    budgeted exactly the way faults are.
 
 Within that envelope every interleaving is covered: the explorer is a
 depth-bounded DFS over the step-mode transition system with
@@ -67,10 +75,14 @@ it runs in the same pre-test ci.sh gate slot as agnes_lint, with the
 same frontier-sharded spawn-worker parallelism (`run_scope`) and the
 same deadline-bounded real-value-or-sentinel contract.
 
-Mutation self-test (`self_test` / `--self-test`): two doctored
-executors — one that decides without quorum, one that drops
-equivocation evidence — must each be caught, minimized, and must
-vanish when the same schedule replays on the honest executor.
+Mutation self-test (`self_test` / `--self-test`): doctored executors
+— deciding without quorum, dropping equivocation evidence, counting
+heads instead of power, tallying against the PREVIOUS validator-set
+epoch, treating a wake as a reboot — must each be caught, minimized,
+and must vanish when the same schedule replays on the honest
+executor; violations living past a height boundary (DEEP_MUTANTS)
+are walk-discovered on the doctored executor and share the same
+drill.
 """
 
 from __future__ import annotations
@@ -104,7 +116,27 @@ class MCConfig:
     quorum boundary — the committee-weight territory of PAPERS.md
     2004.12990 — and the monitors check the WEIGHTED predicates
     (DecisionCert weight vs total power), so a tally that counts heads
-    instead of power is a catchable bug (the weight-blind mutant)."""
+    instead of power is a catchable bug (the weight-blind mutant).
+
+    `epochs` (ISSUE 9) is a validator-set epoch schedule:
+    ((boundary_height, (power, ...)), ...) in original-index order —
+    at every height the tally weights/totals come from the epoch with
+    the largest boundary <= height (genesis `powers` below the first
+    boundary), mirroring the device plane's `set_validators`
+    height-boundary contract.  A boundary at height 0 models a set
+    rotated in at genesis whose table differs from the one the
+    rotation was seeded with — the cheapest scope in which a
+    stale-epoch tally is a reachable, catchable bug.
+
+    `churn_budget`/`churnable` open TOB-SVD's sleepy-participation
+    schedule space (arXiv 2310.11331): ("s", j)/("w", j) actions join
+    the explored alphabet, bounded exactly the way faults are — at
+    most `churn_budget` sleeps, `churnable` (sorted-set indices, like
+    `partition`) naming the nodes allowed to nap (None = every honest
+    node).
+
+    The three new knobs serialize ONLY when non-default so every
+    pre-epoch corpus entry regenerates bit-identical."""
 
     name: str
     n: int = 4
@@ -115,6 +147,9 @@ class MCConfig:
     partition: Optional[Tuple[Tuple[int, ...], ...]] = None
     get_value_base: int = 100
     powers: Optional[Tuple[int, ...]] = None
+    epochs: Optional[Tuple[Tuple[int, Tuple[int, ...]], ...]] = None
+    churn_budget: int = 0
+    churnable: Optional[Tuple[int, ...]] = None
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
@@ -123,6 +158,18 @@ class MCConfig:
             [list(g) for g in self.partition]
         if self.powers is not None:
             d["powers"] = list(self.powers)
+        # bit-stable serialization: pre-ISSUE-9 configs must produce
+        # the exact JSON they always did (corpus regeneration contract)
+        if self.epochs is None:
+            d.pop("epochs")
+        else:
+            d["epochs"] = [[h, list(pw)] for h, pw in self.epochs]
+        if not self.churn_budget:
+            d.pop("churn_budget")
+        if self.churnable is None:
+            d.pop("churnable")
+        else:
+            d["churnable"] = list(self.churnable)
         return d
 
     @classmethod
@@ -133,7 +180,16 @@ class MCConfig:
             d["partition"] = tuple(tuple(g) for g in d["partition"])
         if d.get("powers") is not None:
             d["powers"] = tuple(d["powers"])
+        if d.get("epochs") is not None:
+            d["epochs"] = tuple((int(h), tuple(pw))
+                                for h, pw in d["epochs"])
+        if d.get("churnable") is not None:
+            d["churnable"] = tuple(d["churnable"])
         return cls(**d)
+
+    def epochs_dict(self) -> Optional[Dict[int, Tuple[int, ...]]]:
+        """The schedule as the {boundary: powers} dict Network takes."""
+        return None if self.epochs is None else dict(self.epochs)
 
 
 def build_network(cfg: MCConfig,
@@ -154,9 +210,12 @@ def build_network(cfg: MCConfig,
         get_value=lambda h: base + h,
         verify_signatures=sign if verify is None else verify,
         sign_messages=sign,
-        executor_cls=executor_cls or ConsensusExecutor)
+        executor_cls=executor_cls or ConsensusExecutor,
+        epochs=cfg.epochs_dict())
     net.enable_step_mode(partition_groups=cfg.partition,
-                         max_height=cfg.max_height)
+                         max_height=cfg.max_height,
+                         churn_budget=cfg.churn_budget,
+                         churnable=cfg.churnable)
     if start:
         net.mc_start()
     return net
@@ -202,11 +261,24 @@ def _edge_violations(net: Network, snap: list) -> List[Violation]:
                     f"certificate"))
                 continue
             c = nd.decision_certs[i]
+            epoch_total = net.epoch_total_at(d.height)
             if (c.height, c.round, c.value) != (d.height, d.round,
                                                 d.value):
                 out.append(Violation(
                     "quorum", j,
                     f"certificate {c} does not match decision {d}"))
+            elif c.total != epoch_total:
+                # epoch-indexed check (ISSUE 9): the quorum must be
+                # denominated in the validator set LIVE at the vote's
+                # height — a cert totalled against any other epoch is
+                # the stale-epoch tally bug even if its own arithmetic
+                # clears +2/3
+                out.append(Violation(
+                    "quorum", j,
+                    f"decided {d.value} at (h={d.height}, r={d.round}) "
+                    f"with a certificate denominated {c.weight}/"
+                    f"{c.total} against a stale validator-set epoch "
+                    f"(live epoch total: {epoch_total})"))
             elif not 3 * c.weight > 2 * c.total:
                 out.append(Violation(
                     "quorum", j,
@@ -268,12 +340,15 @@ class SymmetryCapError(AssertionError):
 
 def relabel_action(act: tuple, perm: Sequence[int]) -> tuple:
     """An action's name under a node relabeling: deliveries carry
-    (src, dst), timeouts a node index; partition/heal are global."""
+    (src, dst), timeouts and sleep/wake a node index; partition/heal
+    are global."""
     k = act[0]
     if k == "d":
         return ("d", perm[act[1]], perm[act[2]])
     if k == "t":
         return ("t", perm[act[1]], *act[2:])
+    if k in ("s", "w"):
+        return (k, perm[act[1]])
     return act
 
 
@@ -286,7 +361,12 @@ class Symmetry:
     transition relation can tell nodes apart by:
 
       * behavior (byzantine policies are per-node),
-      * voting power (weights feed every quorum predicate),
+      * voting power in EVERY epoch window live inside the envelope
+        (weights feed every quorum predicate, per height —
+        validator-set epochs make power a function of height),
+      * sleepy-churn eligibility (a churnable node's enabled alphabet
+        includes ("s", j); relabeling it onto a pinned-awake node
+        would not be a bisimulation),
       * partition group (the ("p",) action's shape is fixed),
       * every proposer slot queryable inside the envelope: heights
         <= `h_cap`, rounds <= `max_round` (proposer identity is the
@@ -334,43 +414,62 @@ class Symmetry:
         return best, best_p
 
 
-def _decision_bound(net: Network) -> int:
+def _decision_bound(net: Network, max_height: int = 0) -> int:
     """A sound LOWER bound on the schedule length of any decision:
     the decider needs q-1 delivered value-precommits (q = fewest
     validators, heaviest first, whose power clears +2/3), and each of
     those q-1 precommitters needed q-1 delivered prevotes for its
     polka — all distinct delivery actions.  Behaviors only remove
-    messages and first-vote dedup blocks double counting, so no fault
-    model shortens this.  Holds for the HONEST quorum rule only — a
-    doctored executor may decide cheaper, so mutant explorations must
-    not lean on it (build_symmetry keeps their h_cap conservative)."""
-    powers = sorted((v.voting_power for v in net.vset), reverse=True)
-    total = sum(powers)
-    acc = q = 0
-    for w in powers:
-        acc += w
-        q += 1
-        if 3 * acc > 2 * total:
-            break
-    return q * (q - 1)
+    messages, first-vote dedup blocks double counting, and sleepy
+    churn only withholds deliveries, so no fault or churn schedule
+    shortens this.  With validator-set epochs the quorum size varies
+    per height, so the bound is the MINIMUM over every epoch live
+    within the envelope (heights 0..max_height+1) — a decision at any
+    reachable height needs at least its own epoch's q*(q-1).  Holds
+    for the HONEST quorum rule only — a doctored executor may decide
+    cheaper, so mutant explorations must not lean on it
+    (build_symmetry keeps their h_cap conservative)."""
+    def bound_at(height: int) -> int:
+        powers = sorted(net.epoch_powers_at(height), reverse=True)
+        total = sum(powers)
+        acc = q = 0
+        for w in powers:
+            acc += w
+            q += 1
+            if 3 * acc > 2 * total:
+                break
+        return q * (q - 1)
+
+    return min(bound_at(h) for h in range(max_height + 2))
 
 
 def build_symmetry(cfg: MCConfig,
                    executor_cls: Optional[type] = None,
                    max_perms: int = 24) -> Symmetry:
     """The symmetry group for `cfg` (sorted-index space).  Buckets the
-    honest, non-proposer-slot nodes by (power, partition group) and
-    permutes within buckets; the group size is capped at `max_perms`
-    (canonicalization costs one digest per perm per state) by fixing
-    lowest-index members of the largest bucket first — deterministic,
-    less reduction, never unsound."""
+    honest, non-proposer-slot nodes by their full distinguishing
+    profile and permutes within buckets; the group size is capped at
+    `max_perms` (canonicalization costs one digest per perm per state)
+    by fixing lowest-index members of the largest bucket first —
+    deterministic, less reduction, never unsound.
+
+    PER-EPOCH construction (ISSUE 9): interchangeable nodes must agree
+    on everything the transition relation can tell them apart by in
+    EVERY epoch window reachable inside the envelope — genesis power,
+    the power vector of each epoch live at heights <= h_cap, partition
+    group, proposer slots, and sleepy-churn eligibility (a churnable
+    node and a pinned-awake one have different enabled alphabets, so
+    relabeling across that line would not be a bisimulation).  The
+    epoch profile is read through `Network.epoch_powers_at` — the same
+    config-derived ground truth the monitors use — never through a
+    (possibly doctored) executor."""
     import itertools
     import math
 
     net = build_network(cfg, executor_cls)
     mutant = executor_cls is not None \
         and executor_cls is not ConsensusExecutor
-    if mutant or cfg.depth >= _decision_bound(net):
+    if mutant or cfg.depth >= _decision_bound(net, cfg.max_height):
         h_cap = cfg.max_height + 1
     else:
         h_cap = 0            # no decision fits the budget: heights pin
@@ -387,7 +486,10 @@ def build_symmetry(cfg: MCConfig,
     for i in range(cfg.n):
         if i in fixed or net.specs[i].behavior != "honest":
             continue
-        key = (net.specs[i].power, gid[i])
+        epoch_profile = tuple(net.epoch_powers_at(h)[i]
+                              for h in range(h_cap + 1))
+        churn_ok = (i in net._churnable) if cfg.churn_budget else None
+        key = (net.specs[i].power, epoch_profile, gid[i], churn_ok)
         buckets_by_key.setdefault(key, []).append(i)
     buckets = [b for b in buckets_by_key.values() if len(b) >= 2]
 
@@ -486,12 +588,20 @@ def _target(act: tuple) -> Optional[int]:
     """The node an action mutates, None for global actions."""
     if act[0] == "d":
         return act[2]
-    if act[0] == "t":
+    if act[0] in ("t", "s", "w"):
         return act[1]
     return None
 
 
 def _indep(a: tuple, b: tuple) -> bool:
+    if a[0] in ("s", "w") and b[0] in ("s", "w"):
+        # the shared churn budget couples churn actions: with one
+        # sleep left in the budget, taking ("s", j) DISABLES the
+        # sibling ("s", k) — the commuting diamond the sleep-set
+        # argument needs never closes, so churn-churn pairs stay
+        # dependent (deliveries/timeouts never touch the budget, so
+        # the distinct-target rule below remains exact for them)
+        return False
     ta, tb = _target(a), _target(b)
     return ta is not None and tb is not None and ta != tb
 
@@ -872,7 +982,11 @@ def corpus_entry(name: str, cfg: MCConfig, actions: Sequence[tuple],
                  origin: str) -> dict:
     """Serialize a schedule as a regression-corpus entry, stamping the
     honest host plane's outcome (decisions + evidence counts) so the
-    replay test asserts bit-stable semantics, not just liveness."""
+    replay test asserts bit-stable semantics, not just liveness.
+    Multi-height schedules (the epoch-boundary milestones) stamp every
+    height's decision under `decided_heights`; the key is OMITTED for
+    height-0-only entries so the pre-epoch corpus regenerates
+    bit-identical."""
     net, viols = run_with_monitors(cfg, actions)
     entry = {
         "name": name,
@@ -890,6 +1004,11 @@ def corpus_entry(name: str, cfg: MCConfig, actions: Sequence[tuple],
                 if nd.all_equivocations()},
         },
     }
+    if any(h != 0 for nd in net.nodes for h in nd.decided):
+        entry["expect"]["decided_heights"] = {
+            str(j): {str(h): [d.round, d.value]
+                     for h, d in sorted(nd.decided.items())}
+            for j, nd in enumerate(net.nodes) if nd.decided}
     return entry
 
 
@@ -921,6 +1040,13 @@ def replay_corpus_entry(entry: dict,
     assert got_ev == exp["evidence"], (
         f"{entry['name']}: evidence diverged: {got_ev} != "
         f"{exp['evidence']}")
+    if "decided_heights" in exp:
+        got_hs = {str(j): {str(h): [d.round, d.value]
+                           for h, d in sorted(nd.decided.items())}
+                  for j, nd in enumerate(net.nodes) if nd.decided}
+        assert got_hs == exp["decided_heights"], (
+            f"{entry['name']}: per-height decisions diverged: "
+            f"{got_hs} != {exp['decided_heights']}")
     assert sorted({v.property for v in viols}) == exp["violations"], (
         f"{entry['name']}: property verdicts diverged")
     return net, viols
@@ -930,12 +1056,16 @@ def device_replay_entry(entry: dict) -> list:
     """Replay a corpus entry's schedule through the PRODUCTION device
     plane: run the signed host network under trace taps, then push each
     node's exact processing stream through VoteBatcher -> fused device
-    step (harness/replay.py).  Returns (host net, [(node, host
-    Decision | None, ReplayResult)]).  Weighted configs hand the
-    sorted per-validator power vector to the replay so the device
-    tally counts the same quorum boundaries the host did.  This is
-    the ONLY modelcheck path that touches jax — imported lazily,
-    never from the CLI gate."""
+    step (harness/replay.py).  Returns (host net, [(node, {height:
+    host Decision}, ReplayResult)]).  Weighted configs hand the sorted
+    per-validator power vector to the replay so the device tally
+    counts the same quorum boundaries the host did; EPOCH configs
+    (ISSUE 9) hand the full height->powers table — the replay installs
+    each epoch through the real `set_validators` boundary calls
+    (driver + batcher) as the device advances heights, so host ==
+    device holds THROUGH a validator-set change.  This is the ONLY
+    modelcheck path that touches jax — imported lazily, never from
+    the CLI gate."""
     from agnes_tpu.harness.replay import replay_trace, trace_network
 
     cfg = MCConfig.from_json(entry["config"])
@@ -943,19 +1073,25 @@ def device_replay_entry(entry: dict) -> list:
     powers = None
     if any(v.voting_power != 1 for v in net.vset):
         powers = net.vset.device_arrays()[1]
+    epochs = None
+    if net.epochs:
+        # sorted-index epoch tables, exactly what the device planes eat
+        epochs = {h: list(pw) for h, pw in net.epochs.items()}
     traces = trace_network(net)
     net.run_schedule(entry["actions"])
     out = []
     for j, nd in enumerate(net.nodes):
-        rep = replay_trace(traces[j], n_validators=net.n, powers=powers)
-        out.append((j, nd.decided.get(0), rep))
+        rep = replay_trace(traces[j], n_validators=net.n, powers=powers,
+                           epochs=epochs)
+        out.append((j, dict(nd.decided), rep))
     return net, out
 
 
 def _walk_until(cfg: MCConfig,
                 pred: Callable[[Network], bool],
                 seed: int, max_steps: int = 600,
-                deliver_bias: Optional[float] = None
+                deliver_bias: Optional[float] = None,
+                executor_cls: Optional[type] = None
                 ) -> Optional[List[tuple]]:
     """Seeded guided random walk to a predicate state — the corpus
     generator's probe for goals DEEPER than the exhaustive bounds (a
@@ -963,11 +1099,14 @@ def _walk_until(cfg: MCConfig,
     depth stops well short).  Deterministic given (cfg, seed).
     `deliver_bias` is the probability of considering non-delivery
     actions at all — large N needs delivery-heavy walks (uniform
-    timeout churn wedges at the round cap before a quorum forms)."""
+    timeout churn wedges at the round cap before a quorum forms).
+    `executor_cls` runs the walk on a doctored executor — the
+    discovery probe for mutants whose violation lives past a height
+    boundary, beyond any exhaustively explorable depth."""
     import random
 
     rng = random.Random(seed)
-    net = build_network(cfg)
+    net = build_network(cfg, executor_cls)
     sched: List[tuple] = []
     for _ in range(max_steps):
         if pred(net):
@@ -987,6 +1126,18 @@ def _walk_until(cfg: MCConfig,
 
 def _all_decided(net: Network) -> bool:
     return all(0 in nd.decided for nd in net.nodes)
+
+
+def _all_decided_through_height_1(net: Network) -> bool:
+    return all(0 in nd.decided and 1 in nd.decided for nd in net.nodes)
+
+
+def _sleepy_recovery_decided(net: Network) -> bool:
+    """Everyone decided, at least one real nap happened, nobody is
+    still asleep (the woken node's decision proves it caught up on
+    the traffic the nap withheld)."""
+    return (_all_decided(net) and net._churn_used > 0
+            and not any(net._asleep))
 
 
 #: name -> (config, goal predicate, walk seed, deliver bias): the
@@ -1047,6 +1198,24 @@ CORPUS_GOALS: Dict[str, tuple] = {
                  behaviors=("honest",) * 7,
                  powers=(1, 1, 1, 1, 1, 2, 3)),
         _all_decided, 0, 0.05),
+    # epoch milestone (ISSUE 9): decisions at height 0 (genesis
+    # equal-weight set) AND height 1 (the (1, 3, 1, 1) epoch — heavy
+    # validator REQUIRED for any height-1 quorum), so the device
+    # replay crosses a real set_validators boundary: host == device
+    # must hold through the set change or the height-1 decision
+    # vanishes
+    "mc_epoch_set_change_decides": (
+        MCConfig(name="n4_epoch_boundary", depth=0, max_round=2,
+                 max_height=1, epochs=((1, (1, 3, 1, 1)),)),
+        _all_decided_through_height_1, 0, 0.1),
+    # sleepy-churn milestone (TOB-SVD): a full decision on a schedule
+    # carrying a real sleep/wake cycle — the serialized ("s", j)/
+    # ("w", j) actions ride the corpus codec, the deterministic host
+    # replay, and the device-plane trace replay forever
+    "mc_churn_sleepy_recovery_decides": (
+        MCConfig(name="n4_sleepy", depth=0, max_round=2,
+                 churn_budget=2),
+        _sleepy_recovery_decided, 0, 0.3),
 }
 
 
@@ -1143,13 +1312,49 @@ class WeightBlindExecutor(ConsensusExecutor):
         return 1
 
 
+class StaleEpochExecutor(ConsensusExecutor):
+    """Doctored: tallies every height against the PREVIOUS validator-
+    set epoch — `epoch_powers` looks one height back, so precommits
+    are counted with the powers (and denominated in the total) of the
+    set that was live BEFORE the boundary.  The exact bug class the
+    device plane's `set_validators` height-boundary contract exists to
+    prevent (harness/device_driver.py: "mid-height changes would mix
+    quorum denominators").  On a config whose epoch shifts weight onto
+    one validator, the light validators' old-set quorum no longer
+    clears the live set's +2/3 — the epoch-indexed cert monitor sees a
+    certificate denominated against the wrong epoch and fires."""
+
+    def epoch_powers(self, height: int):
+        return super().epoch_powers(height - 1)
+
+
+class WakeResetExecutor(ConsensusExecutor):
+    """Doctored: treats waking from a sleepy-churn nap as a REBOOT —
+    fresh round-0 state for the current height, lock and valid value
+    shredded, (round, step) position regressed.  The churn-blind
+    recovery bug class of TOB-SVD's sleepy model (a waking validator
+    must resume, not restart: restarting un-locks it and re-opens
+    equivocation/agreement windows the protocol had closed).  Caught
+    by the per-edge monotonicity monitor on the ("w", j) action."""
+
+    def on_wake(self) -> None:
+        self.state = sm.State.new(self.height)
+
+
 #: mutant name -> (executor class, property the monitors must catch it
 #: with, config the violation is reachable in).  The weight-blind
 #: config puts power 3 on one validator (original index 3 -> sorted
 #: index 2, the round-0 proposer under the weighted rotation): the
 #: three weight-1 validators form a head-count quorum (3 of 4) that
 #: holds only 3 of 6 power — the violation needs the full 11-action
-#: three-light protocol, hence the deeper bound.
+#: three-light protocol, hence the deeper bound.  The stale-epoch
+#: config rotates a (1, 3, 1, 1) set in AT height 0 (original index 1
+#: -> sorted index 0, a pinned proposer): the genesis table the
+#: rotation was seeded with is equal-weight, so a tally stuck one
+#: epoch back counts three lights as 3/4 when the live set makes them
+#: 3/6 — again the full three-light protocol, depth 11.  The
+#: wake-reset config needs only churn_budget=1: any position-advanced
+#: node that sleeps and wakes regresses immediately.
 MUTANTS: Dict[str, tuple] = {
     "decide_without_quorum": (
         QuorumlessExecutor, "quorum",
@@ -1165,6 +1370,35 @@ MUTANTS: Dict[str, tuple] = {
         MCConfig(name="mut_weight_blind", n=4,
                  behaviors=("honest",) * 4, powers=(1, 1, 1, 3),
                  depth=11, max_round=1)),
+    "decide_stale_epoch_quorum": (
+        StaleEpochExecutor, "quorum",
+        MCConfig(name="mut_stale_epoch", n=4,
+                 behaviors=("honest",) * 4,
+                 epochs=((0, (1, 3, 1, 1)),), depth=11, max_round=1)),
+    "wake_resets_round_state": (
+        WakeResetExecutor, "monotonic",
+        MCConfig(name="mut_wake_reset", n=4,
+                 behaviors=("honest",) * 4, churn_budget=1,
+                 depth=4, max_round=1)),
+}
+
+#: Deep-mutant registry: violations that live PAST a height boundary
+#: — beyond any exhaustively explorable depth (a height-0 decision
+#: alone costs ~25 actions) — discovered by a seeded guided walk on
+#: the doctored executor instead of the DFS, then ddmin-minimized and
+#: honest-replayed exactly like the explored mutants.  name ->
+#: (executor class, property, config, goal predicate, seed, bias).
+#: The cross-boundary stale-epoch drill: heights decide under the
+#: genesis set, then the (1, 3, 1, 1) epoch lands at height 1 and the
+#: stale tally keeps counting the old equal-weight set — its
+#: height-1 decision carries a cert denominated 3/4 against a live
+#: total of 6.
+DEEP_MUTANTS: Dict[str, tuple] = {
+    "stale_epoch_across_boundary": (
+        StaleEpochExecutor, "quorum",
+        MCConfig(name="mut_stale_epoch_deep", n=4, depth=0, max_round=2,
+                 max_height=1, epochs=((1, (1, 3, 1, 1)),)),
+        lambda net: any(1 in nd.decided for nd in net.nodes), 0, 0.1),
 }
 
 
@@ -1172,7 +1406,11 @@ def self_test(por: bool = True) -> dict:
     """Prove the monitors have teeth: each doctored executor must be
     caught, its counterexample must delta-minimize, and the minimized
     schedule must run CLEAN on the honest executor (the violation is
-    the mutation's, not the checker's)."""
+    the mutation's, not the checker's).  Explored mutants (MUTANTS)
+    are caught by the exhaustive DFS; deep mutants (DEEP_MUTANTS,
+    violations past a height boundary) by a seeded guided walk on the
+    doctored executor — both then share the exact
+    minimize/reproduce/honest-replay drill."""
     out = {}
     for name, (mut_cls, prop, cfg) in MUTANTS.items():
         rep = explore(cfg, executor_cls=mut_cls, por=por)
@@ -1182,21 +1420,45 @@ def self_test(por: bool = True) -> dict:
             f"mutant {name}: no {prop} violation in "
             f"{rep.states} states")
         ce = caught[0]
-        ce.minimized = minimize(cfg, ce.schedule, prop,
-                                executor_cls=mut_cls)
-        assert reproduces(cfg, ce.minimized, prop, executor_cls=mut_cls)
-        _, honest_viols = run_with_monitors(cfg, ce.minimized)
-        assert not honest_viols, (
-            f"mutant {name}: minimized schedule also violates on the "
-            f"honest executor: {honest_viols}")
-        out[name] = {
-            "property": prop,
-            "states_to_detection": rep.states,
-            "schedule_len": len(ce.schedule),
-            "minimized_len": len(ce.minimized),
-            "counterexample": ce.to_json(),
-        }
+        out[name] = _finish_mutant_record(
+            name, mut_cls, prop, cfg, ce, states=rep.states,
+            discovery="dfs")
+    for name, (mut_cls, prop, cfg, goal, seed, bias) in \
+            DEEP_MUTANTS.items():
+        sched = _walk_until(cfg, goal, seed, max_steps=1500,
+                            deliver_bias=bias, executor_cls=mut_cls)
+        assert sched is not None, f"deep mutant {name}: goal unreachable"
+        assert reproduces(cfg, sched, prop, executor_cls=mut_cls), (
+            f"deep mutant {name}: goal state shows no {prop} violation")
+        ce = Counterexample(cfg, Violation(prop, -1,
+                                           f"walk-discovered {name}"),
+                            list(sched))
+        out[name] = _finish_mutant_record(
+            name, mut_cls, prop, cfg, ce, states=len(sched),
+            discovery="walk")
     return out
+
+
+def _finish_mutant_record(name: str, mut_cls: type, prop: str,
+                          cfg: MCConfig, ce: Counterexample,
+                          states: int, discovery: str = "dfs") -> dict:
+    ce.minimized = minimize(cfg, ce.schedule, prop, executor_cls=mut_cls)
+    assert reproduces(cfg, ce.minimized, prop, executor_cls=mut_cls)
+    _, honest_viols = run_with_monitors(cfg, ce.minimized)
+    assert not honest_viols, (
+        f"mutant {name}: minimized schedule also violates on the "
+        f"honest executor: {honest_viols}")
+    return {
+        "property": prop,
+        "discovery": discovery,
+        # explored-state count for DFS-caught mutants; for the walk-
+        # discovered deep mutants the probe has no state count, so
+        # this is the walk's schedule length (see `discovery`)
+        "states_to_detection": states,
+        "schedule_len": len(ce.schedule),
+        "minimized_len": len(ce.minimized),
+        "counterexample": ce.to_json(),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -1207,10 +1469,12 @@ def self_test(por: bool = True) -> dict:
 #: box — must EXHAUST (complete=True) well inside the gate timeout
 #: while clearing the per-shard state floors the gate asserts.  One
 #: config per fault model plus a partition/heal drill, an N=7 shallow
-#: sweep, and (ISSUE 7) two WEIGHTED configs whose +2/3 boundary falls
+#: sweep, (ISSUE 7) two WEIGHTED configs whose +2/3 boundary falls
 #: between vote counts (power 3 on original index 3 -> sorted index 2:
 #: three weight-1 validators are a head-count majority with only 3/6
-#: of the power); every one stays within f < n/3 by weight.
+#: of the power), and (ISSUE 9) two validator-set EPOCH shards plus a
+#: sleepy-CHURN shard; every one stays within f < n/3 by weight in
+#: every live epoch.
 SMOKE_SCOPE: Tuple[MCConfig, ...] = (
     MCConfig(name="n4_honest", depth=10, max_round=1),
     MCConfig(name="n4_silent", depth=11, max_round=1,
@@ -1228,6 +1492,22 @@ SMOKE_SCOPE: Tuple[MCConfig, ...] = (
     MCConfig(name="n4_weighted_equiv", powers=(1, 1, 1, 3), depth=9,
              max_round=1,
              behaviors=("equivocator", "honest", "honest", "honest")),
+    # ISSUE 9 epoch shards: validator-set epochs live inside the
+    # envelope.  n4_epoch_shift rotates weight 3 onto original index 0
+    # at the height-1 boundary — original 0 sorts to index 1, a PINNED
+    # proposer slot, so sorted nodes {2, 3} stay interchangeable in
+    # BOTH epochs and the per-epoch symmetry group is real (|G| = 2).
+    # n4_epoch_genesis rotates (1, 3, 1, 1) in AT height 0 (the
+    # stale-epoch mutant's scope): the live set differs from the
+    # genesis table the network was seeded with from the first vote.
+    MCConfig(name="n4_epoch_shift", depth=10, max_round=1,
+             epochs=((1, (3, 1, 1, 1)),)),
+    MCConfig(name="n4_epoch_genesis", depth=9, max_round=1,
+             epochs=((0, (1, 3, 1, 1)),)),
+    # ISSUE 9 churn shard: TOB-SVD sleepy participation — one sleep in
+    # the budget opens ("s", j) for every honest node plus the paired
+    # wake, the largest alphabet extension in the scope
+    MCConfig(name="n4_churn1", depth=9, max_round=1, churn_budget=1),
 )
 
 #: PR 6's measured unreduced (por-only) visit counts on the shared
@@ -1244,6 +1524,12 @@ SYM_BASELINE_STATES: Dict[str, int] = {
     "n4_nil_flood": 50_932,
     "n4_partition_heal": 88_057,
     "n7_honest": 74_873,
+    # ISSUE 9: unreduced visit counts of the epoch/churn shards —
+    # the denominators of the PER-EPOCH orbit-reduction metric
+    # (`modelcheck_epoch_orbit_reduction` reads only the epoch ones)
+    "n4_epoch_shift": 94_290,
+    "n4_epoch_genesis": 46_252,
+    "n4_churn1": 164_617,
 }
 
 #: Unit-test / CLI-smoke scope: seconds, not minutes.
@@ -1253,6 +1539,9 @@ TINY_SCOPE: Tuple[MCConfig, ...] = (
              behaviors=("equivocator", "honest", "honest", "honest")),
     MCConfig(name="tiny_weighted", powers=(1, 1, 1, 3), depth=6,
              max_round=1),
+    MCConfig(name="tiny_epoch", depth=6, max_round=1,
+             epochs=((1, (3, 1, 1, 1)),)),
+    MCConfig(name="tiny_churn", depth=5, max_round=1, churn_budget=1),
 )
 
 #: Deep scope for workstation runs (not CI-gated): more rounds, deeper
@@ -1266,6 +1555,9 @@ FULL_SCOPE: Tuple[MCConfig, ...] = SMOKE_SCOPE + (
                         "honest", "honest", "honest")),
     MCConfig(name="n7_weighted", n=7, depth=5, max_round=1,
              behaviors=("honest",) * 7, powers=(1, 1, 1, 1, 1, 2, 3)),
+    MCConfig(name="n4_churn2", depth=8, max_round=1, churn_budget=2),
+    MCConfig(name="n4_epoch_churn", depth=8, max_round=1,
+             epochs=((1, (3, 1, 1, 1)),), churn_budget=1),
 )
 
 SCOPES = {"tiny": TINY_SCOPE, "smoke": SMOKE_SCOPE, "full": FULL_SCOPE}
@@ -1341,6 +1633,7 @@ def run_scope(scope: str, workers: Optional[int] = None, por: bool = True,
         ctx = mp.get_context("spawn")       # no forked interpreter state
         with ctx.Pool(processes=workers) as pool:
             results = pool.map(_scope_worker, tasks)
+    by_name = {c.name: c for c in configs}
     report = {
         "scope": scope,
         "por": por,
@@ -1354,18 +1647,34 @@ def run_scope(scope: str, workers: Optional[int] = None, por: bool = True,
                                 if r["kind"] == "consensus"),
         "admission_states": sum(r["states"] for r in results
                                 if r["kind"] == "admission"),
+        # ISSUE 9 domain splits: canonical states visited by the shards
+        # carrying validator-set epochs / a sleepy-churn budget (a shard
+        # can be in both; the ci.sh gate floors the COMBINED count)
+        "epoch_states": sum(
+            r["states"] for r in results if r["kind"] == "consensus"
+            and by_name[r["config"]].epochs is not None),
+        "churn_states": sum(
+            r["states"] for r in results if r["kind"] == "consensus"
+            and by_name[r["config"]].churn_budget > 0),
         "seconds": round(time.perf_counter() - t0, 1),
     }
-    # measured orbit reduction on the shared (PR 6 baseline) configs:
-    # only meaningful when those shards EXHAUSTED under symmetry
-    base = reduced = 0
+    # measured orbit reduction on the baselined configs — overall, and
+    # the PER-EPOCH slice (epoch shards only: the group there must be
+    # sound in EVERY epoch window, so its measured bite is its own
+    # metric).  Only meaningful where shards EXHAUSTED under symmetry.
+    base = reduced = ep_base = ep_reduced = 0
     for r in results:
         if r["kind"] == "consensus" and r["complete"] and sym \
                 and r["config"] in SYM_BASELINE_STATES:
             base += SYM_BASELINE_STATES[r["config"]]
             reduced += r["states"]
+            if by_name[r["config"]].epochs is not None:
+                ep_base += SYM_BASELINE_STATES[r["config"]]
+                ep_reduced += r["states"]
     report["sym_orbit_reduction"] = \
         round(base / reduced, 2) if reduced else -1
+    report["epoch_orbit_reduction"] = \
+        round(ep_base / ep_reduced, 2) if ep_reduced else -1
     report["ok"] = report["violations"] == 0
     return report
 
@@ -1438,6 +1747,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                        sym=not args.no_sym)
     from agnes_tpu.utils.metrics import (
         MODELCHECK_ADMISSION_STATES,
+        MODELCHECK_CHURN_STATES,
+        MODELCHECK_EPOCH_ORBIT_REDUCTION,
+        MODELCHECK_EPOCH_STATES,
         MODELCHECK_STATES_EXPLORED,
         MODELCHECK_SYM_ORBIT_REDUCTION,
         MODELCHECK_VIOLATIONS,
@@ -1448,6 +1760,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         MODELCHECK_VIOLATIONS: report["violations"],
         MODELCHECK_SYM_ORBIT_REDUCTION: report["sym_orbit_reduction"],
         MODELCHECK_ADMISSION_STATES: report["admission_states"],
+        MODELCHECK_EPOCH_STATES: report["epoch_states"],
+        MODELCHECK_CHURN_STATES: report["churn_states"],
+        MODELCHECK_EPOCH_ORBIT_REDUCTION:
+            report["epoch_orbit_reduction"],
     }
     report["deadline"] = {"source": deadline.source,
                           "budget_s": None if rem == float("inf")

@@ -62,6 +62,19 @@ def _valid_round(r: int, allow_neg1: bool = False) -> bool:
     return lo <= r <= _MAX_ROUND
 
 
+def epoch_boundary_at(epochs, height: int) -> Optional[int]:
+    """Largest epoch boundary <= `height`, or None when the genesis
+    set applies — THE boundary rule every plane shares (executor
+    tallies, the checker's config-derived monitors, the device
+    replay's set_validators install path).  `epochs` is any
+    height-keyed mapping (or None/empty)."""
+    best = None
+    for boundary in epochs or ():
+        if boundary <= height and (best is None or boundary > best):
+            best = boundary
+    return best
+
+
 # --- wire messages (the executor's inbound alphabet,
 # consensus_executor.rs:16-20, plus the identity/signature surface) ---------
 
@@ -218,6 +231,15 @@ class ConsensusExecutor:
     get_value : height -> value id to propose (the mempool stand-in;
         reference leaves value sourcing to the consumer).
     is_valid : value id -> bool (proposal validity, the :57 TODO).
+    epochs : optional validator-set epoch schedule — {boundary_height:
+        (power, ...)} in set (sorted) index order.  At every height h
+        the tally weights/totals come from the epoch with the largest
+        boundary <= h (the vset's genesis powers below the first
+        boundary) — the host-plane mirror of the device plane's
+        ``set_validators`` height-boundary contract
+        (harness/device_driver.py).  Identities (pubkeys, and hence
+        the proposer rotation) are epoch-invariant here: a power of 0
+        models removal, exactly like the device's static [V] table.
     """
 
     def __init__(self, vset: ValidatorSet, index: Optional[int],
@@ -226,7 +248,8 @@ class ConsensusExecutor:
                  is_valid: Callable[[int], bool] = lambda v: True,
                  timeout_config: TimeoutConfig = TimeoutConfig(),
                  start_height: int = 0,
-                 verify_signatures: bool = True):
+                 verify_signatures: bool = True,
+                 epochs: Optional[Dict[int, Tuple[int, ...]]] = None):
         self.vset = vset
         self.index = index
         self.seed = seed
@@ -234,6 +257,7 @@ class ConsensusExecutor:
         self.is_valid = is_valid
         self.tcfg = timeout_config
         self.verify_signatures = verify_signatures
+        self.epochs = epochs
 
         self.height = start_height
         self.state = sm.State.new(start_height)
@@ -260,20 +284,39 @@ class ConsensusExecutor:
 
     # -- tally construction / weighting (subclass seams) --------------------
 
+    def epoch_powers(self, height: int) -> Optional[Tuple[int, ...]]:
+        """The per-validator power vector live at `height` under the
+        epoch schedule, or None when the genesis (vset) powers apply.
+        Pure in (epochs, height) — the stale-epoch mutant overrides
+        the lookup height to model a node that keeps tallying against
+        the previous set after a boundary."""
+        best = epoch_boundary_at(self.epochs, height)
+        return None if best is None else self.epochs[best]
+
+    def epoch_total(self, height: int) -> int:
+        pw = self.epoch_powers(height)
+        return self.vset.total_power if pw is None else sum(pw)
+
     def _new_votes(self, height: int) -> VoteExecutor:
-        """The per-height tally.  A seam so doctored executors (the
-        model checker's mutation registry, analysis/modelcheck.py) can
-        install a miscounting tally without copying the height-advance
-        logic."""
+        """The per-height tally, denominated in the power total of the
+        validator-set epoch live at `height`.  A seam so doctored
+        executors (the model checker's mutation registry,
+        analysis/modelcheck.py) can install a miscounting tally
+        without copying the height-advance logic."""
         return VoteExecutor(height=height,
-                            total_weight=self.vset.total_power,
+                            total_weight=self.epoch_total(height),
                             edge_triggered=True)
 
     def _vote_weight(self, v: Vote) -> int:
-        """Voting power an identified inbound vote counts with.  The
+        """Voting power an identified inbound vote counts with — from
+        the epoch live at the node's CURRENT height (votes for other
+        heights never reach the tally, _on_vote's height screen).  The
         weight-blind mutant overrides this (and `_new_votes`) to count
         heads instead of power — the committee-weight bug class the
         quorum-cert monitor exists to catch."""
+        pw = self.epoch_powers(self.height)
+        if pw is not None:
+            return pw[v.validator]
         return self.vset[v.validator].voting_power
 
     # -- proposer schedule --------------------------------------------------
@@ -472,7 +515,8 @@ class ConsensusExecutor:
         rv = self.votes.votes.rounds.get(d.round)
         weight = rv.precommits.value_weight(d.value) if rv else 0
         self.decision_certs.append(DecisionCert(
-            self.height, d.round, d.value, weight, self.vset.total_power))
+            self.height, d.round, d.value, weight,
+            self.epoch_total(self.height)))
         # dedup: a restart restores live-height evidence into the archive,
         # and peers redelivering the same votes would re-detect it here
         seen = set(self.evidence)
@@ -492,6 +536,19 @@ class ConsensusExecutor:
         seen = set(self.evidence)
         return self.evidence + [e for e in self.votes.votes.equivocations()
                                 if e not in seen]
+
+    # -- sleepy participation (TOB-SVD churn model) --------------------------
+
+    def on_wake(self) -> None:
+        """Hook fired when the network wakes this node from a sleepy-
+        churn nap (harness/simulator.py ("w", j) action).  A correct
+        node does NOTHING here: its state machine position, lock, and
+        tally survived the nap untouched, and the gossip layer replays
+        the traffic it missed as ordinary deliveries.  The seam exists
+        for the model checker's churn-blind mutant — a node that
+        treats wake as a reboot (re-entering round 0, shredding its
+        lock) regresses (height, round, step) and the monotonicity
+        monitor catches it."""
 
     # -- timers -------------------------------------------------------------
 
@@ -544,6 +601,7 @@ class ConsensusExecutor:
         n.is_valid = self.is_valid
         n.tcfg = self.tcfg
         n.verify_signatures = self.verify_signatures
+        n.epochs = self.epochs
         n.height = self.height
         n.state = self.state
         n.votes = self.votes.clone()

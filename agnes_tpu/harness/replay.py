@@ -40,7 +40,8 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
-from agnes_tpu.core.executor import WireProposal, WireTimeout
+from agnes_tpu.core.executor import (WireProposal, WireTimeout,
+                                     epoch_boundary_at)
 from agnes_tpu.core.state_machine import EventTag, TimeoutStep
 from agnes_tpu.types import Vote
 
@@ -74,11 +75,17 @@ def trace_network(net) -> List[List[object]]:
 
 @dataclass
 class ReplayResult:
-    """Device-plane outcome of replaying one node's stream."""
+    """Device-plane outcome of replaying one node's stream.  The
+    scalar `decided`/`value`/`round` view is HEIGHT 0 (the single
+    height every pre-epoch replay covered); `decisions` carries every
+    height the device decided — height -> (round, value) — so the
+    cross-plane differential holds host == device THROUGH a
+    validator-set change."""
 
     decided: bool = False
     value: Optional[int] = None          # decoded value id
     round: Optional[int] = None
+    decisions: Dict[int, tuple] = field(default_factory=dict)
     equivocators: Set[int] = field(default_factory=set)
     steps: int = 0
     host_fallback_decisions: int = 0     # decided via PRECOMMIT_VALUE ext
@@ -86,26 +93,39 @@ class ReplayResult:
 
 def replay_trace(trace: List[object], n_validators: int,
                  powers: Optional[np.ndarray] = None,
-                 n_rounds: int = 4, n_slots: int = 4) -> ReplayResult:
+                 n_rounds: int = 4, n_slots: int = 4,
+                 epochs: Optional[Dict[int, object]] = None
+                 ) -> ReplayResult:
     """Replay one node's processed-message stream through the
     bridge + fused-device pipeline (the production device plane) and
-    return the height-0 outcome.
+    return the per-height outcomes.
 
     The device instance is built as a NON-proposer: the node's own
     proposal arrives in the trace as a re-entrant WireProposal and is
     injected as a PROPOSAL ext event, its own votes ride the dense
     phases like peer votes (device/step.py module docstring), and
-    timeouts fire exactly where the host TimerWheel fired them."""
+    timeouts fire exactly where the host TimerWheel fired them.
+
+    `epochs` is a validator-set epoch schedule {boundary_height:
+    [V] powers} in SORTED index order (the executor/simulator
+    contract, core/executor.py `epochs`): at every height the table
+    with the largest boundary <= height applies, `powers` (or
+    all-ones) below the first boundary.  Each boundary is installed
+    through the REAL epoch entry points — `DeviceDriver.
+    set_validators` between heights (after the deciding step, before
+    the next dispatch) and `VoteBatcher.set_validators` right after
+    the `sync_device` that advanced heights — so a replay across a
+    boundary exercises the exact call pattern a production height
+    change performs."""
     from agnes_tpu.bridge import VoteBatcher
     from agnes_tpu.harness.device_driver import DeviceDriver
 
     d = DeviceDriver(1, n_validators, n_rounds=n_rounds, n_slots=n_slots,
                      proposer_is_self=False, advance_height=True)
+    genesis = np.asarray(powers) if powers is not None \
+        else np.ones(n_validators, np.int64)
     if powers is not None:
-        import jax.numpy as jnp
-        from agnes_tpu.device.encoding import I32
-        d.powers = jnp.asarray(powers, I32)
-        d.total = jnp.asarray(int(np.sum(powers)), I32)
+        d.set_validators(powers)
     bat = VoteBatcher(1, n_validators, n_slots=n_slots, n_rounds=n_rounds,
                       powers=powers)
     res = ReplayResult()
@@ -113,20 +133,53 @@ def replay_trace(trace: List[object], n_validators: int,
     def height() -> int:
         return int(np.asarray(d.state.height)[0])
 
+    def epoch_powers_at(h: int) -> np.ndarray:
+        best = epoch_boundary_at(epochs, h)
+        return genesis if best is None \
+            else np.asarray(epochs[best], np.int64)
+
+    installed = {"driver": None, "batcher": None}
+
+    def install_epoch(which: str, setter) -> None:
+        """Idempotently adopt the epoch live at the device's CURRENT
+        height through the real `set_validators` boundary call."""
+        if not epochs:
+            return
+        h = height()
+        pw = epoch_powers_at(h)
+        if installed[which] is None or \
+                not np.array_equal(installed[which], pw):
+            setter(pw)
+            installed[which] = pw
+
     def after_step() -> None:
         res.steps += 1
-        if res.decided or not bool(d.stats.decided[0]):
-            return
-        # decode NOW: the next sync_device resets the slot maps for the
-        # advanced height.  Slot-space decisions decode through the
-        # batcher; host-fallback decisions carry the raw 31-bit value
-        # id in the lane (drain_host_events docstring) — value ids are
-        # content-derived/harness ints >= n_slots, so the ranges are
-        # disjoint.
-        dv = int(d.stats.decision_value[0])
-        res.decided = True
-        res.round = int(d.stats.decision_round[0])
-        res.value = bat.decode_slot(0, dv) if 0 <= dv < n_slots else dv
+        if bool(d.stats.decided[0]):
+            # the step entered at the height it decided; with
+            # advance_height the post-step height is already +1, so
+            # the decision belongs to height() - 1.  Decode NOW: the
+            # next sync_device resets the slot maps for the advanced
+            # height.  Slot-space decisions decode through the
+            # batcher; host-fallback decisions carry the raw 31-bit
+            # value id in the lane (drain_host_events docstring) —
+            # value ids are content-derived/harness ints >= n_slots,
+            # so the ranges are disjoint.
+            dec_h = height() - 1
+            dv = int(d.stats.decision_value[0])
+            rnd = int(d.stats.decision_round[0])
+            val = bat.decode_slot(0, dv) if 0 <= dv < n_slots else dv
+            res.decisions.setdefault(dec_h, (rnd, val))
+            if dec_h == 0:
+                res.decided, res.round, res.value = True, rnd, val
+            # unlatch so the NEXT height's decision records too
+            # (DriverStats latches the first decision per instance)
+            d.stats.decided[0] = False
+            d.stats.decision_round[0] = -1
+            # the decision advanced the height: adopt the new epoch
+            # before the next dispatch (the driver's between-heights
+            # contract; heights only move on decisions, so no other
+            # step can change the live epoch)
+            install_epoch("driver", d.set_validators)
 
     def step(ext=None, phase=None) -> None:
         d.step(ext=ext, phase=phase)
@@ -135,6 +188,10 @@ def replay_trace(trace: List[object], n_validators: int,
     def sync() -> None:
         bat.sync_device(np.asarray(d.tally.base_round),
                         np.asarray(d.state.height))
+        # right after the sync that (may have) advanced heights: the
+        # batcher's host-fallback tallies must quorum against the
+        # live epoch (bridge/ingest.py set_validators contract)
+        install_epoch("batcher", bat.set_validators)
 
     def drain() -> None:
         for inst, hgt, rnd, vid in bat.drain_host_events():
@@ -144,9 +201,9 @@ def replay_trace(trace: List[object], n_validators: int,
                 assert vid >= n_slots, (
                     f"value id {vid} collides with the slot range "
                     f"[0, {n_slots}); use larger value ids")
-                was_decided = res.decided
+                before = len(res.decisions)
                 step(ext=d.ext(int(EventTag.PRECOMMIT_VALUE), rnd, vid))
-                if res.decided and not was_decided:
+                if len(res.decisions) > before:
                     res.host_fallback_decisions += 1
 
     def pump() -> None:
@@ -179,6 +236,10 @@ def replay_trace(trace: List[object], n_validators: int,
                        np.int64))
         pump()
 
+    # genesis may itself sit past an epoch boundary (a set rotated in
+    # at height 0): adopt it before the entry dispatch
+    install_epoch("driver", d.set_validators)
+    install_epoch("batcher", bat.set_validators)
     step()                       # round-0 entry, like the host start()
     chunk: List[Vote] = []
     for msg in trace:

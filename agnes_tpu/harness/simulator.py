@@ -36,6 +36,7 @@ from agnes_tpu.core.executor import (
     TimeoutConfig,
     WireProposal,
     WireTimeout,
+    epoch_boundary_at,
 )
 from agnes_tpu.core.round_votes import Equivocation
 from agnes_tpu.core.state_machine import TimeoutStep
@@ -78,6 +79,14 @@ class Network:
     # swap in a doctored executor class (the mutation-test surface)
     sign_messages: bool = True
     executor_cls: type = ConsensusExecutor
+    # validator-set epoch schedule: {boundary_height: (power, ...)} in
+    # ORIGINAL (pre-sort) index order, like `specs`; re-indexed to the
+    # sorted set here and handed to every executor.  Powers below the
+    # first boundary come from the specs (genesis) set.  Identities
+    # and the proposer rotation are epoch-invariant (power 0 models
+    # removal — the device plane's static-[V]-table contract,
+    # device_driver.set_validators).
+    epochs: Optional[Dict[int, Sequence[int]]] = None
 
     def __post_init__(self):
         assert self.sign_messages or not self.verify_signatures, \
@@ -93,13 +102,20 @@ class Network:
         self.vset = ValidatorSet(
             [Validator(pk, self.specs[i].power)
              for i, (pk, _, _) in enumerate(keyed)])
+        if self.epochs is not None:
+            for h, pw in self.epochs.items():
+                assert len(pw) == self.n, (h, pw)
+            self.epochs = {
+                int(h): tuple(pw[orig] for _, _, orig in keyed)
+                for h, pw in sorted(self.epochs.items())}
         self.nodes: List[ConsensusExecutor] = [
             self.executor_cls(
                 self.vset, index=i,
                 seed=self.seeds[i] if self.sign_messages else None,
                 get_value=self.get_value,
                 timeout_config=self.timeout_config,
-                verify_signatures=self.verify_signatures)
+                verify_signatures=self.verify_signatures,
+                epochs=self.epochs)
             for i in range(self.n)]
         self._delivered = [0] * self.n
         self.dropped = 0
@@ -107,6 +123,21 @@ class Network:
         self._held_cross: List = []               # (target, msg) queue
         self.held_partition = 0
         self._step_mode = False
+
+    # -- validator-set epochs ------------------------------------------------
+
+    def epoch_powers_at(self, height: int) -> Tuple[int, ...]:
+        """The TRUE per-validator (sorted-index) power vector live at
+        `height` under the epoch schedule — computed from the config,
+        never through an executor, so the model checker's monitors can
+        hold a doctored (stale-epoch) executor against the real set."""
+        best = epoch_boundary_at(self.epochs, height)
+        if best is None:
+            return tuple(v.voting_power for v in self.vset)
+        return self.epochs[best]
+
+    def epoch_total_at(self, height: int) -> int:
+        return sum(self.epoch_powers_at(height))
 
     # -- fault models -------------------------------------------------------
 
@@ -266,6 +297,12 @@ class Network:
     #                           values stop mattering)
     #   ("p",)                  split into the configured partition groups
     #   ("h",)                  heal the partition
+    #   ("s", j)                node j falls asleep (TOB-SVD sleepy churn:
+    #                           deliveries to it hold, its timers freeze;
+    #                           bounded by the churn budget)
+    #   ("w", j)                node j wakes (held traffic becomes
+    #                           deliverable again, timers thaw, and the
+    #                           node's on_wake hook fires)
     #
     # Every action is followed by a deterministic re-route of all outboxes,
     # so the post-action state is a pure function of (initial config,
@@ -276,10 +313,19 @@ class Network:
     # ======================================================================
 
     def enable_step_mode(self, partition_groups=None, max_height: int = 1,
-                         max_partition_cycles: int = 1) -> None:
+                         max_partition_cycles: int = 1,
+                         churn_budget: int = 0,
+                         churnable=None) -> None:
         """Switch the router into externally-scheduled single-step mode
         (before `start()`).  `partition_groups` is the one partition
-        shape the ("p",) action applies, or None to disable it."""
+        shape the ("p",) action applies, or None to disable it.
+        `churn_budget` bounds the sleepy-churn alphabet the way the
+        partition cycle cap bounds ("p",): at most that many ("s", j)
+        sleep actions are ever enabled (wakes are free — each sleep
+        admits at most one), so the explored schedule space stays
+        finite.  `churnable` restricts which (sorted-index) nodes may
+        sleep; None = every honest node (byzantine behaviors already
+        own their fault models)."""
         assert not self._step_mode and not any(
             nd._started for nd in self.nodes)
         self._step_mode = True
@@ -288,6 +334,18 @@ class Network:
             tuple(tuple(sorted(g)) for g in partition_groups)
         self._max_partition_cycles = max_partition_cycles
         self._partition_cycles = 0
+        self._churn_budget = int(churn_budget)
+        self._churn_used = 0
+        self._asleep = [False] * self.n
+        if churnable is None:
+            self._churnable = frozenset(
+                i for i in range(self.n)
+                if self.specs[i].behavior == "honest")
+        else:
+            self._churnable = frozenset(int(i) for i in churnable)
+            bad = [i for i in self._churnable if not 0 <= i < self.n]
+            assert not bad, (
+                f"churnable indices {bad} out of range for n={self.n}")
         # height -> set of value ids any node ever put in a WireProposal
         # (recorded pre-behavior, so a silent proposer's value counts):
         # the validity monitor's ground truth
@@ -339,7 +397,7 @@ class Network:
         assert self._step_mode
         acts: List[tuple] = []
         for (i, j), q in sorted(self._channels.items()):
-            if q and not self._cross(i, j):
+            if q and not self._cross(i, j) and not self._asleep[j]:
                 acts.append(("d", i, j))
         if self._group is not None:
             acts.append(("h",))
@@ -347,9 +405,16 @@ class Network:
                 and self._group is None
                 and self._partition_cycles < self._max_partition_cycles):
             acts.append(("p",))
+        if self._churn_used < self._churn_budget:
+            for j in sorted(self._churnable):
+                if not self._asleep[j]:
+                    acts.append(("s", j))
+        for j in range(self.n):
+            if self._asleep[j]:
+                acts.append(("w", j))
         for j, node in enumerate(self.nodes):
-            if self.specs[j].behavior == "silent":
-                continue            # crash fault: the clock never fires
+            if self.specs[j].behavior == "silent" or self._asleep[j]:
+                continue    # crash fault / asleep: the clock never fires
             seen = set()
             for t in node.wheel.pending():
                 if not node.timer_live(t):
@@ -373,7 +438,7 @@ class Network:
         if kind == "d":
             _, i, j = act
             q = self._channels.get((i, j))
-            if not q or self._cross(i, j):
+            if not q or self._cross(i, j) or self._asleep[j]:
                 return False
             msg = q.pop(0)
             self._mc_track_delivery(j, msg)
@@ -381,10 +446,23 @@ class Network:
         elif kind == "t":
             _, j, h, r, s = act
             t = WireTimeout(h, r, TimeoutStep(s))
-            if self.specs[j].behavior == "silent" or \
-                    not self.nodes[j].wheel.remove(t):
+            if self.specs[j].behavior == "silent" or self._asleep[j] \
+                    or not self.nodes[j].wheel.remove(t):
                 return False
             self.nodes[j].execute(t)
+        elif kind == "s":
+            _, j = act
+            if (self._asleep[j] or j not in self._churnable
+                    or self._churn_used >= self._churn_budget):
+                return False
+            self._asleep[j] = True
+            self._churn_used += 1
+        elif kind == "w":
+            _, j = act
+            if not self._asleep[j]:
+                return False
+            self._asleep[j] = False
+            self.nodes[j].on_wake()
         elif kind == "p":
             if (self._group is not None
                     or self._mc_partition_groups is None
@@ -414,7 +492,7 @@ class Network:
     # -- schedule serialization --------------------------------------------
 
     _ACT_NAMES = {"d": "deliver", "t": "timeout", "p": "partition",
-                  "h": "heal"}
+                  "h": "heal", "s": "sleep", "w": "wake"}
     _ACT_CODES = {v: k for k, v in _ACT_NAMES.items()}
 
     @classmethod
@@ -461,6 +539,7 @@ class Network:
         net.verify_signatures = self.verify_signatures
         net.sign_messages = self.sign_messages
         net.executor_cls = self.executor_cls
+        net.epochs = self.epochs     # post-init form: sorted-index, frozen
         net.seeds = self.seeds
         net.vset = self.vset
         net.nodes = [nd.clone() for nd in self.nodes]
@@ -475,6 +554,10 @@ class Network:
         net._mc_partition_groups = self._mc_partition_groups
         net._max_partition_cycles = self._max_partition_cycles
         net._partition_cycles = self._partition_cycles
+        net._churn_budget = self._churn_budget
+        net._churn_used = self._churn_used
+        net._asleep = list(self._asleep)
+        net._churnable = self._churnable
         net._proposed = {h: set(v) for h, v in self._proposed.items()}
         net._dv = [{k: set(v) for k, v in d.items()} for d in self._dv]
         net._expected_ev = [set(s) for s in self._expected_ev]
@@ -518,6 +601,7 @@ class Network:
                           if q)
             group = None if self._group is None else tuple(self._group)
             ev = tuple(tuple(sorted(s)) for s in self._expected_ev)
+            asleep = tuple(self._asleep)
         else:
             by_pos = [None] * self.n
             for i, nd in enumerate(self.nodes):
@@ -539,6 +623,10 @@ class Network:
                 ev_pos[perm[i]] = tuple(sorted(
                     (perm[val], h, r, t) for (val, h, r, t) in s))
             ev = tuple(ev_pos)
+            sl = [False] * self.n
+            for i in range(self.n):
+                sl[perm[i]] = self._asleep[i]
+            asleep = tuple(sl)
         return (
             nodes,
             chans,
@@ -547,6 +635,8 @@ class Network:
             tuple(sorted((h, tuple(sorted(v)))
                          for h, v in self._proposed.items())),
             ev,
+            asleep,
+            self._churn_used,
         )
 
     def mc_digest(self, perm: Optional[Sequence[int]] = None) -> bytes:

@@ -329,6 +329,17 @@ MODELCHECK_VIOLATIONS = "modelcheck_violations"
 #: (analysis/admission_mc.py)
 MODELCHECK_SYM_ORBIT_REDUCTION = "modelcheck_sym_orbit_reduction"
 MODELCHECK_ADMISSION_STATES = "modelcheck_admission_states"
+#: ISSUE 9 additions (epoch-aware, sleepy-churn checking): canonical
+#: states visited by the smoke shards carrying validator-set epochs /
+#: a sleepy-churn budget, and the measured orbit reduction of the
+#: PER-EPOCH symmetry groups against their unreduced baselines
+#: (modelcheck.SYM_BASELINE_STATES epoch rows; -1 = not measured).
+#: ci.sh gate [1d] exports all three as AGNES_MODELCHECK_* env vars
+#: so bench verdict records can state that the epoch/churn envelope
+#: ran and ran clean — the same pattern as the four names above.
+MODELCHECK_EPOCH_STATES = "modelcheck_epoch_states"
+MODELCHECK_CHURN_STATES = "modelcheck_churn_states"
+MODELCHECK_EPOCH_ORBIT_REDUCTION = "modelcheck_epoch_orbit_reduction"
 #: ISSUE 8 observability plane — serve latency HISTOGRAMS (seconds;
 #: log-bucket `Histogram`s living in `Metrics.hists`, quantiles
 #: surfaced as `{name}_{p50,p90,p99,max,count}` snapshot keys and as

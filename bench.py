@@ -170,7 +170,16 @@ _ANALYSIS: dict = {"analysis_entries_audited": 0,
                    "modelcheck_sym_orbit_reduction": float(os.environ.get(
                        "AGNES_MODELCHECK_SYM_ORBIT_REDUCTION", -1)),
                    "modelcheck_admission_states": int(os.environ.get(
-                       "AGNES_MODELCHECK_ADMISSION_STATES", -1))}
+                       "AGNES_MODELCHECK_ADMISSION_STATES", -1)),
+                   # ISSUE 9: the epoch/churn shard state totals and the
+                   # per-epoch symmetry groups' measured orbit reduction
+                   # (-1 = gate not run), same export path
+                   "modelcheck_epoch_states": int(os.environ.get(
+                       "AGNES_MODELCHECK_EPOCH_STATES", -1)),
+                   "modelcheck_churn_states": int(os.environ.get(
+                       "AGNES_MODELCHECK_CHURN_STATES", -1)),
+                   "modelcheck_epoch_orbit_reduction": float(os.environ.get(
+                       "AGNES_MODELCHECK_EPOCH_ORBIT_REDUCTION", -1))}
 
 
 def _harvest_audit(driver) -> None:

@@ -91,8 +91,11 @@ echo "=== [1d/4] bounded model checker (exhaustive smoke scope, no XLA) ==="
 # SYMMETRY reduction (least-orbit digests over interchangeable honest
 # nodes; the reported orbit reduction is measured against PR 6's
 # unreduced baseline), WEIGHTED-validator scopes (asymmetric power
-# vectors moving every +2/3 boundary), and the serve-plane ADMISSION
-# model shards (AdmissionQueue/batcher/dedup-split soundness monitors,
+# vectors moving every +2/3 boundary), EPOCH shards (ISSUE 9:
+# validator-set changes at height boundaries, per-epoch symmetry
+# groups, epoch-indexed quorum certificates), sleepy-CHURN shards
+# (TOB-SVD sleep/wake schedules under a churn budget), and the
+# serve-plane ADMISSION model shards (AdmissionQueue/batcher/dedup-split soundness monitors,
 # analysis/admission_mc.py) — agreement/validity/quorum/monotonicity/
 # evidence + conservation/starvation/pbound/purity monitors on every
 # reachable state.  Pure CPU, zero jax imports, zero compiles; the CLI
@@ -101,7 +104,10 @@ echo "=== [1d/4] bounded model checker (exhaustive smoke scope, no XLA) ==="
 # like [3c]/[3d]).
 MC_JSON="$(mktemp -d)/agnes_modelcheck.json"
 MC_RC=0
-timeout -k 10 420 python scripts/agnes_modelcheck.py --scope smoke --json \
+# 540s: the ISSUE 9 epoch + churn shards add ~150k canonical states
+# (~100 worker-seconds) on top of the ISSUE 7 envelope; still
+# timeout-bounded, and the CLI degrades to a sentinel partial inside it
+timeout -k 10 540 python scripts/agnes_modelcheck.py --scope smoke --json \
   > "$MC_JSON" || MC_RC=$?
 if [ "$MC_RC" -ne 0 ]; then
   echo "model checker FAILED (rc=$MC_RC):"; tail -5 "$MC_JSON"; exit 1
@@ -128,24 +134,39 @@ if rep["complete"]:
     # deadline-sentinel partial is exempt (slow box, not a regression).
     assert rep["consensus_states"] >= 200_000, rep["consensus_states"]
     assert rep["admission_states"] >= 150_000, rep["admission_states"]
+    # ISSUE 9 floors: the epoch + churn shards must EXHAUST >= 100k
+    # combined canonical states (measured envelope ~154k: epoch ~71k,
+    # churn ~83k), and the PER-EPOCH symmetry groups must bite —
+    # reduction > 1 on the epoch shards (measured ~1.98x)
+    assert rep["epoch_states"] + rep["churn_states"] >= 100_000, \
+        (rep["epoch_states"], rep["churn_states"])
+    assert rep["epoch_orbit_reduction"] > 1, rep["epoch_orbit_reduction"]
     # the symmetry reduction must stay real: > 1.5x fewer visited
     # states than PR 6's unreduced baseline on the shared configs
     assert rep["sym_orbit_reduction"] > 1.5, rep["sym_orbit_reduction"]
 kind = "EXHAUSTED" if rep["complete"] else "partial (deadline sentinel)"
 print(f"model checker OK: {rep['states_explored']} canonical states "
       f"{kind} (consensus {rep['consensus_states']}, admission "
-      f"{rep['admission_states']}, orbit reduction "
-      f"{rep['sym_orbit_reduction']}x), 0 violations in "
+      f"{rep['admission_states']}, epoch {rep['epoch_states']}, churn "
+      f"{rep['churn_states']}, orbit reduction "
+      f"{rep['sym_orbit_reduction']}x overall / "
+      f"{rep['epoch_orbit_reduction']}x per-epoch), 0 violations in "
       f"{rep['seconds']}s ({rep['transitions']} transitions)")
 with open(sys.argv[2], "w") as f:
     f.write(f"{rep['states_explored']} {rep['violations']} "
-            f"{rep['sym_orbit_reduction']} {rep['admission_states']}\n")
+            f"{rep['sym_orbit_reduction']} {rep['admission_states']} "
+            f"{rep['epoch_states']} {rep['churn_states']} "
+            f"{rep['epoch_orbit_reduction']}\n")
 PY
-read -r MC_STATES MC_VIOLS MC_SYMRED MC_ADM < "$MC_NUMS"
+read -r MC_STATES MC_VIOLS MC_SYMRED MC_ADM MC_EPOCH MC_CHURN MC_EPRED \
+  < "$MC_NUMS"
 export AGNES_MODELCHECK_STATES_EXPLORED="${MC_STATES:?}"
 export AGNES_MODELCHECK_VIOLATIONS="${MC_VIOLS:?}"
 export AGNES_MODELCHECK_SYM_ORBIT_REDUCTION="${MC_SYMRED:?}"
 export AGNES_MODELCHECK_ADMISSION_STATES="${MC_ADM:?}"
+export AGNES_MODELCHECK_EPOCH_STATES="${MC_EPOCH:?}"
+export AGNES_MODELCHECK_CHURN_STATES="${MC_CHURN:?}"
+export AGNES_MODELCHECK_EPOCH_ORBIT_REDUCTION="${MC_EPRED:?}"
 
 echo "=== [2/4] full test suite (virtual 8-device CPU mesh) ==="
 # step 1 already ran the native differential + fuzz files under ASan

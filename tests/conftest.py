@@ -82,6 +82,7 @@ _CHEAP = (          # no XLA compiles (stdlib / numpy / ctypes / refs)
     "test_admission_mc.py",
     "test_analysis.py",
     "test_bench_deadline.py", "test_budget.py", "test_capi_fuzz.py",
+    "test_cli_shims.py",
     "test_ed25519_ref.py", "test_executor.py", "test_modelcheck.py",
     "test_native_core.py",
     "test_native_ingest.py", "test_observability.py",

@@ -395,6 +395,48 @@ def test_lockcheck_clean_on_repo():
     assert findings == [], [str(f) for f in findings]
 
 
+def test_scan_roots_derived_from_package_tree():
+    """ISSUE 9 satellite: the repo-wide passes derive their scan roots
+    from the package tree — no hand-maintained list to rot.  The
+    post-PR4 modules the old lockcheck list missed must be covered,
+    and a BRAND-NEW module dropped anywhere in the package must be
+    scanned the moment the file exists (both by the shared derivation
+    and by the lockcheck rules themselves)."""
+    mods = lint.package_modules(REPO)
+    for required in ("agnes_tpu/analysis/admission_mc.py",
+                     "agnes_tpu/utils/flightrec.py",
+                     "agnes_tpu/utils/metrics_http.py"):
+        assert required in mods, required
+    assert [os.path.join(REPO, m) for m in mods] == \
+        lockcheck.default_paths(REPO)
+
+    new_mod = os.path.join(REPO, "agnes_tpu", "utils",
+                           "_scanroot_probe_delete_me.py")
+    assert not os.path.exists(new_mod)
+    try:
+        with open(new_mod, "w") as fh:
+            fh.write("import threading\n"
+                     "lock = threading.Lock()\n"
+                     "def f():\n"
+                     "    lock.acquire()\n")
+        rel = os.path.relpath(new_mod, REPO)
+        assert rel in lint.package_modules(REPO)
+        findings = lockcheck.check_paths(lockcheck.default_paths(REPO))
+        assert any(f.code == "LOCK001" and rel in f.where
+                   for f in findings), [str(f) for f in findings]
+    finally:
+        os.remove(new_mod)
+
+
+def test_hot_path_map_rot_is_a_finding():
+    """A HOT_PATHS key naming a vanished module is reported, not
+    silently skipped (the drift the old `continue` hid)."""
+    findings = lint.check_hot_paths(
+        REPO, {"agnes_tpu/serve/_no_such_module.py": {"stage"}})
+    assert len(findings) == 1 and findings[0].code == "LINT001"
+    assert "rotted" in findings[0].message
+
+
 _BARE_ACQUIRE = """
 import threading
 lock = threading.Lock()
@@ -498,10 +540,13 @@ def test_lint_hot_path_sync_fixture(tmp_path):
     target = tmp_path / rel
     target.parent.mkdir(parents=True)
     target.write_text(_HOT_SYNC)
-    findings = lint.check_hot_paths(str(tmp_path))
+    # scope to the fixture's one file: the other default HOT_PATHS
+    # keys don't exist under tmp_path and would (correctly) report rot
+    one = {rel: lint.HOT_PATHS[rel]}
+    findings = lint.check_hot_paths(str(tmp_path), hot_paths=one)
     assert [f.code for f in findings] == ["LINT001"] * 3
     target.write_text(_HOT_SYNC_PRAGMA)
-    assert lint.check_hot_paths(str(tmp_path)) == []
+    assert lint.check_hot_paths(str(tmp_path), hot_paths=one) == []
 
 
 _ROGUE_JIT = """

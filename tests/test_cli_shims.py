@@ -1,0 +1,92 @@
+"""CLI shim drift tripwire (ISSUE 9 satellite).
+
+scripts/agnes_{modelcheck,lint,metrics}.py are thin repo shims over
+the packaged CLIs (the `agnes-*` console entry points in
+pyproject.toml).  Two copies of a dispatch are two chances to drift:
+a shim importing a stale symbol, or pyproject pointing at a renamed
+function, fails only at invocation time — usually inside a CI gate.
+These tests pin both sides to the SAME packaged `main` callable,
+cheaply (AST on the shims, importlib on the package; no subprocess,
+no jax for the jax-free CLIs — asserted)."""
+
+import ast
+import importlib
+import os
+import re
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: shim basename -> (packaged module, console-script name)
+SHIMS = {
+    "agnes_modelcheck.py": ("agnes_tpu.analysis.modelcheck",
+                            "agnes-modelcheck"),
+    "agnes_lint.py": ("agnes_tpu.analysis.lint_cli", "agnes-lint"),
+    "agnes_metrics.py": ("agnes_tpu.utils.metrics_cli",
+                         "agnes-metrics"),
+}
+
+
+def _shim_main_import(path):
+    """(module, names) of the `from X import main[, ...]` statement a
+    shim forwards through, plus whether __main__ calls main()."""
+    tree = ast.parse(open(path).read(), filename=path)
+    imports = [node for node in ast.walk(tree)
+               if isinstance(node, ast.ImportFrom)
+               and any(a.name == "main" for a in node.names)]
+    calls_main = any(
+        isinstance(node, ast.If)
+        and isinstance(node.test, ast.Compare)
+        and getattr(node.test.left, "id", "") == "__name__"
+        and any(isinstance(c, ast.Call)
+                and getattr(c.func, "id", "") == "main"
+                for b in node.body for c in ast.walk(b))
+        for node in tree.body)
+    return imports, calls_main
+
+
+@pytest.mark.parametrize("shim", sorted(SHIMS), ids=lambda s: s)
+def test_shim_forwards_to_packaged_main(shim):
+    mod_name, _ = SHIMS[shim]
+    path = os.path.join(REPO, "scripts", shim)
+    imports, calls_main = _shim_main_import(path)
+    assert imports, f"{shim} has no `from ... import main`"
+    assert imports[0].module == mod_name, (
+        f"{shim} forwards to {imports[0].module!r}, pyproject points "
+        f"the console script at {mod_name!r} — the two dispatches "
+        f"drifted")
+    assert calls_main, f"{shim} never calls main() under __main__"
+    # the forwarded-to symbol really exists and is callable
+    assert callable(getattr(importlib.import_module(mod_name), "main"))
+
+
+def test_console_scripts_match_shims():
+    """pyproject's [project.scripts] names the same module:main pairs
+    the shims forward to."""
+    text = open(os.path.join(REPO, "pyproject.toml")).read()
+    entries = dict(re.findall(
+        r'^(agnes-[\w-]+)\s*=\s*"([\w.]+):main"', text, re.M))
+    for shim, (mod_name, script) in SHIMS.items():
+        assert entries.get(script) == mod_name, (script, entries)
+
+
+def test_jax_free_shims_stay_jax_free():
+    """The modelcheck and metrics CLIs must be importable (and the
+    shims' forwarded mains resolvable) without jax entering the
+    interpreter — the ci.sh gate slot and the wedged-box postmortem
+    path both depend on it."""
+    import subprocess
+
+    code = (
+        "import importlib, sys\n"
+        "for m in ('agnes_tpu.analysis.modelcheck',"
+        " 'agnes_tpu.utils.metrics_cli'):\n"
+        "    assert callable(importlib.import_module(m).main)\n"
+        "assert 'jax' not in sys.modules, 'jax leaked into the CLIs'\n"
+        "print('SHIM-JAXFREE-OK')\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0 and "SHIM-JAXFREE-OK" in out.stdout, (
+        out.stdout, out.stderr)

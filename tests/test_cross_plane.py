@@ -111,27 +111,39 @@ def test_corpus_schedule_replays_identically_on_device_plane(entry):
     """Each corpus schedule runs on the SIGNED, verifying host plane
     under trace taps, then every node's exact processing stream goes
     through the production device path (VoteBatcher -> fused step).
-    Decisions must agree per node; device evidence must be a subset of
-    host evidence (same rule as _compare below)."""
+    Decisions must agree per (node, height) — the epoch-boundary
+    milestones (ISSUE 9) decide at heights 0 AND 1 across a real
+    `set_validators` set change, so the equality here IS the
+    host==device-through-an-epoch-boundary acceptance; device evidence
+    must be a subset of host evidence (same rule as _compare below)."""
     from agnes_tpu.analysis import modelcheck as mc
 
     net, results = mc.device_replay_entry(entry)
     exp = entry["expect"]["decided"]
-    for j, host_dec, rep in results:
+    exp_heights = entry["expect"].get("decided_heights")
+    for j, host_decs, rep in results:
         ctx = f"corpus={entry['name']} node={j}"
-        if host_dec is None:
-            assert not rep.decided, \
-                f"{ctx}: device decided, host did not"
-            continue
         # the signed replay must also match the stamped (unsigned,
         # model-checker-time) expectation — crypto must be transparent
-        assert [host_dec.round, host_dec.value] == exp[str(j)], (
-            f"{ctx}: signed host replay diverged from corpus stamp")
-        assert rep.decided, f"{ctx}: host decided {host_dec}, device did not"
-        assert rep.value == host_dec.value, (
-            f"{ctx}: value {rep.value} != host {host_dec.value}")
-        assert rep.round == host_dec.round, (
-            f"{ctx}: round {rep.round} != host {host_dec.round}")
+        if 0 in host_decs:
+            assert [host_decs[0].round, host_decs[0].value] == \
+                exp[str(j)], (
+                    f"{ctx}: signed host replay diverged from corpus "
+                    f"stamp")
+        else:
+            assert str(j) not in exp, (
+                f"{ctx}: corpus stamped a height-0 decision the "
+                f"signed host replay did not reach")
+        host_hr = {h: [d.round, d.value]
+                   for h, d in sorted(host_decs.items())}
+        if exp_heights is not None:
+            assert host_hr == {int(h): rv for h, rv in
+                               exp_heights.get(str(j), {}).items()}, (
+                f"{ctx}: signed host per-height decisions diverged "
+                f"from corpus stamp")
+        dev_hr = {h: [r, v] for h, (r, v) in rep.decisions.items()}
+        assert dev_hr == host_hr, (
+            f"{ctx}: device decisions {dev_hr} != host {host_hr}")
         host_ev = {e.validator
                    for e in net.nodes[j].all_equivocations()}
         assert rep.equivocators <= host_ev, (
@@ -222,6 +234,74 @@ def test_cross_plane_commit_from_any_round_via_host_fallback():
     assert rep.host_fallback_decisions == 1, (
         "decision must have come through the host-fallback path "
         "(round 0 is outside the rotated device window)")
+
+
+def test_cross_plane_epoch_table_threading_is_load_bearing():
+    """ISSUE 9: the replay must install validator-set epochs through
+    the real `set_validators` boundary calls — and the table must
+    MATTER.  Height 0 decides under the equal genesis set; at height 1
+    the epoch shifts weight 3 onto one peer, so three weight-1
+    precommits that would be a head-count quorum hold only 3/6 of the
+    live power.  The epoch-aware host does NOT decide height 1 and the
+    epoch-threaded device agrees — while the same trace replayed
+    WITHOUT the table (the pre-epoch replay) decides height 1, proving
+    the threading is load-bearing, not decorative."""
+    from agnes_tpu.core.executor import ConsensusExecutor, WireProposal
+    from agnes_tpu.core.validators import Validator, ValidatorSet
+    from agnes_tpu.crypto import ed25519_ref as ed
+    from agnes_tpu.types import Vote, VoteType
+
+    n = 4
+    seeds = [bytes([i + 1]) * 32 for i in range(n)]
+    vset = ValidatorSet([Validator(ed.keypair(s)[1], 1) for s in seeds])
+    probe = ConsensusExecutor(vset, index=None, seed=None,
+                              get_value=lambda h: 7,
+                              verify_signatures=False)
+    p0, p1 = probe.proposer(0, 0), probe.proposer(1, 0)
+    me = next(i for i in range(n) if i not in (p0, p1))
+    heavy = next(i for i in range(n) if i != me)
+    epochs = {1: tuple(3 if i == heavy else 1 for i in range(n))}
+    ex = ConsensusExecutor(vset, index=me, seed=None,
+                           get_value=lambda h: 7,
+                           verify_signatures=False, epochs=epochs)
+    trace = []
+    orig = ex.execute
+    ex.execute = lambda msg: (trace.append(msg), orig(msg))[1]
+    ex.start()
+    peers = [i for i in range(n) if i != me]
+    lights = [i for i in peers if i != heavy]
+
+    def vote(validator, height, round_, typ, value):
+        ex.execute(Vote(typ=typ, round=round_, value=value,
+                        validator=validator, height=height))
+
+    # height 0: a 3/4 equal-weight peer precommit quorum decides
+    # (commit-from-any-round — the decider needs no polka of its own)
+    for v in peers:
+        vote(v, 0, 0, VoteType.PRECOMMIT, 7)
+    assert ex.decided.get(0) is not None and ex.height == 1
+
+    # height 1: proposal + all-peer prevotes (own prevote follows the
+    # proposal; the polka is 6/6) -> ex precommits; then only the two
+    # LIGHT peers precommit: own 1 + 2 = 3 of the live 6 — no quorum
+    ex.execute(WireProposal(height=1, round=0, value=9, pol_round=-1,
+                            proposer=p1))
+    for v in peers:
+        vote(v, 1, 0, VoteType.PREVOTE, 9)
+    for v in lights:
+        vote(v, 1, 0, VoteType.PRECOMMIT, 9)
+    assert ex.decided.get(1) is None
+
+    rep = replay_trace(trace, n_validators=n,
+                       epochs={h: list(pw) for h, pw in epochs.items()})
+    host_hr = {h: [d.round, d.value] for h, d in ex.decided.items()}
+    assert {h: [r, v] for h, (r, v) in rep.decisions.items()} == host_hr
+    assert 0 in rep.decisions and 1 not in rep.decisions
+
+    blind = replay_trace(trace, n_validators=n)     # table withheld
+    assert 1 in blind.decisions, (
+        "without the epoch table the head-count quorum decides height "
+        "1 — the set_validators threading is what keeps host == device")
 
 
 def test_rounds_width_boundary_all_planes_agree():

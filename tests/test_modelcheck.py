@@ -140,6 +140,14 @@ SYM_CONFIGS = (
                 partition=((0, 1), (2, 3))),
     mc.MCConfig(name="sym_n7", n=7, depth=3, max_round=1,
                 behaviors=("honest",) * 7),
+    # ISSUE 9: the per-epoch group (weight shifts onto a pinned
+    # proposer slot at the height-1 boundary — nodes {2, 3} stay
+    # interchangeable in BOTH epochs) and the churn alphabet must
+    # preserve the orbit-set-equality contract too
+    mc.MCConfig(name="sym_epoch", depth=6, max_round=1,
+                epochs=((1, (3, 1, 1, 1)),)),
+    mc.MCConfig(name="sym_churn", depth=5, max_round=1,
+                churn_budget=1),
 )
 
 
@@ -223,12 +231,39 @@ def test_por_x_symmetry_flags_same_violations_as_full():
 
 
 def test_sym_baseline_covers_shared_smoke_configs():
-    """The orbit-reduction metric's baseline names exactly the PR 6
-    smoke configs still present in the scope (the weighted additions
-    are new, not baselined)."""
+    """The orbit-reduction metric's baseline names exactly the
+    baselined smoke configs still present in the scope: PR 6's six
+    plus the ISSUE 9 epoch/churn shards (the weighted additions
+    remain unbaselined); the per-epoch metric needs at least one
+    EPOCH shard in the baseline."""
     names = {c.name for c in mc.SMOKE_SCOPE}
     assert set(mc.SYM_BASELINE_STATES) <= names
-    assert len(mc.SYM_BASELINE_STATES) == 6
+    assert len(mc.SYM_BASELINE_STATES) == 9
+    by_name = {c.name: c for c in mc.SMOKE_SCOPE}
+    assert any(by_name[n].epochs is not None
+               for n in mc.SYM_BASELINE_STATES)
+
+
+def test_per_epoch_symmetry_group_shape():
+    """ISSUE 9 soundness boundary: interchangeable nodes must share
+    their power in EVERY epoch window live inside the envelope, and
+    their sleepy-churn eligibility.  Weight rotating onto a PINNED
+    proposer slot (original 0 -> sorted 1) keeps {2, 3} swappable;
+    onto a swap node (original 2 -> sorted 3) it pins the whole group;
+    a churnable-set split across the bucket pins it too."""
+    s = mc.build_symmetry(mc.MCConfig(
+        name="ge", depth=10, max_round=1, epochs=((1, (3, 1, 1, 1)),)))
+    assert len(s.perms) == 2 and s.perms[1] == (0, 1, 3, 2)
+    s2 = mc.build_symmetry(mc.MCConfig(
+        name="ge2", depth=10, max_round=1, epochs=((1, (1, 1, 3, 1)),)))
+    assert len(s2.perms) == 1
+    s3 = mc.build_symmetry(mc.MCConfig(
+        name="gc", depth=10, max_round=1, churn_budget=1,
+        churnable=(2,)))
+    assert len(s3.perms) == 1
+    s4 = mc.build_symmetry(mc.MCConfig(
+        name="gc2", depth=10, max_round=1, churn_budget=1))
+    assert len(s4.perms) == 2
 
 
 # ---------------------------------------------------------------------------
@@ -275,6 +310,144 @@ def test_weighted_smoke_slice_explores_clean():
     rep = mc.explore(cfg)
     assert rep.complete and not rep.violations
     assert rep.states > 500
+
+
+# ---------------------------------------------------------------------------
+# validator-set epochs + sleepy churn (ISSUE 9 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_config_roundtrips_and_moves_quorum_per_height():
+    cfg = mc.MCConfig(name="e", epochs=((1, (3, 1, 1, 1)),), depth=6,
+                      churn_budget=1, churnable=(0, 2))
+    assert mc.MCConfig.from_json(cfg.to_json()) == cfg
+    net = mc.build_network(cfg)
+    # genesis below the boundary, the rotated set at and past it
+    assert net.epoch_total_at(0) == 4
+    assert net.epoch_total_at(1) == 6 and net.epoch_total_at(5) == 6
+    assert sorted(net.epoch_powers_at(1)) == [1, 1, 1, 3]
+    # the height-1 quorum boundary falls between vote counts: the
+    # three weight-1 validators are a head-count majority with 3/6
+    from agnes_tpu.core.round_votes import is_quorum
+    assert not is_quorum(3, net.epoch_total_at(1))
+    assert is_quorum(5, net.epoch_total_at(1))
+
+
+def test_pre_epoch_config_json_is_bit_stable():
+    """The three ISSUE 9 knobs serialize ONLY when non-default —
+    every pre-epoch corpus entry must regenerate byte-identical."""
+    d = mc.MCConfig(name="w", powers=(1, 1, 1, 3), depth=6).to_json()
+    assert "epochs" not in d and "churn_budget" not in d \
+        and "churnable" not in d
+
+
+def test_churn_budget_bounds_the_sleep_alphabet():
+    """Sleeps are budgeted exactly like faults; an asleep node gets
+    no deliveries and fires no timers until its wake."""
+    cfg = mc.MCConfig(name="cb", depth=0, churn_budget=1)
+    net = mc.build_network(cfg)
+    acts0 = net.mc_enabled(max_round=1)
+    sleeps = [a for a in acts0 if a[0] == "s"]
+    assert len(sleeps) == 4            # every honest node may nap
+    # nap a node that has traffic waiting, so the hold is observable
+    j = next(a[2] for a in acts0 if a[0] == "d")
+    assert net.mc_apply(("s", j))
+    acts = net.mc_enabled(max_round=1)
+    assert not any(a[0] == "s" for a in acts)      # budget spent
+    assert [a for a in acts if a[0] == "w"] == [("w", j)]
+    assert not any(a[0] == "d" and a[2] == j for a in acts)
+    assert not any(a[0] == "t" and a[1] == j for a in acts)
+    assert net.mc_apply(("w", j))
+    assert any(a[0] == "d" and a[2] == j
+               for a in net.mc_enabled(max_round=1))
+
+
+def test_churn_schedule_serializes_and_replays_deterministically():
+    cfg = mc.MCConfig(name="chd", depth=0, max_round=2, churn_budget=2)
+    net, sched = _walk(cfg, seed=11, steps=90)
+    assert any(a[0] in ("s", "w") for a in sched), sched
+    js = [Network.action_to_json(a) for a in sched]
+    assert [Network.action_from_json(a) for a in js] == sched
+    net2 = mc.build_network(cfg)
+    net2.run_schedule(json.loads(json.dumps(js)))
+    assert net2.mc_digest() == net.mc_digest()
+
+
+def test_epoch_decisions_carry_epoch_denominated_certs():
+    """Positive monitor coverage ACROSS a set change: the milestone
+    schedule decides at heights 0 and 1, and each decision's
+    certificate is denominated in the total of the epoch live at ITS
+    height (4 at genesis, 6 past the boundary) — the invariant the
+    stale-epoch mutants break."""
+    cfg, pred, seed, bias = \
+        mc.CORPUS_GOALS["mc_epoch_set_change_decides"]
+    sched = mc._walk_until(cfg, pred, seed, max_steps=1500,
+                           deliver_bias=bias)
+    net, viols = mc.run_with_monitors(cfg, sched)
+    assert not viols
+    for nd in net.nodes:
+        totals = {c.height: c.total for c in nd.decision_certs}
+        assert totals == {0: 4, 1: 6}
+        for c in nd.decision_certs:
+            assert 3 * c.weight > 2 * c.total
+
+
+def test_stale_epoch_mutant_caught_minimized_and_honest_clean():
+    name = "decide_stale_epoch_quorum"
+    mut_cls, prop, cfg = mc.MUTANTS[name]
+    rep = mc.explore(cfg, executor_cls=mut_cls)
+    caught = [c for c in rep.violations if c.violation.property == prop]
+    assert caught, f"monitors missed the {name} mutant"
+    small = mc.minimize(cfg, caught[0].schedule, prop,
+                        executor_cls=mut_cls)
+    assert mc.reproduces(cfg, small, prop, executor_cls=mut_cls)
+    _, honest = mc.run_with_monitors(cfg, small)
+    assert not honest
+    # the epoch-indexed cert monitor named the real defect: a quorum
+    # denominated against the wrong validator-set epoch
+    assert "stale validator-set epoch" in caught[0].violation.detail
+
+
+def test_wake_reset_mutant_caught_minimized_and_honest_clean():
+    name = "wake_resets_round_state"
+    mut_cls, prop, cfg = mc.MUTANTS[name]
+    rep = mc.explore(cfg, executor_cls=mut_cls)
+    caught = [c for c in rep.violations if c.violation.property == prop]
+    assert caught, f"monitors missed the {name} mutant"
+    small = mc.minimize(cfg, caught[0].schedule, prop,
+                        executor_cls=mut_cls)
+    assert mc.reproduces(cfg, small, prop, executor_cls=mut_cls)
+    _, honest = mc.run_with_monitors(cfg, small)
+    assert not honest
+    # the minimized schedule is the sleep/wake cycle itself
+    assert {a[0] for a in small} <= {"s", "w", "d", "t"}
+    assert any(a[0] == "w" for a in small)
+
+
+def test_deep_stale_epoch_mutant_bites_across_the_boundary():
+    """The cross-boundary drill: the violation lives at height 1 —
+    past any exhaustively explorable depth — so it is walk-discovered
+    on the doctored executor, then minimized and honest-replayed like
+    every explored mutant."""
+    mut_cls, prop, cfg, goal, seed, bias = \
+        mc.DEEP_MUTANTS["stale_epoch_across_boundary"]
+    sched = mc._walk_until(cfg, goal, seed, max_steps=1500,
+                           deliver_bias=bias, executor_cls=mut_cls)
+    assert sched is not None
+    assert mc.reproduces(cfg, sched, prop, executor_cls=mut_cls)
+    small = mc.minimize(cfg, sched, prop, executor_cls=mut_cls)
+    assert mc.reproduces(cfg, small, prop, executor_cls=mut_cls)
+    _, honest = mc.run_with_monitors(cfg, small)
+    assert not honest
+    # the caught certificate is PAST the boundary: replaying the
+    # minimized schedule on the mutant shows a height-1 cert
+    # denominated against the genesis total
+    net, viols = mc.run_with_monitors(cfg, small,
+                                      executor_cls=mut_cls)
+    stale = [v for v in viols if v.property == prop]
+    assert stale and "stale validator-set epoch" in stale[0].detail
+    assert any(c.height == 1 and c.total == 4
+               for nd in net.nodes for c in nd.decision_certs)
 
 
 # ---------------------------------------------------------------------------
@@ -391,7 +564,7 @@ def test_mutation_detection_survives_por():
 
 def test_self_test_end_to_end():
     out = mc.self_test()
-    assert set(out) == set(mc.MUTANTS)
+    assert set(out) == set(mc.MUTANTS) | set(mc.DEEP_MUTANTS)
     for name, r in out.items():
         assert r["minimized_len"] <= r["schedule_len"]
         ce = r["counterexample"]
@@ -411,7 +584,7 @@ def test_self_test_end_to_end():
 def test_corpus_exists_and_covers_the_fault_space():
     entries = mc.load_corpus(CORPUS_DIR)
     names = {e["name"] for e in entries}
-    assert len(entries) >= 12, names
+    assert len(entries) >= 17, names
     behaviors = {b for e in entries for b in e["config"]["behaviors"]}
     assert {"equivocator", "nil_flood"} <= behaviors
     assert any(e["config"]["partition"] for e in entries)
@@ -426,10 +599,30 @@ def test_corpus_exists_and_covers_the_fault_space():
                 and len(set(e["config"]["powers"])) > 1]
     assert len(weighted) >= 2, names
     assert any(e["expect"]["decided"] for e in weighted)
+    # epoch milestones (ISSUE 9): a validator-set change at a height
+    # boundary with decisions stamped on BOTH sides of it
+    epoch = [e for e in entries if e["config"].get("epochs")]
+    assert len(epoch) >= 2, names
+    assert any("decided_heights" in e["expect"]
+               and all(set(hs) == {"0", "1"}
+                       for hs in e["expect"]["decided_heights"].values())
+               for e in epoch), names
+    # churn milestone (ISSUE 9): a serialized sleep/wake cycle rides
+    # the corpus codec, and the schedule still fully decides
+    churn = [e for e in entries if e["config"].get("churn_budget")]
+    assert len(churn) >= 2, names
+    sleepy = [e for e in churn
+              if {"sleep", "wake"} <=
+              {a[0] for a in e["actions"]}]
+    assert any(len(e["expect"]["decided"]) == e["config"]["n"]
+               for e in sleepy), names
     assert {n for n in names if n.startswith("mc_mut_")} == {
         "mc_mut_decide_without_quorum",
         "mc_mut_drop_equivocation_evidence",
-        "mc_mut_decide_weight_blind_quorum"}
+        "mc_mut_decide_weight_blind_quorum",
+        "mc_mut_decide_stale_epoch_quorum",
+        "mc_mut_wake_resets_round_state",
+        "mc_mut_stale_epoch_across_boundary"}
 
 
 @pytest.mark.parametrize("entry", mc.load_corpus(CORPUS_DIR),
@@ -486,7 +679,7 @@ def test_cli_self_test():
 
     rc, rep = _run_cli("--self-test", timeout=360)
     assert rc == 0 and rep["ok"]
-    assert set(rep["self_test"]) == set(mc.MUTANTS)
+    assert set(rep["self_test"]) == set(mc.MUTANTS) | set(mc.DEEP_MUTANTS)
     assert set(rep["self_test_admission"]) == set(ADMISSION_MUTANTS)
 
 
