@@ -85,6 +85,15 @@ from agnes_tpu.serve.queue import AdmissionQueue
 
 ADMISSION_PROPERTIES = ("conservation", "starvation", "pbound", "purity")
 
+#: ISSUE 10: the class-bucket extension reuses "conservation" (every
+#: FOLDED share is in exactly one of open-class / aggregate-dispatched
+#: / fallback-dispatched / forged-dropped) and "purity" (an aggregate
+#: dispatch may carry only a pairing-CLEARED class); the pairing
+#: verdict itself is an oracle boundary (crypto, not admission), so
+#: the model declares it per validator via `bls_forged` — the honest
+#: close routes forged classes down the per-share fallback exactly
+#: like serve/bls_lane.BlsLane.clear_classes.
+
 #: template = (instance, validator, round, typ); the wire value id is
 #: 100 + template index, which is how drained rows are re-identified
 _DEFAULT_TEMPLATES = (
@@ -113,10 +122,26 @@ class AdmissionMCConfig:
     starve_bound: int = 4      # eligible-age bound (pump ticks)
     window_rounds: int = 1     # how many ("w",) advances exist
     templates: Tuple[Tuple[int, int, int, int], ...] = _DEFAULT_TEMPLATES
+    # -- BLS class-bucket mode (ISSUE 10) --------------------------------
+    bls: bool = False
+    #: BLS share templates: (instance, validator, typ) at height 0,
+    #: round 0 — each (instance, typ) pair is one aggregate class
+    bls_templates: Tuple[Tuple[int, int, int], ...] = ()
+    bls_target: int = 2        # class size-close threshold (poll)
+    bls_max_classes: int = 2   # BlsClassTable bound
+    #: validators whose shares fail the (modeled) pairing — the honest
+    #: close falls their class back to per-share dispatch
+    bls_forged: Tuple[int, ...] = ()
+    #: validators without a verified proof of possession — their folds
+    #: are rejected at admission (bls_pop_missing)
+    bls_no_pop: Tuple[int, ...] = ()
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
         d["templates"] = [list(t) for t in self.templates]
+        d["bls_templates"] = [list(t) for t in self.bls_templates]
+        d["bls_forged"] = list(self.bls_forged)
+        d["bls_no_pop"] = list(self.bls_no_pop)
         d["kind"] = "admission"
         return d
 
@@ -125,11 +150,43 @@ class AdmissionMCConfig:
         d = dict(d)
         d.pop("kind", None)
         d["templates"] = tuple(tuple(t) for t in d["templates"])
+        d["bls_templates"] = tuple(
+            tuple(t) for t in d.get("bls_templates", ()))
+        d["bls_forged"] = tuple(d.get("bls_forged", ()))
+        d["bls_no_pop"] = tuple(d.get("bls_no_pop", ()))
         return cls(**d)
 
 
-_ACT_NAMES = {"s": "submit", "b": "pump", "v": "settle", "w": "window"}
+_ACT_NAMES = {"s": "submit", "b": "pump", "v": "settle", "w": "window",
+              "f": "fold", "c": "classes"}
 _ACT_CODES = {v: k for k, v in _ACT_NAMES.items()}
+
+
+class _McBlsRegistry:
+    """Stub BlsKeyRegistry surface for the class table (V / powers /
+    pop_ok / epoch) — the REAL registry decompresses device pubkey
+    limbs through the jax kernels, and this model must stay jax-free.
+    The table under check is the production BlsClassTable."""
+
+    def __init__(self, n_validators: int, no_pop=()):
+        self.V = int(n_validators)
+        self.powers = np.ones(self.V, np.int64)
+        self.pop_ok = np.ones(self.V, bool)
+        self.pop_ok[list(no_pop)] = False
+        self.forged_strikes = np.zeros(self.V, np.int64)
+        self.quarantined = np.zeros(self.V, bool)
+        self.epoch = 0
+
+
+@functools.lru_cache(maxsize=256)
+def _bls_share_bytes(idx: int) -> bytes:
+    """A VALID (on-twist, non-identity) 192-byte G2 share per template
+    index, so the fold's decode screen passes on the real path — not a
+    valid signature: the pairing verdict is modeled (`bls_forged`),
+    never computed.  Pure python (bls_ref is jax-free)."""
+    from agnes_tpu.crypto import bls_ref as ref
+
+    return ref.g2_to_bytes(ref.point_mul(2 + idx, ref.G2))
 
 
 @functools.lru_cache(maxsize=256)
@@ -175,6 +232,7 @@ class AdmissionSystem:
 
     #: stage classes — the mutation seams (ADMISSION_MUTANTS)
     queue_cls = AdmissionQueue
+    bls_table_cls = None       # default: serve.bls_lane.BlsClassTable
     #: chunk preverified builds to <= this many vote phases (the
     #: honest pipeline's _stage_preverified bound)
     preverified_chunk = 2
@@ -184,10 +242,22 @@ class AdmissionSystem:
         assert len(set(cfg.templates)) == len(cfg.templates), \
             "templates must be distinct (identity is the full tuple)"
         cache = VerifiedCache() if cfg.dedup else None
+        self.bls_table = None
+        if cfg.bls:
+            from agnes_tpu.serve.bls_lane import BlsClassTable
+
+            assert len(set(cfg.bls_templates)) == len(cfg.bls_templates)
+            reg = _McBlsRegistry(
+                1 + max(t[1] for t in cfg.bls_templates),
+                no_pop=cfg.bls_no_pop)
+            table_cls = self.bls_table_cls or BlsClassTable
+            self.bls_table = table_cls(
+                reg, cfg.n_instances,
+                max_classes=cfg.bls_max_classes, clock=lambda: 0.0)
         self.queue = self.queue_cls(
             cfg.n_instances, cfg.capacity,
             instance_cap=cfg.instance_cap, policy=cfg.policy,
-            cache=cache, clock=lambda: 0.0)
+            cache=cache, bls_table=self.bls_table, clock=lambda: 0.0)
         self.cache = cache
         T = len(cfg.templates)
         # template identity: the (instance, validator, round, typ)
@@ -214,11 +284,34 @@ class AdmissionSystem:
         # (P, signed, per-template counts, rows) per dispatch — the
         # edge monitors' subject; history, excluded from the digest
         self.dispatch_log: List[tuple] = []
+        # -- BLS class-bucket stage (ISSUE 10) ---------------------------
+        B = len(cfg.bls_templates)
+        self._bls_key = {t: k for k, t in enumerate(cfg.bls_templates)}
+        self._bls_wire = [self._pack_bls(k) for k in range(B)]
+        self.bls_submits = [0] * B
+        self.bls_folded = [0] * B          # accepted folds, per template
+        self.bls_agg = [0] * B             # aggregate-dispatched
+        self.bls_fallback = [0] * B        # fallback-dispatched (good)
+        self.bls_dropped = [0] * B         # forged, dropped at fallback
+        # ("agg"|"fallback", member templates, forged templates) per
+        # class close — the purity edge monitor's subject
+        self.bls_dispatch_log: List[tuple] = []
 
     # -- wire records --------------------------------------------------------
 
     def _pack(self, k: int) -> bytes:
         return _pack_template(self.cfg.templates[k])
+
+    def _pack_bls(self, k: int) -> bytes:
+        from agnes_tpu.serve.bls_lane import pack_bls_wire
+
+        inst, val, typ = self.cfg.bls_templates[k]
+        share = np.frombuffer(_bls_share_bytes(k), np.uint8)[None]
+        return pack_bls_wire(
+            np.asarray([inst], np.int64), np.asarray([val], np.int64),
+            np.zeros(1, np.int64), np.zeros(1, np.int64),
+            np.asarray([typ], np.int64),
+            np.asarray([100 + inst], np.int64), share)
 
     def _in_window(self, k: int) -> bool:
         return self.cfg.templates[k][2] <= self.window_round
@@ -248,6 +341,11 @@ class AdmissionSystem:
             acts.append(("v",))
         if self.window_round < self.cfg.window_rounds:
             acts.append(("w",))
+        for k in range(len(self.cfg.bls_templates)):
+            if self.bls_submits[k] < self.cfg.max_copies:
+                acts.append(("f", k))
+        if self.bls_table is not None and self.bls_table.open_classes:
+            acts.append(("c",))
         return acts
 
     def mc_apply(self, act: tuple) -> bool:
@@ -302,6 +400,21 @@ class AdmissionSystem:
                 return False
             self.window_round += 1
             return True
+        if kind == "f":
+            k = act[1]
+            if self.bls_submits[k] >= self.cfg.max_copies:
+                return False
+            self.bls_submits[k] += 1
+            res = self.queue.submit_bls(self._bls_wire[k])
+            if res.accepted:
+                self.bls_folded[k] += 1
+            return True
+        if kind == "c":
+            if self.bls_table is None \
+                    or not self.bls_table.open_classes:
+                return False
+            self._close_classes()
+            return True
         raise ValueError(f"unknown admission action {act!r}")
 
     # -- the pump tick (drain -> split -> build -> dispatch -> age) ----------
@@ -353,6 +466,42 @@ class AdmissionSystem:
         for r in self.pending:
             if self._in_window(r.template):
                 r.age += 1
+
+    #: mutation seam: a True here dispatches EVERY closed class as a
+    #: cleared aggregate, forged shares included (the purity mutant)
+    bls_pairing_blind = False
+
+    def _close_classes(self) -> None:
+        """One class-close tick: size-closed classes leave the table
+        and dispatch — pairing-CLEARED classes as ONE aggregate,
+        classes containing a (declared) forged share down the
+        per-share fallback with the forged shares dropped and the
+        honest remainder dispatched — the BlsLane.clear_classes
+        routing, with the pairing verdict read from `bls_forged`."""
+        closed = self.bls_table.poll(
+            now=0.0, target_signers=self.cfg.bls_target,
+            max_delay_s=1e9)
+        forged = set(self.cfg.bls_forged)
+        for cls in closed:
+            inst, _h, _r, typ, _val = cls.key
+            members, bad = [], []
+            for v in sorted(cls.shares):
+                k = self._bls_key.get((inst, v, typ))
+                if k is None:
+                    continue
+                (bad if v in forged else members).append(k)
+            if not bad or self.bls_pairing_blind:
+                for k in members + bad:
+                    self.bls_agg[k] += 1
+                self.bls_dispatch_log.append(
+                    ("agg", tuple(members + bad), tuple(bad)))
+            else:
+                for k in members:
+                    self.bls_fallback[k] += 1
+                for k in bad:
+                    self.bls_dropped[k] += 1
+                self.bls_dispatch_log.append(
+                    ("fallback", tuple(members), tuple(bad)))
 
     def _split(self, rows: List[_Row]) -> Tuple[List[_Row], List[_Row]]:
         """Partition pending into (pre-verified, fresh), preserving
@@ -411,6 +560,17 @@ class AdmissionSystem:
         s.cache = None if self.cache is None else self.cache.mc_clone()
         s.queue = self.queue.mc_clone()
         s.queue.cache = s.cache
+        s.bls_table = (None if self.bls_table is None
+                       else self.bls_table.mc_clone())
+        s.queue.bls_table = s.bls_table
+        s._bls_key = self._bls_key
+        s._bls_wire = self._bls_wire
+        s.bls_submits = list(self.bls_submits)
+        s.bls_folded = list(self.bls_folded)
+        s.bls_agg = list(self.bls_agg)
+        s.bls_fallback = list(self.bls_fallback)
+        s.bls_dropped = list(self.bls_dropped)
+        s.bls_dispatch_log = list(self.bls_dispatch_log)
         s._wire = self._wire
         s._tmpl_key = self._tmpl_key
         s.submits = list(self.submits)
@@ -439,6 +599,11 @@ class AdmissionSystem:
             self.window_round,
             tuple(tuple((k, i) for k, _d, i in b)
                   for b in self.unsettled),
+            None if self.bls_table is None
+            else (self.bls_table.mc_canonical(),
+                  tuple(self.bls_submits), tuple(self.bls_folded),
+                  tuple(self.bls_agg), tuple(self.bls_fallback),
+                  tuple(self.bls_dropped)),
         )
 
     def mc_digest(self, perm=None) -> bytes:
@@ -507,17 +672,49 @@ def admission_state_violations(sys: AdmissionSystem) -> List[Violation]:
                 f"template {r.template}: pending record waited "
                 f"{r.age} pump ticks in-window (bound {bound})"))
             break
+    if sys.bls_table is not None:
+        # class-bucket conservation (ISSUE 10): every FOLDED share is
+        # in exactly one of open-class / aggregate-dispatched /
+        # fallback-dispatched / forged-dropped — read the open-class
+        # counts from the REAL table's canonical rows, so a lossy fold
+        # cannot vouch for itself
+        open_counts = [0] * len(sys.cfg.bls_templates)
+        for key, signers, _w in sys.bls_table.mc_canonical():
+            inst, _h, _r, typ, _val = key
+            for v in signers:
+                k = sys._bls_key.get((inst, v, typ))
+                if k is not None:
+                    open_counts[k] += 1
+        for k in range(len(sys.cfg.bls_templates)):
+            have = (open_counts[k] + sys.bls_agg[k]
+                    + sys.bls_fallback[k] + sys.bls_dropped[k])
+            if have != sys.bls_folded[k]:
+                out.append(Violation(
+                    "conservation", k,
+                    f"bls template {k}: folded {sys.bls_folded[k]} "
+                    f"!= open {open_counts[k]} + aggregate "
+                    f"{sys.bls_agg[k]} + fallback "
+                    f"{sys.bls_fallback[k]} + dropped "
+                    f"{sys.bls_dropped[k]} — a folded share was "
+                    f"lost outside a counted path"))
     return out
 
 
-def admission_edge_snapshot(sys: AdmissionSystem) -> int:
-    return len(sys.dispatch_log)
+def admission_edge_snapshot(sys: AdmissionSystem) -> tuple:
+    return (len(sys.dispatch_log), len(sys.bls_dispatch_log))
 
 
 def admission_edge_violations(sys: AdmissionSystem,
-                              snap: int) -> List[Violation]:
+                              snap: tuple) -> List[Violation]:
     out: List[Violation] = []
-    for P, signed, _counts, rows in sys.dispatch_log[snap:]:
+    for kind, members, forged in sys.bls_dispatch_log[snap[1]:]:
+        if kind == "agg" and forged:
+            out.append(Violation(
+                "purity", forged[0],
+                f"aggregate dispatch carried a non-pairing-cleared "
+                f"class (forged bls templates {sorted(forged)} "
+                f"folded into the single aggregate lane)"))
+    for P, signed, _counts, rows in sys.dispatch_log[snap[0]:]:
         if P not in (2, 3):
             out.append(Violation(
                 "pbound", -1,
@@ -626,6 +823,14 @@ def admission_corpus_entry(name: str, cfg: AdmissionMCConfig,
                                for k, v in sys_.queue.counters.items()},
             "cache_hits": (0 if sys_.cache is None
                            else sys_.cache.counters["hits"]),
+            "bls_dispatches": [[kind, list(m), list(f)]
+                               for kind, m, f
+                               in sys_.bls_dispatch_log],
+            "bls_folded": list(sys_.bls_folded),
+            "bls_table_counters": (
+                {} if sys_.bls_table is None
+                else {k: int(v) for k, v
+                      in sys_.bls_table.counters.items()}),
         },
     }
 
@@ -643,6 +848,16 @@ def replay_admission_entry(entry: dict) -> Tuple[AdmissionSystem,
     assert list(sys_.evicted) == exp["evicted"], entry["name"]
     assert {k: int(v) for k, v in sys_.queue.counters.items()} \
         == exp["queue_counters"], entry["name"]
+    got_bls = [[k, list(m), list(f)]
+               for k, m, f in sys_.bls_dispatch_log]
+    assert got_bls == exp.get("bls_dispatches", []), (
+        f"{entry['name']}: bls dispatch log diverged")
+    assert list(sys_.bls_folded) == exp.get("bls_folded", []), \
+        entry["name"]
+    if sys_.bls_table is not None:
+        assert {k: int(v)
+                for k, v in sys_.bls_table.counters.items()} \
+            == exp["bls_table_counters"], entry["name"]
     assert sorted({v.property for v in viols}) == exp["violations"], (
         f"{entry['name']}: property verdicts diverged")
     return sys_, viols
@@ -716,6 +931,45 @@ class _LifoSystem(AdmissionSystem):
     queue_cls = _LifoDrainQueue
 
 
+def _lossy_fold_table_cls():
+    """Doctored BlsClassTable built lazily (the serve import stays off
+    the module's import path for the jax-free gate slot): once a class
+    holds two shares, fold() silently drops the highest-validator one
+    — counters untouched, the classic lost-update under the leaf
+    mutex.  Caught by the class-bucket conservation monitor."""
+    from agnes_tpu.serve.bls_lane import BlsClassTable
+
+    class _LossyFoldTable(BlsClassTable):
+        def fold(self, wire_bytes, decode: bool = True) -> dict:
+            res = super().fold(wire_bytes, decode)
+            if res["folded"]:
+                with self._mu:
+                    for cls in self.classes.values():
+                        if cls.n_signers >= 2:
+                            v = max(cls.shares)
+                            del cls.shares[v]
+                            cls.signers[v] = False
+                            cls.weight -= int(self.registry.powers[v])
+                            break
+            return res
+
+    return _LossyFoldTable
+
+
+class _LossyBlsFoldSystem(AdmissionSystem):
+    @property
+    def bls_table_cls(self):
+        return _lossy_fold_table_cls()
+
+
+class _PairingBlindSystem(AdmissionSystem):
+    """Doctored: skips the per-class pairing verdict — forged shares
+    ride the single aggregate lane with the class's combined weight.
+    Caught by the aggregate-purity edge monitor."""
+
+    bls_pairing_blind = True
+
+
 #: mutant name -> (system class, property caught by, config)
 ADMISSION_MUTANTS: Dict[str, tuple] = {
     "lose_drained_record": (
@@ -748,6 +1002,24 @@ ADMISSION_MUTANTS: Dict[str, tuple] = {
         AdmissionMCConfig(name="mut_taint", depth=8, target=2,
                           max_copies=2,
                           templates=((0, 0, 0, 0), (1, 1, 0, 0)))),
+    # ISSUE 10: a fold that loses a share out of an open class bucket
+    # without counting it anywhere — caught by the class-bucket
+    # conservation monitor (folded == open + agg + fallback + dropped)
+    "lossy_bls_fold": (
+        _LossyBlsFoldSystem, "conservation",
+        AdmissionMCConfig(name="mut_bls_lossy", depth=5, max_copies=1,
+                          templates=((0, 0, 0, 0),), bls=True,
+                          bls_templates=((0, 0, 0), (0, 1, 0),
+                                         (0, 2, 0)),
+                          bls_target=3)),
+    # ISSUE 10: a close that "clears" a class without the pairing —
+    # forged shares folded into the one aggregate lane (purity)
+    "pairing_blind_aggregate": (
+        _PairingBlindSystem, "purity",
+        AdmissionMCConfig(name="mut_bls_blind", depth=4, max_copies=1,
+                          templates=((0, 0, 0, 0),), bls=True,
+                          bls_templates=((0, 0, 0), (0, 1, 0)),
+                          bls_target=2, bls_forged=(1,))),
 }
 
 
@@ -881,6 +1153,16 @@ ADMISSION_SMOKE: Tuple[AdmissionMCConfig, ...] = (
                       instance_cap=2, max_copies=2,
                       templates=((0, 0, 0, 0), (0, 1, 0, 0),
                                  (1, 2, 0, 0))),
+    # ISSUE 10: BLS class buckets beside the record queue — both vote
+    # classes fold, validator 2's shares fail the (modeled) pairing so
+    # the prevote class exercises the per-share fallback split, and
+    # validator 3 has no proof of possession (folds rejected, counted)
+    AdmissionMCConfig(name="adm_bls_classes", depth=10, max_copies=2,
+                      templates=((0, 0, 0, 0),), bls=True,
+                      bls_templates=((0, 0, 0), (0, 1, 0), (0, 2, 0),
+                                     (0, 1, 1), (0, 3, 1)),
+                      bls_target=3, bls_max_classes=2,
+                      bls_forged=(2,), bls_no_pop=(3,)),
 )
 
 ADMISSION_SCOPES = {"tiny": ADMISSION_TINY, "smoke": ADMISSION_SMOKE,

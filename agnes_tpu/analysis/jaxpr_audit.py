@@ -183,6 +183,17 @@ def _dense_args(d):
     return (st, ta, ext, ph, _dense(d)) + _common(d)
 
 
+def _bls_args(d):
+    import jax.numpy as jnp
+
+    from agnes_tpu.crypto import bls_jax as BJ
+
+    n = d["N"]
+    return (jnp.zeros((n, 2, BJ.NLIMBS), jnp.int32),
+            jnp.zeros((n, 4, BJ.NLIMBS), jnp.int32),
+            jnp.zeros((n, BJ.W_LIMBS), jnp.int32))
+
+
 def _honest_args(d):
     import jax.numpy as jnp
 
@@ -203,6 +214,7 @@ ARG_BUILDERS: Dict[str, Callable] = {
     "consensus_step_seq_signed_dense": _dense_args,
     "consensus_step_seq_signed_dense_donated": _dense_args,
     "honest_heights": _honest_args,
+    "bls_aggregate": _bls_args,
     "sharded_step": _step_args,
     "sharded_step_seq": _seq_args,
     "sharded_step_seq_signed": _dense_args,
@@ -223,6 +235,7 @@ ENTRY_STATICS: Dict[str, dict] = {
     "consensus_step_seq_signed_dense_donated": {
         "advance_height": False, "verify_chunk": None},
     "honest_heights": {"heights": 2},
+    "bls_aggregate": {"n_windows": 6},
     "sharded_step": {"advance_height": False},
     "sharded_step_seq": {"advance_height": False, "donate": True},
     "sharded_step_seq_signed": {"advance_height": False,
@@ -231,11 +244,14 @@ ENTRY_STATICS: Dict[str, dict] = {
 }
 
 #: entries whose trace contains the Ed25519 verify graph (~15-20s of
-#: tracing each on the CI box); quick mode skips them
+#: tracing each on the CI box) or the BLS aggregation MSM (~45s: the
+#: Barrett field instantiates ~100k eqns across its six rolled
+#: point-add bodies); quick mode skips them
 HEAVY = frozenset({
     "consensus_step_seq_signed_donated",
     "consensus_step_seq_signed_dense_donated",
     "sharded_step_seq_signed",
+    "bls_aggregate",
 })
 
 

@@ -90,11 +90,13 @@ def _jaxpr_worker(task):
 
 #: audit shards balanced by trace weight: the chunk-invariance pair
 #: (sharded signed, traced twice) in one, the two single-device
-#: Ed25519-bearing twins in another, everything cheap in a third
+#: Ed25519-bearing twins in another, the BLS aggregation MSM (one
+#: ~45s trace) in its own, everything cheap in the last
 _JAXPR_SHARDS = (
     ["sharded_step_seq_signed"],
     ["consensus_step_seq_signed_donated",
      "consensus_step_seq_signed_dense_donated"],
+    ["bls_aggregate"],
     ["consensus_step", "consensus_step_seq",
      "consensus_step_seq_donated", "honest_heights", "sharded_step",
      "sharded_step_seq", "sharded_honest_heights"],
@@ -106,7 +108,7 @@ def run_jaxpr(quick: bool, metrics):
 
     union = sorted(set().union(*_JAXPR_SHARDS))
     if quick:
-        tasks = [(_JAXPR_SHARDS[2], True, None)]
+        tasks = [(_JAXPR_SHARDS[-1], True, None)]
     else:
         tasks = [(names, i == 0, union if i == 0 else None)
                  for i, names in enumerate(_JAXPR_SHARDS)]

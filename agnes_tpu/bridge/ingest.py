@@ -298,6 +298,22 @@ class VoteBatcher:
             np.asarray(verified, bool) if verified is not None else None,
             np.asarray(digest, np.uint8) if digest is not None else None))
 
+    def add_class_votes(self, instance, validator, height, round_,
+                        typ, value) -> None:
+        """Enqueue a batch of PRE-VERIFIED class votes (ISSUE 10: the
+        BLS aggregate lane's cleared/fallback-verified shares).  The
+        mixed-mode rung: a whole aggregate class enters as rows that
+        densify to ONE dense phase carrying the class's combined
+        voting weight (each signer's mask bit applies that signer's
+        power in the tally — leaf-identical to per-vote ingestion),
+        and the verified flag routes the build down the verify-free
+        unsigned entries via the split-rung dispatch.  Callers must
+        have a cleared pairing (or per-share fallback verify) behind
+        every row — the cache-hit contract, extended to the lane."""
+        n = len(np.asarray(instance))
+        self.add_arrays(instance, validator, height, round_, typ,
+                        value, verified=np.ones(n, bool))
+
     def add(self, vote: WireVote) -> None:
         if vote.signature is not None and len(vote.signature) != 64:
             # wrong-length signatures can't ride the [N, 64] column;

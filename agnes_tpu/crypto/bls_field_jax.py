@@ -1,0 +1,353 @@
+"""GF(p) limb arithmetic for BLS12-381 in JAX — int32, 12-bit limbs.
+
+The `field_jax.py` pattern pushed to 381 bits.  Two things change
+against the Ed25519 field and both shape the design:
+
+* **p is not pseudo-Mersenne**, so the cheap `2^260 === 608` fold is
+  unavailable: products reduce by **Barrett** against
+  ``mu = floor(2^768 / p)``.  Both constant multiplications inside the
+  reduction (by mu and by p) are contractions against small constant
+  banded matrices — one matmul each, the COLSUM idiom — never a
+  per-limb update loop, and the quotient is taken on *loosely*
+  normalized limbs (vectorized carry passes only).  The loose quotient
+  under-shoots the true one by <= 2, so results land in [0, 4p) and
+  STAY there: elements are "4p-reduced", never canonical.
+  Canonicalization happens on the HOST (`from_limbs` + `% p` over
+  python ints) — the device kernels (bls_jax) never need an inversion,
+  a comparison, or a canonical representative.
+* **32 limbs of 13 bits would overflow int32 column sums** (32 * 8800^2
+  > 2^31), so the radix drops to 2^12: 33 limbs cover 396 bits, and
+  column sums stay <= 33 * 4100 * 4095 < 2^31 for every product here.
+
+Limbs are kept NON-NEGATIVE throughout (unlike field_jax's signed
+limbs): the Barrett quotient is only one-sided-exact when the limbs
+dropped by its shift are non-negative, so subtraction adds a
+per-limb-dominating multiple of p first — field_jax's 64p SUB_K
+spread, generalized to arbitrary static bounds (`_sub_spread`).  The
+ONE sequential carry chain lives at the tail of `reduce_cols` (strict
+output limbs are what keep every later bound small), and it runs over
+24-bit limb PAIRS to halve its length.
+
+Every value carries a STATIC python-int bound (`FV`): additions add
+bounds, subtraction picks its spread from the subtrahend's bound, and
+`fv_mul` auto-reduces operands until the product fits the Barrett
+precondition (x < 2^768) — all decided at trace time, so a formula
+change that would overflow fails the *trace* (and the jaxpr-audit
+gate), not a hardware run, and the common case costs zero extra ops.
+
+Oracle: `bls_ref` (plain python ints); see tests/test_bls.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from agnes_tpu.crypto.bls_ref import P
+
+I32 = jnp.int32
+
+BITS = 12
+RADIX = 1 << BITS            # 4096
+LMASK = RADIX - 1
+NLIMBS = 33                  # 396 bits of headroom (4p < 2^384)
+MU_SHIFT_LIMBS = 64          # Barrett shift: 2^768, limb-aligned
+MU = (1 << (BITS * MU_SHIFT_LIMBS)) // P
+
+#: loose-limb bound after a vectorized carry pass (strict is 4095)
+LOOSE = RADIX + 8
+#: Barrett precondition (slack for the loose-quotient error)
+REDUCE_CAP = (1 << (BITS * MU_SHIFT_LIMBS)) - (1 << 762)
+#: every reduce output obeys this value bound
+RED_BOUND = 4 * P
+
+
+def _const_limbs(x: int) -> List[int]:
+    out = []
+    while x:
+        out.append(x & LMASK)
+        x >>= BITS
+    return out or [0]
+
+
+# --- host <-> limb conversion ----------------------------------------------
+
+def to_limbs(x: int) -> np.ndarray:
+    """Python int in [0, 2^396) -> [NLIMBS] int32 (host helper)."""
+    return np.asarray([(x >> (BITS * i)) & LMASK
+                       for i in range(NLIMBS)], np.int32)
+
+
+def ints_to_limbs(xs) -> np.ndarray:
+    """Iterable of ints -> [len, NLIMBS] int32 (host helper)."""
+    return np.stack([to_limbs(int(x)) for x in xs]) if len(xs) \
+        else np.zeros((0, NLIMBS), np.int32)
+
+
+def from_limbs(a) -> int:
+    """Limb array (loose limbs welcome) -> python int; the caller
+    takes `% P` — host-side canonicalization is one line of python."""
+    arr = np.asarray(a)
+    return sum(int(arr[..., i]) << (BITS * i)
+               for i in range(arr.shape[-1]))
+
+
+# --- vectorized carry passes ------------------------------------------------
+
+def _vpass(r: jnp.ndarray) -> jnp.ndarray:
+    """One exact vectorized carry pass over the whole limb axis
+    (field_jax._vpass, fold=None): value preserved exactly, the top
+    limb keeps its full value, signed carries borrow via the
+    arithmetic shift.  Per-limb bound M maps to 4095 + M/2^12 + 1;
+    non-negative input limbs stay non-negative."""
+    lo = r & LMASK
+    hi = r >> BITS
+    shift_in = jnp.concatenate(
+        [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
+    lo = jnp.concatenate([lo[..., :-1], r[..., -1:]], axis=-1)
+    return lo + shift_in
+
+
+def _passes_needed(col_bound: int) -> int:
+    n, m = 0, int(col_bound)
+    while m > LOOSE:
+        m = RADIX + m // RADIX + 1
+        n += 1
+    return max(n, 1)
+
+
+def loosen(r: jnp.ndarray, col_bound: int) -> jnp.ndarray:
+    """Columns (|col| <= col_bound) -> loose limbs (interior bound
+    LOOSE), value preserved exactly."""
+    for _ in range(_passes_needed(col_bound)):
+        r = _vpass(r)
+    return r
+
+
+def _chain_strict(r: jnp.ndarray) -> jnp.ndarray:
+    """Sequential signed carry chain -> strict limbs in [0, 2^12).
+    Runs over 24-bit limb PAIRS (half the sequential steps); the
+    caller guarantees the value is non-negative and fits, so the final
+    carry is zero."""
+    n = r.shape[-1]
+    if n % 2:
+        r = jnp.pad(r, [(0, 0)] * (r.ndim - 1) + [(0, 1)])
+        n += 1
+    s = r[..., 0::2] + (r[..., 1::2] << BITS)     # 24-bit superlimbs
+    c = jnp.zeros_like(s[..., 0])
+    outs = []
+    mask24 = (1 << (2 * BITS)) - 1
+    for k in range(n // 2):
+        t = s[..., k] + c
+        outs.append(t & mask24)
+        c = t >> (2 * BITS)
+    sup = jnp.stack(outs, axis=-1)
+    lo = sup & LMASK
+    hi = sup >> BITS
+    return jnp.stack([lo, hi], axis=-1).reshape(r.shape[:-1] + (n,))
+
+
+def _banded(const: List[int], n_in: int, n_out: int) -> jnp.ndarray:
+    """[n_in, n_out] banded constant-multiplication matrix:
+    (a @ M)[k] = sum_i a_i * const[k - i] — limb convolution by a
+    fixed constant as ONE contraction.  Per-column terms <=
+    len(const), so sums stay int32-safe for loose inputs."""
+    m = np.zeros((n_in, n_out), np.int32)
+    for i in range(n_in):
+        for j, cj in enumerate(const):
+            if cj and i + j < n_out:
+                m[i, i + j] = cj
+    return jnp.asarray(m)
+
+
+_N65 = 2 * NLIMBS - 1
+_MU_MAT = _banded(_const_limbs(MU), _N65, _N65 + len(_const_limbs(MU)))
+_P_MAT = _banded(_const_limbs(P), NLIMBS, _N65)
+
+# column-sum contraction (flat outer index -> column), field_jax.COLSUM
+_M = np.zeros((NLIMBS * NLIMBS, _N65), np.int32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _M[_i * NLIMBS + _j, _i + _j] = 1
+_COLSUM = jnp.asarray(_M)
+del _M
+
+
+# --- Barrett reduction ------------------------------------------------------
+
+def reduce_cols(cols: jnp.ndarray, col_bound: int) -> jnp.ndarray:
+    """Raw NON-NEGATIVE columns (value < REDUCE_CAP) -> [NLIMBS]
+    STRICT limbs of a representative < 4p of the same residue class.
+
+    q = value(t[64:]) of the loosened t = x*mu drops only
+    non-negative low limbs, so it under-shoots floor(x*mu / 2^768) by
+    at most 2 and never overshoots — r = x - q*p stays in [0, 4p).
+    The one sequential chain at the tail makes the output limbs
+    strict, which is what keeps every downstream bound (and the
+    subtraction spreads) small."""
+    x = loosen(cols, col_bound)
+    n = x.shape[-1]
+    if n < _N65:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, _N65 - n)])
+    t = x @ _MU_MAT
+    t = loosen(t, len(_const_limbs(MU)) * LOOSE * LMASK)
+    q = t[..., MU_SHIFT_LIMBS:MU_SHIFT_LIMBS + NLIMBS]
+    ql = q @ _P_MAT
+    r = x - loosen(ql, len(_const_limbs(P)) * LOOSE * LMASK)
+    r = loosen(r, 2 * LOOSE * LMASK)
+    return _chain_strict(r)[..., :NLIMBS]
+
+
+# --- statically-bounded field values ---------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FV:
+    """A field value during tracing: non-negative loose limbs + STATIC
+    value bound (a plain python int, trace-time only — the bound
+    bookkeeping costs zero runtime ops)."""
+
+    a: jnp.ndarray          # [..., NLIMBS] limbs, interior <= ~2*LOOSE
+    bound: int
+
+    def __post_init__(self):
+        assert self.a.shape[-1] == NLIMBS, self.a.shape
+
+
+def fv_in(arr: jnp.ndarray, bound: int = P) -> FV:
+    """Wrap a kernel input (canonical host-packed limbs by default)."""
+    return FV(arr, bound)
+
+
+def fv_add(x: FV, y: FV) -> FV:
+    return FV(_vpass(x.a + y.a), x.bound + y.bound)
+
+
+#: memoized subtraction spreads, keyed by the subtrahend's top-limb
+#: bound (docstring of _sub_spread)
+_SPREADS: Dict[int, Tuple[np.ndarray, int]] = {}
+
+#: per-limb bound of any element's interior limbs (strict reduce
+#: outputs, one vpass after add/sub)
+_ELEM_LIMB = 2 * LOOSE
+
+
+def _sub_spread(y_bound: int) -> Tuple[np.ndarray, int]:
+    """(limb array, value) of a multiple of p that per-limb dominates
+    any element with value < y_bound: limbs 0..31 >= _ELEM_LIMB, the
+    top region >= y_bound >> 384 + 2 — so x - y + spread has
+    non-negative limbs everywhere (the field_jax 64p SUB_K spread,
+    generalized).  Memoized by the top bound."""
+    ytop = (int(y_bound) >> (BITS * (NLIMBS - 1))) + 2
+    hit = _SPREADS.get(ytop)
+    if hit is not None:
+        return hit
+    base = sum(_ELEM_LIMB << (BITS * i) for i in range(NLIMBS - 1))
+    k = -(-(base + (ytop + 1) * (1 << (BITS * (NLIMBS - 1)))) // P)
+    v = k * P
+    rest = v - base
+    assert rest >> (BITS * (NLIMBS - 1)) >= ytop
+    limbs = np.asarray(
+        [_ELEM_LIMB + ((rest >> (BITS * i)) & LMASK)
+         for i in range(NLIMBS - 1)]
+        + [rest >> (BITS * (NLIMBS - 1))], np.int64)
+    assert (limbs < (1 << 30)).all(), "spread top limb overflow"
+    assert sum(int(limbs[i]) << (BITS * i)
+               for i in range(NLIMBS)) == v
+    # memoize as a NUMPY constant: a jnp array built inside a scan/jit
+    # trace would be a tracer, and caching a tracer across traces is a
+    # leak (jnp ops consume numpy operands as constants directly)
+    out = (np.asarray(limbs, np.int32), v)
+    _SPREADS[ytop] = out
+    return out
+
+
+def fv_sub(x: FV, y: FV) -> FV:
+    """x - y + spread(y.bound): value-equivalent mod p, limbs stay
+    non-negative (Barrett's one-sided-quotient requirement)."""
+    spread, v = _sub_spread(y.bound)
+    return FV(_vpass(x.a - y.a + spread), x.bound + v)
+
+
+def _outer_cols(x: FV, y: FV) -> jnp.ndarray:
+    prod = x.a[..., :, None] * y.a[..., None, :]
+    flat = prod.reshape(prod.shape[:-2] + (NLIMBS * NLIMBS,))
+    return flat @ _COLSUM
+
+
+def fv_reduce(x: FV) -> FV:
+    """Re-reduce a grown value below 4p."""
+    assert x.bound < REDUCE_CAP
+    if x.bound <= RED_BOUND:
+        return x
+    return FV(reduce_cols(x.a, _ELEM_LIMB + LMASK), RED_BOUND)
+
+
+def fv_mul(x: FV, y: FV) -> FV:
+    # auto-reduce grown operands until the product fits the Barrett
+    # precondition — static, so the common case pays nothing and no
+    # formula can silently overflow
+    while x.bound * y.bound >= REDUCE_CAP:
+        if x.bound >= y.bound:
+            assert x.bound > RED_BOUND, "un-reducible operand pair"
+            x = fv_reduce(x)
+        else:
+            y = fv_reduce(y)
+    cols = _outer_cols(x, y)
+    return FV(reduce_cols(cols, NLIMBS * _ELEM_LIMB * _ELEM_LIMB),
+              RED_BOUND)
+
+
+def fv_mul_small(x: FV, k: int) -> FV:
+    assert 0 < k * _ELEM_LIMB < (1 << 31) \
+        and x.bound * k < REDUCE_CAP
+    return FV(reduce_cols(x.a * jnp.asarray(k, I32), k * _ELEM_LIMB),
+              RED_BOUND)
+
+
+# --- Fp2 (u^2 = -1), components as FV pairs ---------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FV2:
+    c0: FV
+    c1: FV
+
+
+def fv2_in(arr: jnp.ndarray, bound: int = P) -> FV2:
+    """[..., 2, NLIMBS] -> FV2."""
+    return FV2(FV(arr[..., 0, :], bound), FV(arr[..., 1, :], bound))
+
+
+def fv2_add(x: FV2, y: FV2) -> FV2:
+    return FV2(fv_add(x.c0, y.c0), fv_add(x.c1, y.c1))
+
+
+def fv2_sub(x: FV2, y: FV2) -> FV2:
+    return FV2(fv_sub(x.c0, y.c0), fv_sub(x.c1, y.c1))
+
+
+def fv2_mul(x: FV2, y: FV2) -> FV2:
+    """Karatsuba over u^2 = -1: v0 = a0b0, v1 = a1b1,
+    v2 = (a0+a1)(b0+b1); c0 = v0 - v1, c1 = v2 - v0 - v1 — THREE
+    Barrett reductions per Fp2 product (the dominant cost of the G2
+    lane; fv_mul's auto-reduce keeps the sum operands legal)."""
+    v0 = fv_mul(x.c0, y.c0)
+    v1 = fv_mul(x.c1, y.c1)
+    v2 = fv_mul(fv_add(x.c0, x.c1), fv_add(y.c0, y.c1))
+    return FV2(fv_sub(v0, v1), fv_sub(v2, fv_add(v0, v1)))
+
+
+def fv2_mul_small(x: FV2, k: int) -> FV2:
+    return FV2(fv_mul_small(x.c0, k), fv_mul_small(x.c1, k))
+
+
+def fv2_reduce(x: FV2) -> FV2:
+    return FV2(fv_reduce(x.c0), fv_reduce(x.c1))
+
+
+def fv2_out(x: FV2) -> jnp.ndarray:
+    """FV2 -> [..., 2, NLIMBS]."""
+    return jnp.stack([x.c0.a, x.c1.a], axis=-2)

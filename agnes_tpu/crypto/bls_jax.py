@@ -1,0 +1,281 @@
+"""BLS12-381 G1/G2 aggregation kernels — the aggregate lane's O(N).
+
+The serve plane's BLS lane (serve/bls_lane.py) splits each vote
+class's verification into the O(N) part — aggregate N signer pubkeys
+(G1, stake-weighted MSM) and N signature shares (G2, the same
+weighted-MSM machinery) — and the O(1) part, two pairings through the
+`bls_ref` oracle.  THIS module is the O(N) part on device:
+
+* point arithmetic with the Renes–Costello–Batina COMPLETE projective
+  addition for a = 0 short-Weierstrass curves (eprint 2015/1060,
+  algorithm 7): branch-free, identity-safe, doubling-safe — exactly
+  what vectorized bucket accumulation needs (buckets hold identities
+  and equal points constantly), over `bls_field_jax`'s 12-bit-limb
+  Barrett field (G1) and its Fp2 extension (G2);
+* one registered jit entry, `bls_aggregate`: weights -> window digits
+  -> `msm_jax.msm_generic` (the generalized Pippenger: shared
+  doubling chain, sequential-scan bucket sums — see
+  `bucket_sums_seq`'s rationale) for BOTH groups in one dispatch.
+  Padding lanes carry weight 0 and fall into the excluded 0 bucket,
+  so one compiled shape per ladder rung serves every class size.
+
+Outputs stay PROJECTIVE (X, Y, Z limb arrays): the host converts to
+affine with two python modular inversions per class (bls_ref) before
+the pairing — the device never needs an inversion, a comparison, or a
+canonical representative (bls_field_jax module docstring).
+
+Weights are voting powers, capped at W_BITS bits (the lane screens);
+the aggregate check this feeds is
+`bls_ref.aggregate_verify_weighted`."""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from agnes_tpu.crypto import bls_field_jax as BF
+from agnes_tpu.crypto import bls_ref as ref
+from agnes_tpu.crypto import msm_jax as M
+from agnes_tpu.crypto.bls_field_jax import (
+    FV,
+    FV2,
+    I32,
+    NLIMBS,
+    RED_BOUND,
+    fv2_add,
+    fv2_in,
+    fv2_mul,
+    fv2_mul_small,
+    fv2_out,
+    fv2_reduce,
+    fv2_sub,
+    fv_add,
+    fv_in,
+    fv_mul,
+    fv_mul_small,
+    fv_reduce,
+    fv_sub,
+)
+
+#: stake-weight width: voting powers above this are screened by the
+#: lane at registration (2^24 per validator is far above any realistic
+#: consensus power table; the MSM cost scales with it)
+W_BITS = 24
+W_LIMBS = -(-W_BITS // BF.BITS)          # 2
+WINDOW_C = 4
+N_WINDOWS = -(-W_BITS // WINDOW_C)       # 6
+
+
+class G1P(NamedTuple):
+    """Projective G1 point; each field [..., NLIMBS] int32 limbs."""
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+
+
+class G2P(NamedTuple):
+    """Projective G2 point; each field [..., 2, NLIMBS] int32 limbs."""
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+
+
+def _one_limbs(shape: Tuple[int, ...]) -> jnp.ndarray:
+    return jnp.zeros(shape + (NLIMBS,), I32).at[..., 0].set(1)
+
+
+def g1_identity(shape: Tuple[int, ...]) -> G1P:
+    z = jnp.zeros(shape + (NLIMBS,), I32)
+    return G1P(x=z, y=_one_limbs(shape), z=z)
+
+
+def g2_identity(shape: Tuple[int, ...]) -> G2P:
+    z = jnp.zeros(shape + (2, NLIMBS), I32)
+    one = z.at[..., 0, 0].set(1)
+    return G2P(x=z, y=one, z=z)
+
+
+def _rcb_add(p, q, *, add, sub, mul, red, b3_mul):
+    """Renes–Costello–Batina 2015/1060 algorithm 7 (complete addition,
+    a = 0), generic over the field op set — instantiated for Fp (G1)
+    and Fp2 (G2).  Inputs/outputs are coordinate triples bounded by
+    RED_BOUND (the scan-carry fixed point); the interleaved `red`
+    calls keep every product inside the Barrett precondition, which
+    `bls_field_jax` asserts statically at trace time."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    t0 = mul(x1, x2)
+    t1 = mul(y1, y2)
+    t2 = mul(z1, z2)
+    t3 = red(sub(mul(add(x1, y1), add(x2, y2)), add(t0, t1)))
+    t4 = red(sub(mul(add(y1, z1), add(y2, z2)), add(t1, t2)))
+    t6 = red(sub(mul(add(x1, z1), add(x2, z2)), add(t0, t2)))
+    s3 = red(add(add(t0, t0), t0))               # 3 * X1X2
+    t2b = b3_mul(t2)
+    z3 = add(t1, t2b)
+    t1b = sub(t1, t2b)
+    y3 = b3_mul(t6)
+    z3r = red(z3)
+    x_out = red(sub(mul(t3, t1b), mul(t4, y3)))
+    y_out = red(add(mul(y3, s3), mul(t1b, z3r)))
+    z_out = red(add(mul(z3r, t4), mul(s3, t3)))
+    return x_out, y_out, z_out
+
+
+def g1_add(p: G1P, q: G1P) -> G1P:
+    """Complete G1 addition; coords are RED_BOUND-bounded limbs."""
+    def wrap(pt):
+        return tuple(fv_in(c, RED_BOUND) for c in pt)
+
+    x, y, z = _rcb_add(
+        wrap(p), wrap(q),
+        add=fv_add, sub=fv_sub, mul=fv_mul, red=fv_reduce,
+        b3_mul=lambda t: fv_mul_small(t, 3 * ref.B_G1))
+    return G1P(x=x.a, y=y.a, z=z.a)
+
+
+def _fv2_b3(t: FV2) -> FV2:
+    """t * 3*b' for b' = 4(1 + u): 12t(1 + u) =
+    12(c0 - c1) + 12(c0 + c1)u, each component Barrett-reduced."""
+    return FV2(fv_mul_small(fv_sub(t.c0, t.c1), 12),
+               fv_mul_small(fv_add(t.c0, t.c1), 12))
+
+
+def g2_add(p: G2P, q: G2P) -> G2P:
+    """Complete G2 addition over Fp2; coords RED_BOUND-bounded."""
+    def wrap(pt):
+        return tuple(fv2_in(c, RED_BOUND) for c in pt)
+
+    x, y, z = _rcb_add(
+        wrap(p), wrap(q),
+        add=fv2_add, sub=fv2_sub, mul=fv2_mul, red=fv2_reduce,
+        b3_mul=_fv2_b3)
+    return G2P(x=fv2_out(x), y=fv2_out(y), z=fv2_out(z))
+
+
+# --- the registered aggregation entry ---------------------------------------
+
+def n_windows_for(w_bits: int) -> int:
+    """Windows needed for stake weights of `w_bits` bits (clamped to
+    the registration-screened W_BITS cap).  STATIC per deployment:
+    the key registry fixes its weight width at construction, so a
+    uniform-stake validator set (w_bits=1) pays ONE window's bucket
+    scan instead of six — the dominant per-class runtime term."""
+    return -(-max(1, min(int(w_bits), W_BITS)) // WINDOW_C)
+
+
+def bls_aggregate(pk: jnp.ndarray, sig: jnp.ndarray,
+                  w: jnp.ndarray,
+                  n_windows: int = N_WINDOWS) -> Tuple[G1P, G2P]:
+    """One vote class's O(N) aggregation in one dispatch.
+
+    pk  [N, 2, NLIMBS] int32 — signer pubkeys, affine G1 limb coords
+    sig [N, 4, NLIMBS] int32 — signature shares, affine G2
+                               (x0, x1, y0, y1) limb coords
+    w   [N, W_LIMBS]   int32 — stake weights as 12-bit limbs; weight 0
+                               marks a padding lane (dropped by the
+                               0-bucket exclusion, no mask needed)
+
+    `n_windows` is STATIC (part of the compile key): the number of
+    4-bit weight windows the MSM walks, `n_windows_for(w_bits)` of
+    the deployment's weight width — every weight must fit
+    `n_windows * WINDOW_C` bits (the key registry enforces it).
+
+    Returns (agg_pk, agg_sig) PROJECTIVE: agg_pk = Σ [wᵢ] pkᵢ over G1,
+    agg_sig = Σ [wᵢ] sigᵢ over G2 — the two MSMs whose outputs feed
+    `bls_ref.aggregate_verify_weighted`'s single pairing-product
+    check.  Shapes (+ n_windows) are the compile key: the lane pads
+    every class onto a ladder rung, so the jit cache holds one
+    executable per rung."""
+    g1pts = G1P(x=pk[:, 0], y=pk[:, 1],
+                z=_one_limbs((pk.shape[0],)))
+    g2x = jnp.stack([sig[:, 0], sig[:, 1]], axis=-2)
+    g2y = jnp.stack([sig[:, 2], sig[:, 3]], axis=-2)
+    g2pts = G2P(x=g2x, y=g2y, z=g2_identity((sig.shape[0],)).y)
+    agg_pk = M.msm_generic(
+        g1pts, w, n_windows, point_add=g1_add, identity=g1_identity,
+        window_c=WINDOW_C, bits=BF.BITS)
+    agg_sig = M.msm_generic(
+        g2pts, w, n_windows, point_add=g2_add, identity=g2_identity,
+        window_c=WINDOW_C, bits=BF.BITS)
+    return agg_pk, agg_sig
+
+
+bls_aggregate_jit = jax.jit(bls_aggregate,
+                            static_argnames=("n_windows",))
+
+from agnes_tpu.device import registry as _registry  # noqa: E402
+
+_registry.register(_registry.EntrySpec(
+    name="bls_aggregate", fn=bls_aggregate, jit=bls_aggregate_jit,
+    statics=("n_windows",), hot=True))
+
+
+# --- host-side packing / unpacking ------------------------------------------
+
+def pack_g1_rows(points) -> np.ndarray:
+    """bls_ref affine G1 points -> [n, 2, NLIMBS] int32 (host)."""
+    n = len(points)
+    out = np.zeros((n, 2, NLIMBS), np.int32)
+    for i, pt in enumerate(points):
+        assert pt is not None, "identity pubkey cannot be aggregated"
+        out[i, 0] = BF.to_limbs(pt[0])
+        out[i, 1] = BF.to_limbs(pt[1])
+    return out
+
+
+def pack_g2_rows(points) -> np.ndarray:
+    """bls_ref affine G2 points -> [n, 4, NLIMBS] int32 (host)."""
+    n = len(points)
+    out = np.zeros((n, 4, NLIMBS), np.int32)
+    for i, pt in enumerate(points):
+        assert pt is not None, "identity share cannot be aggregated"
+        x, y = pt
+        out[i, 0] = BF.to_limbs(x.c[0])
+        out[i, 1] = BF.to_limbs(x.c[1])
+        out[i, 2] = BF.to_limbs(y.c[0])
+        out[i, 3] = BF.to_limbs(y.c[1])
+    return out
+
+
+def pack_weights(weights) -> np.ndarray:
+    """Voting powers -> [n, W_LIMBS] int32 12-bit limbs.  Powers must
+    fit W_BITS (the lane screens at registration)."""
+    w = np.asarray(weights, np.int64)
+    assert (w >= 0).all() and (w < (1 << W_BITS)).all(), \
+        f"weights must fit {W_BITS} bits"
+    out = np.zeros(w.shape + (W_LIMBS,), np.int32)
+    for i in range(W_LIMBS):
+        out[..., i] = (w >> (BF.BITS * i)) & BF.LMASK
+    return out
+
+
+def g1_from_device(p: G1P):
+    """Projective limb output -> bls_ref affine G1 point (host: two
+    python int mods + one inversion; None for the identity)."""
+    z = BF.from_limbs(np.asarray(p.z)) % ref.P
+    if z == 0:
+        return None
+    zi = pow(z, ref.P - 2, ref.P)
+    return (BF.from_limbs(np.asarray(p.x)) * zi % ref.P,
+            BF.from_limbs(np.asarray(p.y)) * zi % ref.P)
+
+
+def g2_from_device(p: G2P):
+    """Projective Fp2 limb output -> bls_ref affine G2 point."""
+    z = ref.fq2(BF.from_limbs(np.asarray(p.z[..., 0, :])) % ref.P,
+                BF.from_limbs(np.asarray(p.z[..., 1, :])) % ref.P)
+    if z.is_zero():
+        return None
+    zi = z.inv()
+    x = ref.fq2(BF.from_limbs(np.asarray(p.x[..., 0, :])) % ref.P,
+                BF.from_limbs(np.asarray(p.x[..., 1, :])) % ref.P)
+    y = ref.fq2(BF.from_limbs(np.asarray(p.y[..., 0, :])) % ref.P,
+                BF.from_limbs(np.asarray(p.y[..., 1, :])) % ref.P)
+    return (x * zi, y * zi)

@@ -118,18 +118,25 @@ def sum_mod_L(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def window_digits(s: jnp.ndarray, n_windows: int,
-                  c: int = WINDOW_C) -> jnp.ndarray:
+                  c: int = WINDOW_C, bits: int = BITS) -> jnp.ndarray:
     """[..., n_limbs] limbs -> [n_windows, ...] c-bit digits, least
-    significant window first."""
+    significant window first.
+
+    Generalized (ISSUE 10 satellite): `c` is the window width and
+    `bits` the scalar's limb radix — the Ed25519 instantiation is the
+    default (c=8 over 13-bit limbs), the BLS lane reads 4-bit windows
+    over `bls_field_jax`'s 12-bit limbs.  A window may straddle at
+    most one limb boundary, so c <= bits is required."""
+    assert 0 < c <= bits, (c, bits)
     nl = s.shape[-1]
     outs = []
     for w in range(n_windows):
         lo = c * w
-        li, off = lo // BITS, lo % BITS
+        li, off = lo // bits, lo % bits
         d = s[..., li] >> off
-        if off > BITS - c and li + 1 < nl:
-            d = d | (s[..., li + 1] << (BITS - off))
-        outs.append(d & (N_BUCKETS - 1))
+        if off > bits - c and li + 1 < nl:
+            d = d | (s[..., li + 1] << (bits - off))
+        outs.append(d & ((1 << c) - 1))
     return jnp.stack(outs, axis=0)
 
 
@@ -171,21 +178,144 @@ def _bucket_sums(points: E.Point, digits: jnp.ndarray) -> E.Point:
     return E.Point(*tuple(b[:N_BUCKETS] for b in buckets))
 
 
-def _bucket_aggregate(buckets: E.Point) -> E.Point:
-    """Σ_{d=1}^{N_BUCKETS-1} d * bucket[d] via the running-suffix
-    trick: acc accumulates suffix sums, total accumulates acc."""
-    idn = E.identity(())
+def bucket_aggregate_generic(buckets, *, point_add, identity,
+                             n_buckets: int):
+    """Σ_{d=1}^{n_buckets-1} d * bucket[d] via the running-suffix
+    trick (acc accumulates suffix sums, total accumulates acc) —
+    curve-generic: `point_add` combines two point pytrees, `identity`
+    builds an identity of a given leading shape.  The loop is a rolled
+    `fori_loop`, so the traced graph holds TWO point-add bodies
+    however wide the window is."""
+    idn = identity(())
 
     def body(j, carry):
         acc, tot = carry
-        d = N_BUCKETS - 1 - j
-        bd = E.Point(*(c[d] for c in buckets))
-        acc = E.point_add(acc, bd)
-        tot = E.point_add(tot, acc)
+        d = n_buckets - 1 - j
+        bd = jax.tree.map(lambda c: c[d], buckets)
+        acc = point_add(acc, bd)
+        tot = point_add(tot, acc)
         return acc, tot
 
-    _, tot = jax.lax.fori_loop(0, N_BUCKETS - 1, body, (idn, idn))
+    _, tot = jax.lax.fori_loop(0, n_buckets - 1, body, (idn, idn))
     return tot
+
+
+def _bucket_aggregate(buckets: E.Point) -> E.Point:
+    """The Ed25519 instantiation of `bucket_aggregate_generic`."""
+    return bucket_aggregate_generic(
+        buckets, point_add=E.point_add, identity=E.identity,
+        n_buckets=N_BUCKETS)
+
+
+def bucket_sums_seq(points, digits: jnp.ndarray, *, point_add,
+                    identity, n_buckets: int):
+    """One window's bucket sums, curve-generic, with the segmented
+    accumulation as a SEQUENTIAL `lax.scan` over the sorted lanes
+    instead of the log-depth associative scan: the traced graph holds
+    ONE point-add body regardless of N.
+
+    That trade is deliberate for the BLS lane: a generic-prime
+    (Barrett) field add costs ~5-15k traced ops, so the associative
+    scan's log2(N) instantiations would blow the XLA graph past
+    practical compile budgets, while the per-class lane counts
+    (N <= 1024) make N sequential adds cheap at runtime.  Ed25519's
+    `_bucket_sums` keeps the log-depth formulation (its field is ~10x
+    cheaper to instantiate and its batch sizes 100x larger)."""
+    order = jnp.argsort(digits)                  # stable
+    ds = digits[order]
+    pts = jax.tree.map(lambda c: c[order], points)
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), ds[1:] != ds[:-1]])
+    seg_end = jnp.concatenate(
+        [ds[1:] != ds[:-1], jnp.ones((1,), bool)])
+    # bucket arrays are [n_buckets + 1]: non-end lanes park their
+    # running sum in the dump slot (same trick as _bucket_sums)
+    buckets0 = identity((n_buckets + 1,))
+
+    def body(carry, inp):
+        buckets, acc = carry
+        pt, d, start, end = inp
+        summed = point_add(acc, pt)
+        acc = jax.tree.map(lambda a, b: jnp.where(start, a, b),
+                           pt, summed)
+        idx = jnp.where(end, d, n_buckets)
+        buckets = jax.tree.map(lambda b, a: b.at[idx].set(a),
+                               buckets, acc)
+        return (buckets, acc), None
+
+    (buckets, _), _ = jax.lax.scan(
+        body, (buckets0, identity(())), (pts, ds, seg_start, seg_end))
+    return jax.tree.map(lambda b: b[:n_buckets], buckets)
+
+
+def bucket_aggregate_merged(buckets, *, point_add, identity,
+                            n_buckets: int):
+    """`bucket_aggregate_generic` with the two adds per iteration
+    folded into ONE point-add instantiation (2(nb-1) iterations
+    alternating acc-accumulate / total-accumulate via selects).  The
+    BLS lane uses this: its generic-prime point add costs thousands of
+    traced ops, so halving the instantiation count is worth the extra
+    rolled iterations; Ed25519's `_bucket_aggregate` keeps the plain
+    two-add body."""
+    idn = identity(())
+
+    def body(j, carry):
+        acc, tot = carry
+        even = (j % 2) == 0
+        d = n_buckets - 1 - j // 2
+        bd = jax.tree.map(lambda c: c[d], buckets)
+        lhs = jax.tree.map(lambda a, t: jnp.where(even, a, t),
+                           acc, tot)
+        rhs = jax.tree.map(lambda b, a: jnp.where(even, b, a),
+                           bd, acc)
+        s = point_add(lhs, rhs)
+        acc = jax.tree.map(lambda a, sv: jnp.where(even, sv, a),
+                           acc, s)
+        tot = jax.tree.map(lambda t, sv: jnp.where(even, t, sv),
+                           tot, s)
+        return acc, tot
+
+    _, tot = jax.lax.fori_loop(0, 2 * (n_buckets - 1), body,
+                               (idn, idn))
+    return tot
+
+
+def msm_generic(points, scalars: jnp.ndarray, n_windows: int, *,
+                point_add, identity, window_c: int = WINDOW_C,
+                bits: int = BITS):
+    """Multi-scalar multiplication Σ [scalarᵢ] Pᵢ, generic over the
+    curve (`point_add`/`identity` pytree ops), the window width and
+    the scalar limb radix — the Pippenger machinery `msm` instantiates
+    for Ed25519, reusable by the BLS lane (bls_jax).  Lanes with
+    scalar 0 contribute nothing (every window digit lands in the
+    excluded 0 bucket), which is how padding rows are dropped without
+    a mask.
+
+    Graph-size discipline: the whole MSM instantiates THREE point-add
+    bodies — the sequential bucket scan, the merged bucket aggregate,
+    and one (window_c + 1)-iteration fori whose first window_c
+    rounds double the accumulator and whose last round adds the
+    window sum (select on the iteration index)."""
+    digits = window_digits(scalars, n_windows, c=window_c, bits=bits)
+    nb = 1 << window_c
+
+    def body(acc, dig):
+        wsum = bucket_aggregate_merged(
+            bucket_sums_seq(points, dig, point_add=point_add,
+                            identity=identity, n_buckets=nb),
+            point_add=point_add, identity=identity, n_buckets=nb)
+
+        def dbl_or_add(i, a):
+            rhs = jax.tree.map(
+                lambda av, wv: jnp.where(i < window_c, av, wv),
+                a, wsum)
+            return point_add(a, rhs)
+
+        acc = jax.lax.fori_loop(0, window_c + 1, dbl_or_add, acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, identity(()), digits[::-1])
+    return acc
 
 
 def msm(points: E.Point, scalars: jnp.ndarray,

@@ -47,6 +47,7 @@ CANONICAL_MODULES = (
     "agnes_tpu.parallel.sharded",
     "agnes_tpu.crypto.ed25519_jax",
     "agnes_tpu.crypto.msm_jax",
+    "agnes_tpu.crypto.bls_jax",
     "agnes_tpu.crypto.pallas_verify",
     "agnes_tpu.crypto.pallas_ed25519",
 )

@@ -42,6 +42,16 @@ entries while fresh traffic keeps the signed fused path (at a smaller
 rung).  Entries are inserted only after a dispatch's device verify
 settles with zero rejected lanes, so forged duplicates can never
 pre-populate the cache.
+
+bls_lane.py (ISSUE 10 tentpole) adds the BLS aggregate-precommit
+lane: same-class precommits fold into per-(height, round, value)
+AggregateClass buckets at admission, aggregate on device
+(crypto/bls_jax stake-weighted MSMs on one padded ladder rung), and
+clear with ONE pairing-product per class — the whole class then rides
+the verify-free unsigned entries like a dedup hit.  Rogue-key defense
+is an admission-time proof-of-possession registry; a failed pairing
+falls back to per-share verification so a forged share can never
+poison or suppress honest votes (README "BLS aggregate lane").
 """
 
 from agnes_tpu.serve.batcher import MicroBatcher, ShapeLadder  # noqa: F401
@@ -64,6 +74,12 @@ from agnes_tpu.serve.queue import (  # noqa: F401
 from agnes_tpu.utils.lazy import make_lazy_getattr  # noqa: E402
 
 __getattr__ = make_lazy_getattr(__name__, {
+    # bls_lane's MODULE is jax-free, but BlsKeyRegistry's constructor
+    # packs device pubkey limbs through the jax kernels — keep the
+    # whole lane behind the lazy seam with the other dispatch members
+    "BlsClassTable": ("agnes_tpu.serve.bls_lane", "BlsClassTable"),
+    "BlsKeyRegistry": ("agnes_tpu.serve.bls_lane", "BlsKeyRegistry"),
+    "BlsLane": ("agnes_tpu.serve.bls_lane", "BlsLane"),
     "ServePipeline": ("agnes_tpu.serve.pipeline", "ServePipeline"),
     "Decision": ("agnes_tpu.serve.service", "Decision"),
     "VoteService": ("agnes_tpu.serve.service", "VoteService"),

@@ -48,18 +48,30 @@ def _ceil_pow2(n: int) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class ShapeLadder:
-    """Ascending power-of-two lane counts the serve plane may emit."""
+    """Ascending power-of-two lane counts the serve plane may emit.
+
+    `bls_rungs` is the MIXED-MODE extension (ISSUE 10): the BLS
+    aggregate lane pads each vote class's signer count onto one of
+    these rungs before the `bls_aggregate` MSM dispatch, so the
+    aggregation kernel — like the fused verify — compiles a
+    logarithmic number of shapes for the service's lifetime and every
+    one of them is warmable (ServePipeline.warmup covers them when a
+    lane is attached).  Empty = no BLS lane planned."""
 
     rungs: Tuple[int, ...]
+    bls_rungs: Tuple[int, ...] = ()
 
     def __post_init__(self):
         if not self.rungs:
             raise ValueError("empty shape ladder")
-        for r in self.rungs:
+        for r in self.rungs + self.bls_rungs:
             if r & (r - 1) or r <= 0:
                 raise ValueError(f"rungs must be powers of two: {r}")
         if list(self.rungs) != sorted(set(self.rungs)):
             raise ValueError(f"rungs must be ascending: {self.rungs}")
+        if list(self.bls_rungs) != sorted(set(self.bls_rungs)):
+            raise ValueError(
+                f"bls_rungs must be ascending: {self.bls_rungs}")
 
     @property
     def min_rung(self) -> int:
@@ -142,9 +154,36 @@ class ShapeLadder:
             r <<= 1
         return cls(rungs=tuple(rungs))
 
+    def with_bls(self, n_validators: int,
+                 min_rung: int = 16) -> "ShapeLadder":
+        """Extend with BLS aggregation rungs: powers of two from
+        `min_rung` up to the validator count (a class can never hold
+        more signers than validators)."""
+        min_rung = _ceil_pow2(min_rung)
+        top = max(_ceil_pow2(n_validators), min_rung)
+        rungs = []
+        r = min_rung
+        while r <= top:
+            rungs.append(r)
+            r <<= 1
+        return dataclasses.replace(self, bls_rungs=tuple(rungs))
+
+    def bls_rung_for(self, n_signers: int) -> int:
+        """Smallest BLS rung holding `n_signers` aggregation lanes."""
+        for r in self.bls_rungs:
+            if n_signers <= r:
+                return r
+        raise ValueError(
+            f"{n_signers} signers exceed the top BLS rung "
+            f"{self.bls_rungs[-1] if self.bls_rungs else 0}")
+
     def describe(self) -> str:
-        return ("shape ladder: " + " ".join(str(r) for r in self.rungs)
-                + " lanes")
+        out = ("shape ladder: " + " ".join(str(r) for r in self.rungs)
+               + " lanes")
+        if self.bls_rungs:
+            out += (" | bls: "
+                    + " ".join(str(r) for r in self.bls_rungs))
+        return out
 
 
 class MicroBatcher:

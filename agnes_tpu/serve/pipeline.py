@@ -120,6 +120,7 @@ class ServePipeline:
                  donate: bool = True,
                  dense: Optional[bool] = None,
                  cache=None,
+                 bls_lane=None,
                  tracer: Optional[Tracer] = None,
                  metrics=None,
                  flightrec=None,
@@ -141,6 +142,12 @@ class ServePipeline:
         self.window_predictor = window_predictor
         self.donate = donate
         self.cache = cache
+        # BLS aggregate lane (ISSUE 10, serve/bls_lane.BlsLane):
+        # pump() hands it closed classes, stage_bls() aggregates them
+        # on device, pairing-checks on host and feeds the cleared
+        # rows down the SAME split-rung unsigned path as dedup-cache
+        # hits — one warmed-shape discipline for both
+        self.bls_lane = bls_lane
         self.dense = (dense if dense is not None
                       else getattr(driver, "mesh", None) is not None)
         self.tracer = tracer
@@ -181,6 +188,10 @@ class ServePipeline:
         # dedup-cache hit (dispatched_* above count BOTH streams)
         self.preverified_builds = 0
         self.preverified_votes = 0
+        # BLS aggregate lane accounting: votes that entered via a
+        # pairing-cleared class / the per-share fallback (subsets of
+        # preverified_votes — lane rows ride the unsigned stream)
+        self.bls_votes = 0
         # lane shapes above the ladder's top rung.  Historically: a
         # held future-round burst entering the window in the same
         # round as a full new batch drained into one build — a pow2
@@ -294,13 +305,15 @@ class ServePipeline:
         re-entering on a later tick keep their stream: a fresh vote can
         never slip into an unsigned build."""
         staged = False
-        # gate on the CACHE, not merely a signed deployment: without
-        # one, no admission path ever sets the verified column, so the
-        # split would be a per-tick no-op walk — and a stray
-        # verified=True row fed directly to the batcher must not ride
-        # an unsigned build that no cache vouched for
+        # gate on the CACHE or the BLS LANE, not merely a signed
+        # deployment: without either, no admission path ever sets the
+        # verified column, so the split would be a per-tick no-op walk
+        # — and a stray verified=True row fed directly to the batcher
+        # must not ride an unsigned build that neither a cache hit nor
+        # a cleared pairing vouched for
         pre = (self.batcher.split_pending_verified()
-               if self.cache is not None else [])
+               if (self.cache is not None
+                   or self.bls_lane is not None) else [])
         while self.batcher.pending_votes > 0:
             before = self.batcher.pending_votes
             staged |= self._build_one(hts, t_first)
@@ -470,12 +483,45 @@ class ServePipeline:
             total += st.n_votes
         return total
 
-    def pump(self, batch: Optional[WireColumns]) -> Tuple[int, bool]:
+    def stage_bls(self, classes) -> bool:
+        """Aggregate-lane staging (ISSUE 10): device-MSM + pairing-
+        check the closed classes (BlsLane.clear_classes), then feed
+        every surviving row — pairing-cleared class members and
+        per-share fallback survivors alike — into the batcher as
+        PRE-VERIFIED votes and build them through the same split-rung
+        unsigned path as dedup-cache hits.  Forged shares died inside
+        the lane (counted there); nothing unverified can reach an
+        unsigned entry through this path."""
+        if not classes or self.bls_lane is None:
+            return False
+        with self._span("serve.bls_clear"):
+            rows = self.bls_lane.clear_classes(classes)
+        if rows is None:
+            self.noop_ticks += 1
+            return False
+        n = len(rows["instance"])
+        with self._span("serve.densify"):
+            hts = self._sync_window()
+            self.batcher.add_class_votes(
+                rows["instance"], rows["validator"], rows["height"],
+                rows["round_"], rows["typ"], rows["value"])
+            self.bls_votes += n
+            staged = self._build_all(
+                hts, rows["t_first"] if rows["t_first"] is not None
+                else self._clock())
+        if not staged:
+            self.noop_ticks += 1
+        return staged
+
+    def pump(self, batch: Optional[WireColumns],
+             bls_classes=None) -> Tuple[int, bool]:
         """One pipeline tick: dispatch what was staged, then densify
-        `batch` while the device runs.  Returns (votes dispatched,
-        staged?)."""
+        `batch` (and any closed BLS classes) while the device runs.
+        Returns (votes dispatched, staged?)."""
         dispatched = self.dispatch_staged()
         staged = self.stage(batch)
+        if bls_classes:
+            staged |= self.stage_bls(bls_classes)
         return dispatched, staged
 
     # -- settle --------------------------------------------------------------
@@ -549,7 +595,7 @@ class ServePipeline:
         serve dispatch whose (entry, shape-signature) was not warmed
         fails loudly and bumps `retrace_unexpected`, instead of
         stalling the service on a live multi-minute compile."""
-        if self.pubkeys is None:
+        if self.pubkeys is None and self.bls_lane is None:
             return 0
         import jax
 
@@ -571,7 +617,9 @@ class ServePipeline:
             exts = [d.ext()] * P
             phases_st = jax.tree.map(lambda *xs: jnp.stack(xs), *phases)
             exts_st = jax.tree.map(lambda *xs: jnp.stack(xs), *exts)
-            if self.dense:
+            if self.pubkeys is None:
+                pass                      # BLS-only: no signed rungs
+            elif self.dense:
                 Ps = max(P - 1, 1)           # entry carries no lanes
                 dense = DenseSignedPhases(
                     pub=jnp.zeros((d.V, 32), jnp.int32),
@@ -603,14 +651,16 @@ class ServePipeline:
                              verify_chunk=chunk)
                     jax.block_until_ready(out.state)
                     warmed += 1
-            if self.cache is not None:
-                # split-rung dispatch (ISSUE 5): pre-verified builds
-                # ride the UNSIGNED sequence entries — warm (and
-                # tripwire-arm) those at the same P, so a burst of
-                # dedup hits can never stall the service on a live
-                # unsigned-entry trace.  Their compile key carries no
-                # lane rung (phases are dense [P, I, V]): one shape
-                # per P, sharing this loop's stacked phases/exts.
+            if self.cache is not None or self.bls_lane is not None:
+                # split-rung dispatch (ISSUE 5 + ISSUE 10):
+                # pre-verified builds — dedup-cache hits AND
+                # pairing-cleared BLS class rows — ride the UNSIGNED
+                # sequence entries; warm (and tripwire-arm) those at
+                # the same P, so a burst of either can never stall
+                # the service on a live unsigned-entry trace.  Their
+                # compile key carries no lane rung (phases are dense
+                # [P, I, V]): one shape per P, sharing this loop's
+                # stacked phases/exts.
                 args = (*copies(), exts_st, phases_st, d.powers,
                         d.total, d.proposer_flag, d.propose_value)
                 if d.mesh is not None:
@@ -627,6 +677,22 @@ class ServePipeline:
                     out = registry.timed_entry(name)(
                         *args, advance_height=d.advance_height)
                 jax.block_until_ready(out.state)
+                warmed += 1
+        if self.bls_lane is not None and self.ladder.bls_rungs:
+            # the aggregate lane's MSM entry: one compiled shape per
+            # BLS rung (all-zero inputs with weight 0 — the padding
+            # encoding — build the exact runtime shapes)
+            from agnes_tpu.crypto import bls_jax as _bj
+
+            fn = registry.timed_entry("bls_aggregate")
+            nw = self.bls_lane.registry.n_windows
+            for r in self.ladder.bls_rungs:
+                args = (jnp.zeros((r, 2, _bj.NLIMBS), jnp.int32),
+                        jnp.zeros((r, 4, _bj.NLIMBS), jnp.int32),
+                        jnp.zeros((r, _bj.W_LIMBS), jnp.int32))
+                d._observe("bls_aggregate", args, statics=(nw,))
+                out = fn(*args, n_windows=nw)
+                jax.block_until_ready(out[0].x)
                 warmed += 1
         if arm and getattr(d, "sentinel", None) is not None:
             d.sentinel.arm()

@@ -219,10 +219,20 @@ class AdmissionQueue:
                  instance_cap: Optional[int] = None,
                  policy: str = REJECT_NEWEST,
                  cache=None,
+                 bls_table=None,
                  clock=time.monotonic):
         """`cache` is an optional serve/cache.VerifiedCache: admitted
         records are digest-looked-up and hits marked pre-verified
-        (module docstring); None = dedup off, zero added work."""
+        (module docstring); None = dedup off, zero added work.
+
+        `bls_table` (serve/bls_lane.BlsClassTable) enables the
+        CLASS-BUCKETING mode (ISSUE 10): `submit_bls` folds BLS wire
+        shares into per-(instance, height, round, typ, value)
+        aggregate classes instead of the record queue — the table is
+        bounded and fail-closed on its own (max open classes, one
+        share per signer, PoP-verified signers only), and its rejects
+        surface through this queue's counters so the admission plane
+        reports through one place."""
         if capacity <= 0:
             raise ValueError(f"capacity must be positive: {capacity}")
         if policy not in (REJECT_NEWEST, DROP_OLDEST):
@@ -239,6 +249,7 @@ class AdmissionQueue:
                 f"instance_cap must be positive: {instance_cap}")
         self.policy = policy
         self.cache = cache
+        self.bls_table = bls_table
         # optional utils.metrics.Histogram: submit -> drain wait per
         # drained chunk (ISSUE 8 `serve_admit_wait_s`; VoteService
         # wires the shared registry's histogram in).  A plain
@@ -342,6 +353,32 @@ class AdmissionQueue:
                            rejected_fairness, malformed, evicted,
                            pre_verified)
 
+    def submit_bls(self, wire_bytes) -> AdmitResult:
+        """Class-bucketing admission (ISSUE 10): fold packed BLS wire
+        records (serve/bls_lane wire ABI) into the aggregate-class
+        table.  Folded shares count as accepted; every reject cause
+        maps onto this queue's counter taxonomy (PoP-missing, unknown
+        validator, duplicate and quarantined-forger shares count as
+        FAIRNESS rejects — they are per-identity admission refusals —
+        class-table overflow as OVERFLOW, bad points/truncation as
+        MALFORMED)."""
+        if self.bls_table is None:
+            raise ValueError(
+                "submit_bls on a queue without a bls_table (pass "
+                "BlsClassTable/BlsLane at construction)")
+        res = self.bls_table.fold(wire_bytes)
+        fairness = (res["pop_missing"] + res["unknown_validator"]
+                    + res["duplicate"] + res["quarantined"])
+        self.counters["submitted"] += (res["folded"] + fairness
+                                       + res["malformed"]
+                                       + res["overflow"])
+        self.counters["admitted"] += res["folded"]
+        self.counters["rejected_overflow"] += res["overflow"]
+        self.counters["rejected_fairness"] += fairness
+        self.counters["rejected_malformed"] += res["malformed"]
+        return AdmitResult(res["folded"], res["overflow"], fairness,
+                           res["malformed"], 0)
+
     # -- state-space surface (analysis/admission_mc.py) ----------------------
 
     def mc_clone(self) -> "AdmissionQueue":
@@ -357,6 +394,7 @@ class AdmissionQueue:
         q.instance_cap = self.instance_cap
         q.policy = self.policy
         q.cache = self.cache
+        q.bls_table = self.bls_table
         q._clock = self._clock
         q.wait_hist = self.wait_hist
         q._chunks = collections.deque(self._chunks)

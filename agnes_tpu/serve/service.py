@@ -76,6 +76,14 @@ SERVE_CACHE_EVICTIONS = "serve_cache_evictions"
 SERVE_CACHE_BYTES = "serve_cache_bytes"                  # gauge
 SERVE_CACHE_HIT_RATE = "serve_cache_hit_rate_window"     # gauge
 SERVE_PREVERIFIED_DISPATCHED = "serve_preverified_votes_dispatched"
+#: BLS aggregate lane (ISSUE 10, serve/bls_lane.py): pairing-cleared
+#: classes, votes that fell back to per-share verification after a
+#: failed class pairing, and shares the admission fold rejected for a
+#: missing proof of possession (rogue-key defense) — counters; the
+#: pairing wall-clock histogram name lives in utils/metrics.py
+SERVE_BLS_AGG_CLASSES = "serve_bls_agg_classes"
+SERVE_BLS_FALLBACK_VOTES = "serve_bls_fallback_votes"
+SERVE_BLS_POP_MISSING = "bls_pop_missing"
 #: threaded-host gauges (serve/threaded.py): per-thread depth and
 #: utilization — the inbox depth the submit thread drains, and each
 #: loop's busy fraction over its last gauge window
@@ -135,6 +143,7 @@ class VoteService:
                  window_predictor=None,
                  donate: bool = True,
                  dedup_cache=None,
+                 bls_lane=None,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
                  flightrec=None,
@@ -145,6 +154,17 @@ class VoteService:
         failures land in its bounded ring, and a Heartbeat over the
         same recorder leaves a crash-surviving NDJSON trail.  The
         recorder is also handed to the driver (dispatch events).
+
+        `bls_lane` (serve/bls_lane.BlsLane) attaches the BLS
+        aggregate-precommit lane (ISSUE 10): `submit_bls` folds BLS
+        wire shares into per-class buckets, pump() closes classes
+        size-or-deadline, the pipeline aggregates them on device
+        (`bls_aggregate`), pairing-checks through the bls_ref oracle
+        and dispatches cleared classes down the verify-free unsigned
+        entries; a failed pairing falls back to per-share
+        verification so a forged share can neither be counted nor
+        suppress honest shares.  Works beside OR without Ed25519
+        `pubkeys` (a BLS-only deployment passes pubkeys=None).
 
         `dedup_cache` enables the verified-vote dedup layer
         (ISSUE 5): pass a serve/cache.VerifiedCache (or True for a
@@ -169,6 +189,7 @@ class VoteService:
         else:
             dedup_cache = None
         self.cache = dedup_cache
+        self.bls = bls_lane
         if ladder is None:
             if getattr(driver, "mesh", None) is not None:
                 # dense dispatch mode: the compile shape is fixed by
@@ -178,16 +199,21 @@ class VoteService:
                     I, V, local_shape=driver._local_shape())
             else:
                 ladder = ShapeLadder.plan(I, V)
+        if bls_lane is not None and not ladder.bls_rungs:
+            # the aggregation MSM needs its own warmed rung set
+            ladder = ladder.with_bls(V)
         self.metrics = metrics or Metrics()
         self.flightrec = flightrec
         # default queue: two full both-classes ticks — enough to
         # absorb a burst while one tick is in flight, small enough
         # that overload surfaces as rejects, not as unbounded memory
         capacity = capacity if capacity is not None else 4 * I * V
-        self.queue = AdmissionQueue(I, capacity,
-                                    instance_cap=instance_cap,
-                                    policy=overload_policy,
-                                    cache=self.cache, clock=clock)
+        self.queue = AdmissionQueue(
+            I, capacity, instance_cap=instance_cap,
+            policy=overload_policy, cache=self.cache,
+            bls_table=(bls_lane.table if bls_lane is not None
+                       else None),
+            clock=clock)
         # serve latency histograms (ISSUE 8): admission wait recorded
         # by the queue at drain; close age + submit->decision here;
         # dispatch/settle walls inside the pipeline — one registry
@@ -201,9 +227,12 @@ class VoteService:
         self.pipeline = ServePipeline(driver, batcher, pubkeys, ladder,
                                       window_predictor=window_predictor,
                                       donate=donate, cache=self.cache,
+                                      bls_lane=bls_lane,
                                       tracer=tracer,
                                       metrics=self.metrics,
                                       flightrec=flightrec, clock=clock)
+        if bls_lane is not None:
+            bls_lane.bind(driver, metrics=self.metrics, ladder=ladder)
         self.driver = driver
         if flightrec is not None and \
                 getattr(driver, "flightrec", None) is None:
@@ -268,6 +297,56 @@ class VoteService:
         m.gauge(SERVE_QUEUE_DEPTH, self.queue.depth)
         return res
 
+    def submit_bls(self, wire_bytes) -> AdmitResult:
+        """Admit packed BLS wire shares (serve/bls_lane wire ABI)
+        into the class-bucketing lane; same fail-closed semantics as
+        submit (a draining service rejects everything)."""
+        if self.bls is None:
+            raise ValueError("submit_bls on a service without a "
+                             "bls_lane")
+        from agnes_tpu.serve.bls_lane import BLS_REC_SIZE
+
+        if self._draining:
+            n = len(wire_bytes) // BLS_REC_SIZE
+            tail = 1 if len(wire_bytes) % BLS_REC_SIZE else 0
+            self.metrics.count(SERVE_SUBMITTED, n + tail)
+            self.metrics.count(SERVE_REJECTED_OVERFLOW, n)
+            self.metrics.count(SERVE_REJECTED_MALFORMED, tail)
+            return AdmitResult(0, n, 0, tail, 0)
+        res = self.queue.submit_bls(wire_bytes)
+        m = self.metrics
+        m.count(SERVE_SUBMITTED, res.accepted + res.rejected)
+        m.count(SERVE_ADMITTED, res.accepted)
+        m.count(SERVE_REJECTED_OVERFLOW, res.rejected_overflow)
+        m.count(SERVE_REJECTED_FAIRNESS, res.rejected_fairness)
+        m.count(SERVE_REJECTED_MALFORMED, res.rejected_malformed)
+        # the rogue-key reject is its own well-known number: a fleet
+        # suddenly dropping shares for missing PoPs is a registry
+        # problem, not load
+        m.gauge(SERVE_BLS_POP_MISSING,
+                self.bls.table.counters["bls_pop_missing"])
+        if self.flightrec is not None and res.rejected:
+            self.flightrec.event(
+                "reject", overflow=res.rejected_overflow,
+                fairness=res.rejected_fairness,
+                malformed=res.rejected_malformed, bls=True)
+        return res
+
+    def _mirror_bls_metrics(self) -> None:
+        """Reconcile the lane's counters into the shared registry —
+        called from every path that clears classes (pump ticks AND
+        the drain flush), so scrapes/heartbeats/drain reports never
+        under-report against the lane's own snapshot."""
+        if self.bls is None:
+            return
+        c = self.bls.counters
+        for name, key in ((SERVE_BLS_AGG_CLASSES, "agg_classes"),
+                          (SERVE_BLS_FALLBACK_VOTES,
+                           "fallback_votes")):
+            delta = c[key] - self.metrics.counters.get(name, 0)
+            if delta > 0:
+                self.metrics.count(name, delta)
+
     # -- the event-loop tick -------------------------------------------------
 
     def pump(self, now: Optional[float] = None) -> dict:
@@ -290,14 +369,19 @@ class VoteService:
         return self.micro.poll(now)
 
     def _pump_batch(self, batch) -> dict:
-        """Pipeline half of a tick: dispatch staged, densify `batch`."""
+        """Pipeline half of a tick: dispatch staged, densify `batch`
+        (and any size-or-deadline-closed BLS classes — polled HERE,
+        under the same lock domain as the pipeline, so the threaded
+        host's split pump keeps working unchanged)."""
         n_batch = len(batch) if batch is not None else 0
         if n_batch:
             # oldest-record age at close (size- OR deadline-closed):
             # the batching delay component of end-to-end latency
             self._h_close_age.record(self._clock() - batch.t_first,
                                      n_batch)
-        dispatched, staged = self.pipeline.pump(batch)
+        bls_classes = self.bls.poll() if self.bls is not None else None
+        dispatched, staged = self.pipeline.pump(batch, bls_classes)
+        self._mirror_bls_metrics()
         m = self.metrics
         if n_batch:
             m.count(SERVE_BATCHES)
@@ -408,6 +492,14 @@ class VoteService:
         #    flushed PRE-VERIFIED votes ride the verify-free unsigned
         #    entries instead of paying a signed-rung dispatch at
         #    shutdown (the ISSUE 5 drain fix)
+        if self.bls is not None:
+            # flush every open class through the lane (aggregate +
+            # pairing + dispatch), before held-vote re-entry
+            open_cls = self.bls.flush()
+            if open_cls:
+                self.pipeline.pump(None, open_cls)
+                self.pipeline.pump(None)
+            self._mirror_bls_metrics()
         self.pipeline.window_predictor = None
         held_before = self.batcher.held_votes
         if held_before:
@@ -457,6 +549,9 @@ class VoteService:
             "preverified_votes": self.pipeline.preverified_votes,
             "serve_cache": (self.cache.snapshot()
                             if self.cache is not None else None),
+            "bls": (self.bls.snapshot() if self.bls is not None
+                    else None),
+            "bls_votes": self.pipeline.bls_votes,
             "metrics": snap,
             "serve_rates_window": {k: v for k, v in snap.items()
                                    if k.endswith("_per_sec")},

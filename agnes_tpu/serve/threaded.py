@@ -174,7 +174,13 @@ class ThreadedVoteService:
             # pump when there is a closed batch OR builds staged by a
             # previous tick wait for their dispatch (reading the FIFO's
             # truthiness unlocked is benign: worst case one extra tick)
-            if batch is not None or self.service.pipeline._staged:
+            # OR a BLS aggregate class would close (ISSUE 10: classes
+            # are polled inside _pump_batch, so without this gate a
+            # BLS-only — or Ed25519-quiet — deployment would strand
+            # deadline-expired classes until drain)
+            if (batch is not None or self.service.pipeline._staged
+                    or (self.service.bls is not None
+                        and self.service.bls.ready())):
                 t0 = self._clock()
                 with self._device:
                     self.service._pump_batch(batch)

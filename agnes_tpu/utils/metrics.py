@@ -361,6 +361,16 @@ SERVE_BATCH_CLOSE_AGE_S = "serve_batch_close_age_s"
 SERVE_DISPATCH_WALL_S = "serve_dispatch_wall_s"
 SERVE_SETTLE_WALL_S = "serve_settle_wall_s"
 SERVE_E2E_DECISION_S = "serve_submit_to_decision_s"
+#: ISSUE 10 (BLS aggregate lane, serve/bls_lane.py): host wall of one
+#: class's pairing-product check — the O(1)-per-class cost the lane
+#: trades N Ed25519 verifies for (memo hits record ~0; the histogram
+#: lives in `Metrics.hists`, so the drain report, the /metrics scrape
+#: and every heartbeat source reading a registry snapshot carry its
+#: quantiles like the serve histograms above).  The lane's companion
+#: COUNTERS — `serve_bls_agg_classes` / `serve_bls_fallback_votes` /
+#: `bls_pop_missing` — are named in serve/service.py next to the rest
+#: of the serve counter taxonomy.
+BLS_PAIRING_WALL_S = "bls_pairing_wall_s"
 #: per-entry first-dispatch wall gauges, `compile_ms_<entry>` (ISSUE 8
 #: satellite): the registry times the FIRST dispatch of every entry in
 #: the process (trace + compile dominates that call), so the next

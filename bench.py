@@ -212,15 +212,24 @@ _SERVE_MESH_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_MESH_SMOKE"))
 #: CPU, same crash-safe contract.  AGNES_BENCH_SERVE_DUP sets the
 #: duplication factor (default 8)
 _SERVE_DEDUP_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_DEDUP_SMOKE"))
+#: BLS-aggregate-smoke mode (ci.sh gate, ISSUE 10): ONLY the BLS
+#: aggregate-lane serve probe — one pairing per vote class instead of
+#: one Ed25519 verify per vote — then the SAME traffic per-vote
+#: Ed25519 in-process for the bls_agg_speedup ratio; CPU, crash-safe
+_SERVE_BLS_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_BLS_SMOKE"))
 _SENTINEL_METRIC = ("pipeline_serve_mesh_votes_per_sec"
                     if _SERVE_MESH_SMOKE
                     else "pipeline_serve_dedup_votes_per_sec"
                     if _SERVE_DEDUP_SMOKE
+                    else "pipeline_serve_bls_votes_per_sec"
+                    if _SERVE_BLS_SMOKE
                     else "pipeline_fused_votes_per_sec" if _SERVE_SMOKE
                     else "pipeline_votes_per_sec")
 _SENTINEL_STAGE = ("bench_pipeline_serve_mesh" if _SERVE_MESH_SMOKE
                    else "bench_pipeline_serve_dedup"
                    if _SERVE_DEDUP_SMOKE
+                   else "bench_pipeline_serve_bls"
+                   if _SERVE_BLS_SMOKE
                    else "bench_pipeline_serve" if _SERVE_SMOKE
                    else "bench_pipeline")
 
@@ -231,7 +240,7 @@ _EXTRA_RECORD: dict = {}
 
 #: every serve smoke is a CPU-only CI gate (no TPU claim/lease/probe)
 _ANY_SERVE_SMOKE = (_SERVE_SMOKE or _SERVE_MESH_SMOKE
-                    or _SERVE_DEDUP_SMOKE)
+                    or _SERVE_DEDUP_SMOKE or _SERVE_BLS_SMOKE)
 
 
 def _emit_sentinel(note: str) -> None:
@@ -1498,6 +1507,180 @@ def _pipeline_serve_dedup(n_instances: int, n_validators: int,
     return rate_on
 
 
+def _pipeline_serve_bls(n_instances: int, n_validators: int,
+                        heights: int) -> float:
+    """CLOSED-LOOP through the serve plane's BLS AGGREGATE lane
+    (ISSUE 10): every height's prevote/precommit class arrives as BLS
+    wire shares, folds into one AggregateClass per (height, typ),
+    aggregates on device (`bls_aggregate`, one padded ladder rung) and
+    clears with ONE pairing-product per class — then dispatches the
+    whole class down the verify-free unsigned entries.  Afterwards the
+    SAME traffic shape runs per-vote Ed25519 in-process (the
+    `_pipeline_serve` path) for the `bls_agg_speedup` ratio —
+    PAPERS.md 2302.00418's trade measured end-to-end: BLS is ~10x
+    slower per signature but one aggregate check covers the class.
+
+    Bench keys (via _EXTRA_RECORD): `bls_agg_speedup`,
+    `pipeline_serve_bls_ed25519_votes_per_sec`, `bls_class_size`,
+    `serve_bls_agg_classes`, `serve_bls_fallback_votes`.
+
+    Fixture keys are THROWAWAY benchmark keys (sk_v = v + 1): shares
+    and pubkeys build incrementally (one G2/G1 add per validator), so
+    fixture setup stays O(V) python point-adds, not O(V) scalar
+    mults.  The registry unlocks them through the trust-root seam
+    (`mark_trusted`); the cryptographic PoP path (`register_pop`) is
+    covered by tests/test_bls.py — one pairing per validator is an
+    admission-time cost, not a steady-state serve cost."""
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+    from agnes_tpu.core import native
+    from agnes_tpu.crypto import bls_ref as bref
+    from agnes_tpu.crypto.encoding import vote_signing_bytes
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.serve import ShapeLadder, VoteService
+    from agnes_tpu.serve.bls_lane import (
+        BlsKeyRegistry,
+        BlsLane,
+        pack_bls_wire,
+    )
+    from agnes_tpu.utils.config import RunConfig
+    from agnes_tpu.utils.metrics import RETRACE_UNEXPECTED
+
+    I, V = n_instances, n_validators
+    n = I * V
+    PV, PC = int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+
+    # -- BLS fixtures (incremental multiples of G1 / H(msg)) -----------------
+    pk_pts = []
+    acc = None
+    for _v in range(V):
+        acc = bref.point_add(acc, bref.G1)
+        pk_pts.append(acc)
+    pk_bytes = np.stack([
+        np.frombuffer(bref.g1_compress(p), np.uint8) for p in pk_pts])
+
+    def bls_wire(h: int, typ: int) -> bytes:
+        base = bref.hash_to_g2(vote_signing_bytes(h, 0, typ, 7))
+        sig, shares = None, []
+        for _v in range(V):
+            sig = bref.point_add(sig, base)
+            shares.append(np.frombuffer(bref.g2_to_bytes(sig),
+                                        np.uint8))
+        shares = np.tile(np.stack(shares), (I, 1))
+        return pack_bls_wire(inst, val, np.full(n, h), np.zeros(n),
+                             np.full(n, typ), np.full(n, 7), shares)
+
+    all_bls = [{typ: bls_wire(h, typ) for typ in (PV, PC)}
+               for h in range(heights + 1)]
+
+    reg = BlsKeyRegistry(pk_bytes)
+    reg.mark_trusted(np.arange(V))
+    rung = 1 << (V - 1).bit_length()
+    lane = BlsLane(reg, I, max_classes=4 * I,
+                   target_signers=V, max_delay_s=1e9)
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True,
+                     audit=True)
+    bat = RunConfig(n_validators=V, n_instances=I,
+                    n_slots=4).validate().make_batcher()
+    cur = {"h": 0}
+    svc = VoteService(
+        d, bat, None, bls_lane=lane, capacity=4 * n, target_votes=n,
+        max_delay_s=1e9,
+        ladder=ShapeLadder.plan(I, V).with_bls(V, min_rung=rung),
+        window_predictor=lambda: (np.zeros(I, np.int64),
+                                  np.full(I, cur["h"], np.int64)),
+        flightrec=_FLIGHTREC)
+    _set_probe_source(lambda: svc.metrics.snapshot(
+        window=True, window_key="heartbeat"))
+    # warm the unsigned entries AND the BLS aggregation rung, then arm
+    # the retrace tripwire: the whole measured run must dispatch ZERO
+    # unplanned compiles (the mixed-mode warmup acceptance)
+    svc.pipeline.warmup()
+
+    def run_height(h: int) -> None:
+        cur["h"] = h
+        for typ in (PV, PC):
+            svc.submit_bls(all_bls[h][typ])
+            svc.pump()               # close + aggregate + pair + stage
+            svc.pump()               # dispatch
+        svc.poll_decisions()
+
+    run_height(0)                    # pairing memos cold, shapes warm
+    d.block_until_ready()
+    assert d.stats.decisions_total == I, d.stats.decisions_total
+
+    t0 = time.perf_counter()
+    for h in range(1, heights + 1):
+        run_height(h)
+    d.block_until_ready()
+    dt = time.perf_counter() - t0
+    assert d.stats.decisions_total == I * (heights + 1), \
+        d.stats.decisions_total
+    rate_bls = 2 * n * heights / dt
+    rep = svc.drain()
+    bls = rep["bls"]
+    assert bls["fallback_classes"] == 0, bls
+    assert bls["rejected_share_signature"] == 0, bls
+    assert bls["bls_pop_missing"] == 0, bls
+    assert rep["queue"]["rejected_overflow"] == 0
+    _harvest_audit(d)
+
+    # -- the per-vote Ed25519 baseline: same traffic shape -------------------
+    seeds = [v.to_bytes(4, "little") + bytes(28) for v in range(V)]
+    ed_pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                           for s in seeds])
+    d2 = DeviceDriver(I, V, advance_height=True, defer_collect=True,
+                      audit=True)
+    bat2 = RunConfig(n_validators=V, n_instances=I,
+                     n_slots=4).validate().make_batcher()
+    svc2 = VoteService(
+        d2, bat2, ed_pubkeys, capacity=4 * n, target_votes=n,
+        max_delay_s=1e9,
+        ladder=ShapeLadder.plan(I, V, min_rung=1 << (n - 1).bit_length()),
+        window_predictor=lambda: (np.zeros(I, np.int64),
+                                  np.full(I, cur["h"], np.int64)),
+        flightrec=_FLIGHTREC)
+    _set_probe_source(lambda: svc2.metrics.snapshot(
+        window=True, window_key="heartbeat"))
+
+    def ed_height(h: int) -> None:
+        cur["h"] = h
+        sigs = _sign_height_sigs(seeds, h)
+        for typ in (PV, PC):
+            svc2.submit(pack_wire_votes(
+                inst, val, np.full(n, h), np.zeros(n),
+                np.full(n, typ), np.full(n, 7), sigs[typ][val]))
+            svc2.pump()
+            svc2.pump()
+        svc2.poll_decisions()
+
+    ed_height(0)
+    d2.block_until_ready()
+    assert d2.stats.decisions_total == I, d2.stats.decisions_total
+    t0 = time.perf_counter()
+    for h in range(1, heights + 1):
+        ed_height(h)
+    d2.block_until_ready()
+    rate_ed = 2 * n * heights / (time.perf_counter() - t0)
+    assert d2.stats.decisions_total == I * (heights + 1)
+    assert d2.rejected_signature_device == 0
+    _harvest_audit(d2)
+
+    snap = rep["metrics"]
+    _EXTRA_RECORD.update({
+        "bls_class_size": V,
+        "pipeline_serve_bls_ed25519_votes_per_sec": round(rate_ed),
+        "bls_agg_speedup": (round(rate_bls / rate_ed, 2)
+                            if rate_ed > 0 else -1),
+        "serve_bls_agg_classes": bls["agg_classes"],
+        "serve_bls_fallback_votes": bls["fallback_votes"],
+        "bls_pairing_wall_p50_s": snap.get("bls_pairing_wall_s_p50"),
+    })
+    assert _ANALYSIS.get(RETRACE_UNEXPECTED, 0) == 0, _ANALYSIS
+    return rate_bls
+
+
 def bench_pipeline(n_instances: int = 1024, n_validators: int = 128,
                    heights: int = 6) -> float:
     """The flagship headline: end-to-end through the numpy bridge."""
@@ -1550,6 +1733,16 @@ def bench_pipeline_serve_dedup(n_instances: int = 1024,
     verified-vote dedup cache + split-rung dispatch (ISSUE 5), with a
     dedup-off replay of the same traffic for the speedup ratio."""
     return _pipeline_serve_dedup(n_instances, n_validators, heights)
+
+
+def bench_pipeline_serve_bls(n_instances: int = 64,
+                             n_validators: int = 128,
+                             heights: int = 6) -> float:
+    """End-to-end through the serve plane's BLS aggregate-precommit
+    lane (ISSUE 10): one device MSM + one host pairing per vote class
+    instead of one Ed25519 verify per vote, with a per-vote Ed25519
+    run of the same traffic in-process for `bls_agg_speedup`."""
+    return _pipeline_serve_bls(n_instances, n_validators, heights)
 
 
 def _smoke_main(stage: str, metric: str, value_key: str, unit: str,
@@ -1622,6 +1815,25 @@ def main_serve_dedup_smoke() -> None:
                 "dedup smoke: duplicated-traffic streaming plane")
 
 
+def main_serve_bls_smoke() -> None:
+    """The ci.sh BLS gate's entry (ISSUE 10): ONLY the aggregate-lane
+    serve probe — BLS class fold -> device MSM -> one pairing per
+    class -> unsigned dispatch, plus the per-vote Ed25519 comparison —
+    tiny-I/full-V shape, CPU, same crash-safe contract.  The record
+    carries `bls_agg_speedup` + the lane counters via _EXTRA_RECORD.
+    Default shape I=1, V=64 (the acceptance's >= 64-validator class —
+    the aggregation win is per-CLASS, so the smoke spends its budget
+    on validators, not instances)."""
+    os.environ.setdefault("AGNES_SERVE_BLS_SMOKE_I", "1")
+    os.environ.setdefault("AGNES_SERVE_BLS_SMOKE_V", "64")
+    _smoke_main("bench_pipeline_serve_bls",
+                "pipeline_serve_bls_votes_per_sec",
+                "pipeline_serve_bls_votes_per_sec", "votes/sec/chip",
+                "AGNES_SERVE_BLS_SMOKE", bench_pipeline_serve_bls,
+                "bls smoke: aggregate-precommit lane vs per-vote "
+                "Ed25519")
+
+
 def main_serve_mesh_smoke() -> None:
     """The ci.sh mesh-serve gate's entry (ISSUE 3): ONLY the mesh
     serve probe — ThreadedVoteService event loop + dense sharded
@@ -1673,6 +1885,8 @@ def main() -> None:
     pipeline_serve_mesh = guarded(bench_pipeline_serve_mesh)
     # duplicated-traffic serve: dedup cache + split-rung dispatch
     pipeline_serve_dedup = guarded(bench_pipeline_serve_dedup)
+    # BLS aggregate lane: one pairing per vote class
+    pipeline_serve_bls = guarded(bench_pipeline_serve_bls)
     tally = guarded(bench_tally)
     verifies = guarded(bench_verify)
     msm = guarded(bench_verify_msm)
@@ -1701,6 +1915,7 @@ def main() -> None:
         "pipeline_serve_votes_per_sec": pipeline_serve,
         "pipeline_serve_mesh_votes_per_sec": pipeline_serve_mesh,
         "pipeline_serve_dedup_votes_per_sec": pipeline_serve_dedup,
+        "pipeline_serve_bls_votes_per_sec": pipeline_serve_bls,
         **_EXTRA_RECORD,
         "fused_tally_step_votes_per_sec": tally,
         "ed25519_verifies_per_sec": verifies,
@@ -1719,6 +1934,7 @@ if __name__ == "__main__":
     try:
         (main_serve_mesh_smoke() if _SERVE_MESH_SMOKE
          else main_serve_dedup_smoke() if _SERVE_DEDUP_SMOKE
+         else main_serve_bls_smoke() if _SERVE_BLS_SMOKE
          else main_serve_smoke() if _SERVE_SMOKE else main())
     except BaseException as e:  # noqa: BLE001 — the contract: a
         # parseable record is the LAST stdout line no matter how this

@@ -1,0 +1,574 @@
+"""BLS aggregate lane (ISSUE 10): reference-crypto self-tests, wire /
+registry / class-table admission units, the generalized Pippenger
+digit/bucket math against python ints, and the jax-vs-ref
+differentials — cheap cases run eager or pure-python (pairings cost
+~2s each on this box, so they are rationed); compile-heavy cases
+(anything dispatching `bls_aggregate` or a fused verify) are marked
+slow per the 870s tier-1 budget.
+
+The flagship slow test proves the acceptance differential: decisions
+served through the AGGREGATE lane == the per-vote Ed25519 serve plane
+== the offline fused path, state/tally leaf-for-leaf — including a
+forged-share class that must fall back to per-share verification
+without poisoning the honest shares."""
+
+import numpy as np
+import pytest
+
+from agnes_tpu.crypto import bls_ref as ref
+from agnes_tpu.crypto.encoding import vote_signing_bytes
+from agnes_tpu.serve.bls_lane import (
+    BLS_REC_SIZE,
+    BlsClassTable,
+    BlsKeyRegistry,
+    pack_bls_wire,
+    unpack_bls_wire,
+)
+
+PV, PC = 0, 1
+
+
+def _incremental_keys(V):
+    """Throwaway fixture keys sk_v = v + 1: pubkeys by cumulative G1
+    adds (no per-validator scalar mult), shares by cumulative adds of
+    the message point."""
+    pts, acc = [], None
+    for _ in range(V):
+        acc = ref.point_add(acc, ref.G1)
+        pts.append(acc)
+    pk = np.stack([np.frombuffer(ref.g1_compress(p), np.uint8)
+                   for p in pts])
+    return pts, pk
+
+
+def _class_shares(V, msg_pt):
+    out, acc = [], None
+    for _ in range(V):
+        acc = ref.point_add(acc, msg_pt)
+        out.append(np.frombuffer(ref.g2_to_bytes(acc), np.uint8))
+    return np.stack(out)
+
+
+# ---------------------------------------------------------------------------
+# reference crypto (pure python; each pairing product ~2s)
+# ---------------------------------------------------------------------------
+
+
+def test_ref_sign_verify_and_reject():
+    sk, pk = ref.keygen(b"\x07" * 16)
+    sig = ref.sign(sk, b"agnes vote")
+    assert ref.verify(pk, b"agnes vote", sig)
+    assert not ref.verify(pk, b"other vote", sig)
+
+
+def test_ref_weighted_aggregate_and_forged_share():
+    V = 3
+    pts, _pk = _incremental_keys(V)
+    msg_pt = ref.hash_to_g2(b"class message")
+    sigs = [ref.point_mul(v + 1, msg_pt) for v in range(V)]
+    w = [2, 1, 5]
+    agg = None
+    for s, wi in zip(sigs, w):
+        agg = ref.point_add(agg, ref.point_mul(wi, s))
+    assert ref.aggregate_verify_weighted(pts, w, msg_pt, agg)
+    # one forged share in the aggregate must fail the ONE pairing
+    forged = ref.point_add(agg, msg_pt)
+    assert not ref.aggregate_verify_weighted(pts, w, msg_pt, forged)
+
+
+def test_ref_pop_domain_separation():
+    sk, pk = ref.keygen(b"\x21" * 16)
+    pop = ref.pop_prove(sk, pk)
+    assert ref.pop_verify(pk, pop)
+    # a vote signature over the pubkey bytes must NOT pass as a PoP:
+    # the PoP hash is domain-separated (rogue-key threat model)
+    assert not ref.pop_verify(pk, ref.sign(sk, pk))
+
+
+def test_g1_codec_roundtrip_and_rejects():
+    pt = ref.point_mul(7, ref.G1)
+    assert ref.g1_decompress(ref.g1_compress(pt)) == pt
+    assert ref.g1_decompress(ref.g1_compress(None)) is None
+    with pytest.raises(ValueError):
+        ref.g1_decompress(b"\x00" * 48)          # no compression flag
+    with pytest.raises(ValueError):
+        ref.g1_decompress(b"\xff" * 48)          # x out of range
+    with pytest.raises(ValueError):
+        ref.g1_decompress(b"\x00" * 47)          # wrong length
+
+
+def test_g2_codec_roundtrip_and_rejects():
+    pt = ref.point_mul(5, ref.G2)
+    assert ref.g2_from_bytes(ref.g2_to_bytes(pt)) == pt
+    assert ref.g2_from_bytes(bytes(192)) is None      # identity
+    with pytest.raises(ValueError):
+        ref.g2_from_bytes(bytes(191))
+    bad = bytearray(ref.g2_to_bytes(pt))
+    bad[-1] ^= 1
+    with pytest.raises(ValueError):                   # off the twist
+        ref.g2_from_bytes(bytes(bad))
+
+
+# ---------------------------------------------------------------------------
+# wire codec + key registry + class table (numpy/stdlib; no pairings)
+# ---------------------------------------------------------------------------
+
+
+def test_bls_wire_roundtrip_and_truncation():
+    n = 3
+    shares = np.arange(n * 192, dtype=np.uint8).reshape(n, 192)
+    wire = pack_bls_wire([0, 1, 0], [2, 0, 1], [5, 5, 6], [0, 1, 0],
+                         [PV, PC, PV], [7, -1, 9], shares)
+    assert len(wire) == n * BLS_REC_SIZE
+    inst, val, h, r, typ, value, sh = unpack_bls_wire(wire)
+    assert inst.tolist() == [0, 1, 0]
+    assert val.tolist() == [2, 0, 1]
+    assert h.tolist() == [5, 5, 6]
+    assert r.tolist() == [0, 1, 0]
+    assert typ.tolist() == [PV, PC, PV]
+    assert value.tolist() == [7, -1, 9]       # nil survives
+    np.testing.assert_array_equal(sh, shares)
+    # a trailing partial record is dropped by the codec (counted as
+    # malformed by the fold)
+    assert len(unpack_bls_wire(wire + b"\x01\x02")[0]) == n
+
+
+def _registry(V=3, powers=None):
+    _pts, pk = _incremental_keys(V)
+    return BlsKeyRegistry(pk, powers=powers)
+
+
+def test_key_registry_pop_gating_and_epochs():
+    reg = _registry(V=3)
+    assert not reg.pop_ok.any()
+    # a wrong proof flips nothing
+    assert not reg.register_pop(0, bytes(192))
+    assert not reg.register_pop(99, bytes(192))       # out of range
+    assert not reg.pop_ok.any()
+    pop = ref.pop_prove(1, bytes(reg.pk_bytes[0]))    # sk_0 = 1
+    assert reg.register_pop(0, pop)
+    assert reg.pop_ok[0] and not reg.pop_ok[1:].any()
+    reg.mark_trusted([2])
+    assert reg.pop_ok[2]
+    # epoch advance invalidates memoized pairing verdicts by key
+    e0 = reg.epoch
+    reg.set_powers([3, 1, 1])
+    assert reg.epoch == e0 + 1
+    # the weight WIDTH is fixed at construction (the MSM window count
+    # is a warmed compile-key component)
+    with pytest.raises(ValueError):
+        reg.set_powers([1 << 10, 1, 1])
+    with pytest.raises(ValueError):
+        _registry(V=2, powers=[1 << 30, 1])   # W_BITS screen
+
+
+def _wire_one(inst, val, typ, share, h=0, value=7):
+    return pack_bls_wire([inst], [val], [h], [0], [typ], [value],
+                         share[None])
+
+
+def test_class_table_fold_taxonomy_and_poll():
+    reg = _registry(V=3)
+    reg.mark_trusted([0, 1])                  # validator 2 has no PoP
+    t = BlsClassTable(reg, n_instances=2, max_classes=1,
+                      clock=lambda: 0.0)
+    share = np.zeros(192, np.uint8)           # opaque (decode=False)
+    r = t.fold(_wire_one(0, 0, PV, share), decode=False)
+    assert r["folded"] == 1
+    r = t.fold(_wire_one(0, 0, PV, share), decode=False)
+    assert r["duplicate"] == 1                # one share per signer
+    r = t.fold(_wire_one(0, 2, PV, share), decode=False)
+    assert r["pop_missing"] == 1              # rogue-key defense
+    r = t.fold(_wire_one(0, 9, PV, share), decode=False)
+    assert r["unknown_validator"] == 1
+    r = t.fold(_wire_one(9, 0, PV, share), decode=False)
+    assert r["malformed"] == 1                # instance out of range
+    r = t.fold(_wire_one(0, 0, PC, share), decode=False)
+    assert r["overflow"] == 1                 # max_classes=1
+    # decode=True screens a non-point share as malformed
+    r = t.fold(_wire_one(0, 1, PV, share), decode=True)
+    assert r["malformed"] == 1 and r["folded"] == 0
+    # size-close at target, not below
+    assert t.poll(now=0.0, target_signers=2, max_delay_s=1e9) == []
+    r = t.fold(_wire_one(0, 1, PV, share), decode=False)
+    assert r["folded"] == 1
+    closed = t.poll(now=0.0, target_signers=2, max_delay_s=1e9)
+    assert len(closed) == 1 and closed[0].n_signers == 2
+    assert closed[0].weight == 2
+    assert t.open_classes == 0
+    # deadline-close: a lone share older than the deadline leaves too
+    t.fold(_wire_one(1, 0, PV, share), decode=False)
+    assert t.poll(now=99.0, target_signers=2, max_delay_s=0.5)
+    c = t.snapshot()
+    assert c["bls_shares_folded"] == 3
+    assert c["bls_duplicate_share"] == 1
+    assert c["bls_pop_missing"] == 1
+
+
+def test_lane_forged_share_memo_and_quarantine():
+    """Fallback liveness defenses, host-only (device aggregation
+    stubbed with the oracle sum): a forged class replayed
+    byte-identically is served from the memos (zero pairings), and a
+    validator proven forged `quarantine_after` times has further
+    folds refused at admission."""
+    from agnes_tpu.serve.bls_lane import BlsLane
+
+    V, I = 2, 1
+    _pts, pk = _incremental_keys(V)
+    reg = _registry(V=V)
+    reg.mark_trusted(np.arange(V))
+    lane = BlsLane(reg, I, target_signers=V, max_delay_s=1e9,
+                   quarantine_after=2)
+
+    def oracle_agg(cls, signers):
+        apk = asig = None
+        for v in signers:
+            apk = ref.point_add(apk, ref.g1_decompress(bytes(pk[v])))
+            asig = ref.point_add(asig,
+                                 ref.g2_from_bytes(cls.shares[v]))
+        return apk, asig
+
+    lane._aggregate_device = oracle_agg
+
+    def submit_class(h, forged_share):
+        msg_pt = ref.hash_to_g2(vote_signing_bytes(h, 0, PV, 7))
+        shares = _class_shares(V, msg_pt)
+        shares[1] = np.frombuffer(forged_share, np.uint8)
+        return lane.table.fold(pack_bls_wire(
+            [0] * V, list(range(V)), [h] * V, [0] * V, [PV] * V,
+            [7] * V, shares))
+
+    bad1 = ref.g2_to_bytes(ref.point_mul(77, ref.G2))
+    assert submit_class(0, bad1)["folded"] == V
+    lane.clear_classes(lane.poll())
+    assert lane.counters["rejected_share_signature"] == 1
+    assert reg.forged_strikes[1] == 1 and not reg.quarantined[1]
+    # byte-identical replay: memos, no new strike
+    assert submit_class(0, bad1)["folded"] == V
+    lane.clear_classes(lane.poll())
+    assert lane.counters["pairing_memo_hits"] == 1
+    assert reg.forged_strikes[1] == 1
+    # FRESH garbage at a new height: second strike -> quarantined
+    bad2 = ref.g2_to_bytes(ref.point_mul(78, ref.G2))
+    assert submit_class(1, bad2)["folded"] == V
+    lane.clear_classes(lane.poll())
+    assert reg.forged_strikes[1] == 2 and reg.quarantined[1]
+    # further folds from the proven forger are refused at admission
+    res = submit_class(2, bad2)
+    assert res["quarantined"] == 1 and res["folded"] == V - 1
+    assert lane.table.counters["bls_quarantined"] == 1
+
+
+# ---------------------------------------------------------------------------
+# generalized Pippenger digit/bucket math vs python ints (tiny eager
+# graphs only — the "curve" is integer addition)
+# ---------------------------------------------------------------------------
+
+
+def _to_limbs(x, bits, nl):
+    return [(x >> (bits * i)) & ((1 << bits) - 1) for i in range(nl)]
+
+
+def test_window_digits_generalized_against_ints():
+    import jax.numpy as jnp
+
+    from agnes_tpu.crypto import msm_jax as M
+
+    rng = np.random.default_rng(0)
+    for bits, c, nl in ((13, 8, 20), (12, 4, 2), (12, 6, 3)):
+        n_windows = -(-(bits * nl) // c)
+        xs = [int(rng.integers(0, 1 << min(bits * nl, 63)))
+              for _ in range(4)]
+        limbs = jnp.asarray([_to_limbs(x, bits, nl) for x in xs],
+                            jnp.int32)
+        digits = np.asarray(M.window_digits(limbs, n_windows, c=c,
+                                            bits=bits))
+        for j, x in enumerate(xs):
+            for w in range(n_windows):
+                assert digits[w, j] == (x >> (c * w)) & ((1 << c) - 1)
+    with pytest.raises(AssertionError):
+        M.window_digits(limbs, 2, c=13, bits=12)      # c > bits
+
+
+def test_generic_bucket_machinery_and_msm_over_ints():
+    import jax
+    import jax.numpy as jnp
+
+    from agnes_tpu.crypto import msm_jax as M
+
+    add = lambda a, b: a + b                            # noqa: E731
+    idn = lambda shape: jnp.zeros(shape, jnp.int64)     # noqa: E731
+
+    rng = np.random.default_rng(1)
+    pts = jnp.asarray(rng.integers(1, 1 << 20, size=8), jnp.int64)
+    digits = jnp.asarray(rng.integers(0, 16, size=8), jnp.int32)
+    buckets = M.bucket_sums_seq(pts, digits, point_add=add,
+                                identity=idn, n_buckets=16)
+    buckets = np.asarray(buckets)
+    for d in range(16):
+        want = int(np.asarray(pts)[np.asarray(digits) == d].sum())
+        assert buckets[d] == want, d
+    total = M.bucket_aggregate_merged(jnp.asarray(buckets),
+                                      point_add=add, identity=idn,
+                                      n_buckets=16)
+    assert int(total) == sum(d * int(buckets[d]) for d in range(16))
+    # rolled vs merged aggregate agree
+    assert int(M.bucket_aggregate_generic(
+        jnp.asarray(buckets), point_add=add, identity=idn,
+        n_buckets=16)) == int(total)
+
+    # full generic MSM over the integer "curve": Σ wᵢ xᵢ, zero-weight
+    # lanes dropped by the 0-bucket exclusion
+    bits, c, nl = 12, 4, 2
+    w_int = [0, 1, 255, 77, 0, 13, 200, 5]
+    limbs = jnp.asarray([_to_limbs(w, bits, nl) for w in w_int],
+                        jnp.int32)
+    got = M.msm_generic(pts, limbs, n_windows=2, point_add=add,
+                        identity=idn, window_c=c, bits=bits)
+    want = sum(w * int(p) for w, p in zip(w_int, np.asarray(pts)))
+    assert int(jax.device_get(got)) == want
+
+
+def test_n_windows_for_widths():
+    from agnes_tpu.crypto import bls_jax as BJ
+
+    assert BJ.n_windows_for(1) == 1        # uniform stake: one window
+    assert BJ.n_windows_for(4) == 1
+    assert BJ.n_windows_for(5) == 2
+    assert BJ.n_windows_for(BJ.W_BITS) == BJ.N_WINDOWS
+    assert BJ.n_windows_for(99) == BJ.N_WINDOWS    # clamped
+
+
+# ---------------------------------------------------------------------------
+# jax-vs-ref differentials (eager; the field/curve grid is minutes of
+# eager dispatch — slow-marked per the tier-1 budget)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_field_differential_grid():
+    import jax.numpy as jnp
+
+    from agnes_tpu.crypto import bls_field_jax as BF
+
+    P = ref.P
+    vals = [0, 1, 2, P - 1, P - 2, (1 << 381) - 1, 4 * P - 1,
+            0x1234567890ABCDEF << 200]
+
+    def fv(x):
+        return BF.fv_in(jnp.asarray(BF.to_limbs(x))[None],
+                        max(x, 1))
+
+    for x in vals:
+        for y in vals[:5]:
+            for op, pyop in ((BF.fv_add, lambda a, b: a + b),
+                             (BF.fv_sub, lambda a, b: a - b),
+                             (BF.fv_mul, lambda a, b: a * b)):
+                got = BF.from_limbs(np.asarray(op(fv(x), fv(y)).a)) % P
+                assert got == pyop(x, y) % P, (op.__name__, x, y)
+    # small-constant multiply
+    got = BF.from_limbs(np.asarray(
+        BF.fv_mul_small(fv(P - 1), 12).a)) % P
+    assert got == (P - 1) * 12 % P
+
+
+@pytest.mark.slow
+def test_curve_ops_differential():
+    import jax.numpy as jnp
+
+    from agnes_tpu.crypto import bls_field_jax as BF
+    from agnes_tpu.crypto import bls_jax as BJ
+
+    def g1_dev(pt):
+        if pt is None:
+            return BJ.g1_identity(())
+        return BJ.G1P(x=jnp.asarray(BF.to_limbs(pt[0])),
+                      y=jnp.asarray(BF.to_limbs(pt[1])),
+                      z=jnp.asarray(BF.to_limbs(1)))
+
+    def g2_dev(pt):
+        if pt is None:
+            return BJ.g2_identity(())
+        (x, y) = pt
+        st = lambda c: jnp.stack(                       # noqa: E731
+            [jnp.asarray(BF.to_limbs(c.c[0])),
+             jnp.asarray(BF.to_limbs(c.c[1]))])
+        return BJ.G2P(x=st(x), y=st(y),
+                      z=jnp.stack([jnp.asarray(BF.to_limbs(1)),
+                                   jnp.asarray(BF.to_limbs(0))]))
+
+    # identity, doubling, inverse pairs and generic adds all route
+    # through the ONE complete RCB formula — exactly what the bucket
+    # accumulators feed it
+    g1s = [None, ref.G1, ref.point_mul(7, ref.G1),
+           ref.point_neg(ref.G1)]
+    for a in g1s:
+        for b in g1s:
+            got = BJ.g1_from_device(BJ.g1_add(g1_dev(a), g1_dev(b)))
+            assert got == ref.point_add(a, b), (a, b)
+    g2s = [None, ref.G2, ref.point_mul(5, ref.G2),
+           ref.point_neg(ref.G2)]
+    for a in g2s:
+        for b in g2s:
+            got = BJ.g2_from_device(BJ.g2_add(g2_dev(a), g2_dev(b)))
+            assert got == ref.point_add(a, b)
+
+
+@pytest.mark.slow
+def test_weighted_msm_differential_eager():
+    """Multi-window weighted MSM vs the reference — eager (no rung
+    compile): N=3 lanes, weights spanning two 4-bit windows, both
+    groups in one bls_aggregate call."""
+    import jax.numpy as jnp
+
+    from agnes_tpu.crypto import bls_jax as BJ
+
+    V = 3
+    pts, _pk = _incremental_keys(V)
+    msg_pt = ref.hash_to_g2(b"msm diff")
+    sigs = [ref.point_mul(v + 1, msg_pt) for v in range(V)]
+    w = [255, 0, 17]
+    agg_pk, agg_sig = BJ.bls_aggregate(
+        jnp.asarray(BJ.pack_g1_rows(pts)),
+        jnp.asarray(BJ.pack_g2_rows(sigs)),
+        jnp.asarray(BJ.pack_weights(np.asarray(w))), n_windows=2)
+    want_pk = want_sig = None
+    for p, s, wi in zip(pts, sigs, w):
+        want_pk = ref.point_add(want_pk, ref.point_mul(wi, p))
+        want_sig = ref.point_add(want_sig, ref.point_mul(wi, s))
+    assert BJ.g1_from_device(agg_pk) == want_pk
+    assert BJ.g2_from_device(agg_sig) == want_sig
+    # and the pairing oracle accepts exactly this weighted aggregate
+    assert ref.aggregate_verify_weighted(pts, w, msg_pt, want_sig)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance differential: aggregate lane == per-vote Ed25519 ==
+# offline fused, leaf-for-leaf, incl. the forged-share fallback
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_bls_differential_and_forged_fallback():
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        full_mesh_cols,
+        validator_pubkeys,
+    )
+    from agnes_tpu.serve import ShapeLadder, VoteService
+    from agnes_tpu.serve.bls_lane import BlsLane
+    from agnes_tpu.types import VoteType
+
+    I, V = 2, 4
+    N = I * V
+    heights = 3
+    FORGED_H, FORGED_V = 1, 1     # height 1's prevote class carries a
+    #                               forged share from validator 1
+    pv, pc = int(VoteType.PREVOTE), int(VoteType.PRECOMMIT)
+    seeds = deterministic_seeds(V)
+    ed_pubkeys = validator_pubkeys(seeds)
+    rung = 1 << (2 * N - 1).bit_length()
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+
+    def ed_cols(h, typ):
+        return full_mesh_cols(
+            I, V, seeds, h, typ, 7,
+            forge_validator=(FORGED_V if (h, typ) == (FORGED_H, pv)
+                             else None))
+
+    # -- offline fused reference --------------------------------------------
+    dA = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bA = VoteBatcher(I, V, n_slots=4)
+    for h in range(heights):
+        bA.sync_device(np.zeros(I, np.int64), np.full(I, h, np.int64))
+        for typ in (pv, pc):
+            bA.add_arrays(*ed_cols(h, typ))
+        phases, lanes = bA.build_phases_device(ed_pubkeys,
+                                               phase_offset=1,
+                                               lane_floor=rung)
+        dA.step_seq_signed([dA.empty_phase()] + [p for p, _ in phases],
+                           lanes)
+    dA.block_until_ready()
+    assert dA.stats.decisions_total == I * heights
+
+    # -- per-vote Ed25519 serve ---------------------------------------------
+    box = {"h": 0}
+    dB = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    svcB = VoteService(
+        dB, VoteBatcher(I, V, n_slots=4), ed_pubkeys,
+        capacity=4 * 2 * N, target_votes=2 * N, max_delay_s=0.0,
+        ladder=ShapeLadder.plan(I, V, min_rung=rung), donate=False,
+        window_predictor=lambda: (np.zeros(I, np.int64),
+                                  np.full(I, box["h"], np.int64)))
+    for h in range(heights):
+        box["h"] = h
+        wire = b"".join(pack_wire_votes(*ed_cols(h, typ))
+                        for typ in (pv, pc))
+        svcB.submit(wire)
+        svcB.pump()
+    repB = svcB.drain()
+    assert repB["decisions_total"] == I * heights
+    # one forged prevote lane per instance at the forged height
+    assert repB["rejected_signature_device"] == I
+
+    # -- BLS aggregate-lane serve -------------------------------------------
+    bls_pts, bls_pk = _incremental_keys(V)
+    reg = BlsKeyRegistry(bls_pk)
+    reg.mark_trusted(np.arange(V))
+    lane = BlsLane(reg, I, target_signers=V, max_delay_s=1e9)
+    dC = DeviceDriver(I, V, advance_height=True, defer_collect=True,
+                      audit=True)
+    svcC = VoteService(
+        dC, VoteBatcher(I, V, n_slots=4), None, bls_lane=lane,
+        capacity=4 * 2 * N, target_votes=2 * N, max_delay_s=1e9,
+        ladder=ShapeLadder.plan(I, V).with_bls(V, min_rung=4),
+        window_predictor=lambda: (np.zeros(I, np.int64),
+                                  np.full(I, box["h"], np.int64)))
+    svcC.pipeline.warmup()       # bls rung + unsigned entries; arms
+    for h in range(heights):
+        box["h"] = h
+        for typ in (pv, pc):
+            msg_pt = ref.hash_to_g2(vote_signing_bytes(h, 0, typ, 7))
+            shares = _class_shares(V, msg_pt)
+            if (h, typ) == (FORGED_H, pv):
+                # validator 1's share signs the WRONG message: the
+                # class pairing must fail and fall back per-share
+                wrong = ref.hash_to_g2(b"forged")
+                shares[FORGED_V] = np.frombuffer(
+                    ref.g2_to_bytes(ref.point_mul(FORGED_V + 1,
+                                                  wrong)), np.uint8)
+            svcC.submit_bls(pack_bls_wire(
+                inst, val, np.full(N, h), np.zeros(N),
+                np.full(N, typ), np.full(N, 7),
+                np.tile(shares, (I, 1))))
+            svcC.pump()
+            svcC.pump()
+        svcC.poll_decisions()
+    repC = svcC.drain()
+    assert repC["decisions_total"] == I * heights
+    bls = repC["bls"]
+    # the forged class fell back: I classes (one per instance) at the
+    # forged height, each dropping exactly the forged share and
+    # dispatching the honest remainder
+    assert bls["fallback_classes"] == I, bls
+    assert bls["rejected_share_signature"] == I, bls
+    assert bls["fallback_votes"] == I * (V - 1), bls
+    assert bls["agg_classes"] == 2 * heights * I - I, bls
+    assert repC["metrics"].get("retrace_unexpected", 0) == 0
+
+    # -- leaf-for-leaf equality across all three planes ---------------------
+    for name, dX in (("ed_serve", dB), ("bls_serve", dC)):
+        for a, b in zip(dA.state, dX.state):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b), err_msg=name)
+        for a, b in zip(dA.tally, dX.tally):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b), err_msg=name)
+        np.testing.assert_array_equal(dA.stats.decision_value,
+                                      dX.stats.decision_value)
+        np.testing.assert_array_equal(dA.stats.decision_round,
+                                      dX.stats.decision_round)
