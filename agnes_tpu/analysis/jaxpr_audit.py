@@ -34,8 +34,9 @@ import dataclasses
 from typing import Callable, Dict, List, Optional, Tuple
 
 #: audit shape dims — tiny on purpose: trace cost is graph-size bound,
-#: not shape bound, and the invariants are shape-independent
-AUDIT_DIMS = dict(I=2, V=4, P=2, Ps=1, R=4, S=4, N=8, H=2, NB=1)
+#: not shape bound, and the invariants are shape-independent.
+#: C = pairing class-batch width (bls_pairing_product)
+AUDIT_DIMS = dict(I=2, V=4, P=2, Ps=1, R=4, S=4, N=8, H=2, NB=1, C=1)
 
 COLLECTIVES = frozenset({
     "psum", "psum2", "all_reduce", "all_gather", "all_gather_invariant",
@@ -76,6 +77,10 @@ class EntryReport:
     collectives: Dict[str, int]
     aliased: Optional[int] = None      # donor/alias attrs in lowering
     heavy: bool = False
+    ops: Optional[int] = None          # total traced primitives (the
+    #                                    census pass's raw number —
+    #                                    measured here so `--pass all`
+    #                                    never traces an entry twice)
 
 
 @dataclasses.dataclass
@@ -194,6 +199,16 @@ def _bls_args(d):
             jnp.zeros((n, BJ.W_LIMBS), jnp.int32))
 
 
+def _bls_pair_args(d):
+    import jax.numpy as jnp
+
+    from agnes_tpu.crypto import bls_jax as BJ
+
+    c = d["C"]
+    return (jnp.zeros((c, 2, 3, BJ.NLIMBS), jnp.int32),
+            jnp.zeros((c, 2, 3, 2, BJ.NLIMBS), jnp.int32))
+
+
 def _honest_args(d):
     import jax.numpy as jnp
 
@@ -215,6 +230,7 @@ ARG_BUILDERS: Dict[str, Callable] = {
     "consensus_step_seq_signed_dense_donated": _dense_args,
     "honest_heights": _honest_args,
     "bls_aggregate": _bls_args,
+    "bls_pairing_product": _bls_pair_args,
     "sharded_step": _step_args,
     "sharded_step_seq": _seq_args,
     "sharded_step_seq_signed": _dense_args,
@@ -236,6 +252,7 @@ ENTRY_STATICS: Dict[str, dict] = {
         "advance_height": False, "verify_chunk": None},
     "honest_heights": {"heights": 2},
     "bls_aggregate": {"n_windows": 6},
+    "bls_pairing_product": {},
     "sharded_step": {"advance_height": False},
     "sharded_step_seq": {"advance_height": False, "donate": True},
     "sharded_step_seq_signed": {"advance_height": False,
@@ -244,14 +261,16 @@ ENTRY_STATICS: Dict[str, dict] = {
 }
 
 #: entries whose trace contains the Ed25519 verify graph (~15-20s of
-#: tracing each on the CI box) or the BLS aggregation MSM (~45s: the
+#: tracing each on the CI box), the BLS aggregation MSM (~45s: the
 #: Barrett field instantiates ~100k eqns across its six rolled
-#: point-add bodies); quick mode skips them
+#: point-add bodies), or the BLS pairing tower (~35k eqns of rolled
+#: Miller/final-exp bodies); quick mode skips them
 HEAVY = frozenset({
     "consensus_step_seq_signed_donated",
     "consensus_step_seq_signed_dense_donated",
     "sharded_step_seq_signed",
     "bls_aggregate",
+    "bls_pairing_product",
 })
 
 
@@ -385,7 +404,8 @@ def _audit_one(spec, statics, mesh, metrics, findings, reports,
         findings.extend(dn)
     reports.append(EntryReport(entry=spec.name, collectives=census,
                                aliased=aliased,
-                               heavy=spec.name in HEAVY))
+                               heavy=spec.name in HEAVY,
+                               ops=sum(prims.values())))
     if metrics is not None:
         from agnes_tpu.utils.metrics import ANALYSIS_ENTRIES_AUDITED
 
@@ -401,6 +421,106 @@ def planned_names() -> List[str]:
 
     specs = {s.name for s in registry.entries()}
     return [n for n in ARG_BUILDERS if n in specs and n not in TWINS]
+
+
+# -- jaxpr op-count census (ISSUE 13) ----------------------------------------
+#
+# The graph diet is only a diet while something fails when the graph
+# grows back: every hot entry's TOTAL traced-primitive count at the
+# audit shape is pinned in a checked-in baseline, and the census pass
+# (`agnes-lint --pass census`) fails on >10% drift either way —
+# growth is a compile-budget regression, collapse means the audit is
+# tracing the wrong thing.  `--update-baseline` rewrites the file
+# after a DELIBERATE change (tests/baselines/jaxpr_census.json's
+# history then documents the graph-size trajectory).
+
+CENSUS_TOLERANCE = 0.10
+CENSUS_BASELINE_REL = "tests/baselines/jaxpr_census.json"
+
+
+def census_baseline_path(repo_root: str) -> str:
+    import os
+
+    return os.path.join(repo_root, *CENSUS_BASELINE_REL.split("/"))
+
+
+def load_census_baseline(path: str) -> Dict[str, int]:
+    import json
+
+    with open(path) as f:
+        data = json.load(f)
+    return {k: int(v) for k, v in data["entries"].items()}
+
+
+def write_census_baseline(path: str, measured: Dict[str, int]) -> None:
+    import json
+    import os
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"version": 1, "dims": AUDIT_DIMS,
+                   "tolerance": CENSUS_TOLERANCE,
+                   "entries": {k: int(v) for k, v in
+                               sorted(measured.items())}},
+                  f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def census_planned_names() -> List[str]:
+    """The entry set an `--update-baseline` pins: every audit-planned
+    UNSHARDED entry (sharded entries need a mesh the standalone
+    census workers don't build; in `--pass all` their ops still ride
+    the audit report).  Derived, never hand-maintained — a new hot
+    entry enters the census gate on the next baseline update without
+    anyone editing a list (the shard_coverage_findings lesson)."""
+    from agnes_tpu.device import registry
+
+    return [n for n in planned_names() if not registry.get(n).sharded]
+
+
+def census_findings(measured: Dict[str, int],
+                    baseline: Dict[str, int],
+                    tolerance: float = CENSUS_TOLERANCE
+                    ) -> List[Finding]:
+    """Drift findings: measured vs baseline op counts (AUD007), a
+    baselined entry the run never traced (AUD008)."""
+    out: List[Finding] = []
+    for name, want in sorted(baseline.items()):
+        got = measured.get(name)
+        if got is None:
+            out.append(Finding(
+                "census", "AUD008", name,
+                "baselined entry was not traced (unregistered, "
+                "renamed, or dropped from the census shards) — "
+                "update the baseline or the shard table"))
+            continue
+        drift = (got - want) / want
+        if abs(drift) > tolerance:
+            out.append(Finding(
+                "census", "AUD007", name,
+                f"traced op count {got} drifted {drift:+.1%} from "
+                f"the baseline {want} (tolerance ±{tolerance:.0%}) — "
+                f"a graph-size regression, or run `agnes-lint --pass "
+                f"census --update-baseline` after a deliberate "
+                f"change"))
+    return out
+
+
+def census_coverage_findings(baseline: Dict[str, int]
+                             ) -> List[Finding]:
+    """A census-PLANNED entry missing from the baseline is itself a
+    finding (AUD010): without this, a newly registered hot entry's
+    op count stays silently ungated — the exact regression class the
+    gate exists for (the shard_coverage_findings lesson, applied to
+    the compare path and not just `--update-baseline`)."""
+    missing = sorted(set(census_planned_names()) - set(baseline))
+    if not missing:
+        return []
+    return [Finding(
+        "census", "AUD010", ",".join(missing),
+        "census-planned entries missing from the baseline — run "
+        "`agnes-lint --pass census --update-baseline` and check the "
+        "file in so the new entries' graph sizes are gated")]
 
 
 def shard_coverage_findings(union_names) -> List[Finding]:

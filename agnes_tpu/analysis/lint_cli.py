@@ -14,6 +14,12 @@ compiles (abstract tracing only) — and exits non-zero on any finding:
            .acquire()/.release() anywhere in serve//utils.metrics
   lint     serve hot-path host syncs, unregistered import-time jits,
            unhashable static-argnum candidates
+  census   hot-entry traced-op-count regression gate (ISSUE 13):
+           totals at the audit shape vs tests/baselines/
+           jaxpr_census.json, ±10%; `--update-baseline` rewrites the
+           file after a deliberate graph change.  Runs LAST so a
+           `--pass all` reuses the jaxpr pass's traces (zero extra
+           tracing); standalone it traces only the baselined entries
 
 Invoked as `scripts/agnes_lint.py` (the repo shim) or the installed
 `agnes-lint` console script (pyproject [project.scripts]).  The CLI
@@ -36,7 +42,7 @@ import os
 import sys
 import time
 
-PASSES = ("jaxpr", "retrace", "locks", "lint")
+PASSES = ("jaxpr", "retrace", "locks", "lint", "census")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -91,16 +97,23 @@ def _jaxpr_worker(task):
 #: audit shards balanced by trace weight: the chunk-invariance pair
 #: (sharded signed, traced twice) in one, the two single-device
 #: Ed25519-bearing twins in another, the BLS aggregation MSM (one
-#: ~45s trace) in its own, everything cheap in the last
+#: ~45s trace) and the BLS pairing tower (ISSUE 13, ~25s of rolled
+#: Miller/final-exp bodies) in their own, everything cheap in the
+#: last
 _JAXPR_SHARDS = (
     ["sharded_step_seq_signed"],
     ["consensus_step_seq_signed_donated",
      "consensus_step_seq_signed_dense_donated"],
     ["bls_aggregate"],
+    ["bls_pairing_product"],
     ["consensus_step", "consensus_step_seq",
      "consensus_step_seq_donated", "honest_heights", "sharded_step",
      "sharded_step_seq", "sharded_honest_heights"],
 )
+
+#: entry -> traced op total, filled by run_jaxpr so a `--pass all`
+#: census never re-traces what the audit already traced
+_CENSUS_MEASURED: dict = {}
 
 
 def run_jaxpr(quick: bool, metrics):
@@ -129,10 +142,14 @@ def run_jaxpr(quick: bool, metrics):
         entries.extend(e_dicts)
         skipped.extend(skip)
         metrics.count(ANALYSIS_ENTRIES_AUDITED, audited)
+    for e in entries:
+        if e.get("ops"):
+            _CENSUS_MEASURED[e["entry"]] = e["ops"]
     detail = {
         "entries": [{"entry": e["entry"],
                      "collectives": e["collectives"],
-                     "aliased": e["aliased"]} for e in entries],
+                     "aliased": e["aliased"],
+                     "ops": e.get("ops")} for e in entries],
         "skipped": skipped,
     }
     return findings, detail
@@ -172,11 +189,103 @@ def run_lint(quick: bool, metrics):
     return lint.check_repo(_REPO), {}
 
 
+#: set by main() from --update-baseline
+_UPDATE_BASELINE = False
+
+
+def _census_worker(names):
+    """Trace the named entries and return {name: total ops} — one
+    spawned interpreter per shard, same rationale as _jaxpr_worker.
+    A name that is no longer registered (or lost its audit coverage)
+    is SKIPPED, not raised: its absence from `measured` is what turns
+    into the AUD008 finding — a renamed entry must fail the gate with
+    the update-the-baseline message, not a traceback."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from agnes_tpu.utils.compile_cache import disable_persistent_cache
+
+    disable_persistent_cache()
+    from agnes_tpu.analysis import jaxpr_audit
+    from agnes_tpu.device import registry
+
+    registry.ensure_populated()
+    out = {}
+    for name in names:
+        try:
+            spec = registry.get(name)
+            statics = dict(jaxpr_audit.ENTRY_STATICS[name])
+        except KeyError:
+            continue
+        if spec.sharded:
+            continue
+        traced = jaxpr_audit.trace_entry(spec, statics)
+        out[name] = sum(jaxpr_audit.primitive_census(
+            traced.jaxpr.jaxpr).values())
+    return out
+
+
+def run_census(quick: bool, metrics):
+    from agnes_tpu.analysis import jaxpr_audit
+
+    path = jaxpr_audit.census_baseline_path(_REPO)
+    if _UPDATE_BASELINE:
+        # the keyset is DERIVED (every audit-planned unsharded
+        # entry), so a new hot entry enters the gate on the next
+        # baseline update — never a hand-edited JSON
+        want = sorted(jaxpr_audit.census_planned_names())
+    else:
+        if not os.path.exists(path):
+            return [jaxpr_audit.Finding(
+                "census", "AUD009", path,
+                "census baseline missing — run `agnes-lint --pass "
+                "census --update-baseline` and check the file in")], \
+                {"baseline": path}
+        baseline = jaxpr_audit.load_census_baseline(path)
+        want = sorted(baseline)
+    if quick:
+        # a census that skips the heavy (BLS) entries gates nothing
+        return [], {"skipped": want, "note": "quick mode"}
+    missing = [n for n in want if n not in _CENSUS_MEASURED]
+    if missing:
+        import multiprocessing as mp
+
+        # one shard per heavy entry, the cheap rest together —
+        # standalone `--pass census` parallelizes like the audit
+        from agnes_tpu.analysis.jaxpr_audit import HEAVY
+
+        shards = [[n] for n in missing if n in HEAVY]
+        cheap = [n for n in missing if n not in HEAVY]
+        if cheap:
+            shards.append(cheap)
+        if len(shards) == 1:
+            results = [_census_worker(shards[0])]
+        else:
+            ctx = mp.get_context("spawn")
+            with ctx.Pool(processes=min(
+                    len(shards), max(2, os.cpu_count() or 2))) as p:
+                results = p.map(_census_worker, shards)
+        for r in results:
+            _CENSUS_MEASURED.update(r)
+    measured = {n: _CENSUS_MEASURED[n] for n in want
+                if n in _CENSUS_MEASURED}
+    if _UPDATE_BASELINE:
+        jaxpr_audit.write_census_baseline(path, measured)
+        return [], {"updated": path, "entries": measured}
+    findings = jaxpr_audit.census_findings(measured, baseline)
+    findings += jaxpr_audit.census_coverage_findings(baseline)
+    return findings, {"entries": measured,
+                      "baseline_entries": baseline,
+                      "drift_entries": len(findings)}
+
+
 RUNNERS = {"jaxpr": run_jaxpr, "retrace": run_retrace,
-           "locks": run_locks, "lint": run_lint}
+           "locks": run_locks, "lint": run_lint,
+           "census": run_census}
 
 
 def main(argv=None) -> int:
+    global _UPDATE_BASELINE
     setup_backend_env()
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pass", dest="passes", default="all",
@@ -186,7 +295,12 @@ def main(argv=None) -> int:
                     help="skip the Ed25519-heavy jaxpr traces")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable JSON report on stdout")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="census: rewrite tests/baselines/"
+                         "jaxpr_census.json from this run's measured "
+                         "op counts (after a DELIBERATE graph change)")
     args = ap.parse_args(argv)
+    _UPDATE_BASELINE = bool(args.update_baseline)
     selected = PASSES if args.passes == "all" else (args.passes,)
 
     from agnes_tpu.utils.metrics import (

@@ -167,14 +167,10 @@ def _banded(const: List[int], n_in: int, n_out: int) -> jnp.ndarray:
 _N65 = 2 * NLIMBS - 1
 _MU_MAT = _banded(_const_limbs(MU), _N65, _N65 + len(_const_limbs(MU)))
 _P_MAT = _banded(_const_limbs(P), NLIMBS, _N65)
-
-# column-sum contraction (flat outer index -> column), field_jax.COLSUM
-_M = np.zeros((NLIMBS * NLIMBS, _N65), np.int32)
-for _i in range(NLIMBS):
-    for _j in range(NLIMBS):
-        _M[_i * NLIMBS + _j, _i + _j] = 1
-_COLSUM = jnp.asarray(_M)
-del _M
+# (the old flat-outer-product @ COLSUM contraction for variable x
+# variable products is replaced by `_mul_cols`' shifted multiply-adds
+# — same columns, ~65x less CPU arithmetic; the constant mu/p
+# multiplies above stay banded matmuls, their bands are dense)
 
 
 # --- Barrett reduction ------------------------------------------------------
@@ -272,10 +268,28 @@ def fv_sub(x: FV, y: FV) -> FV:
     return FV(_vpass(x.a - y.a + spread), x.bound + v)
 
 
+def _mul_cols(xa: jnp.ndarray, ya: jnp.ndarray) -> jnp.ndarray:
+    """Limb-convolution columns of x*y ([..., NLIMBS] each ->
+    [..., 2*NLIMBS-1]): NLIMBS statically-shifted multiply-adds
+    instead of the flat-outer-product @ _COLSUM contraction.  Exactly
+    the same integer columns; the dense [NLIMBS^2, 65] matmul carries
+    a ~65x arithmetic overhead (one nonzero per row) that the MXU
+    absorbs on TPU but a CPU pays in full — and the serve smokes ARE
+    the CPU story.  The pairing's per-dispatch wall dropped ~3x with
+    this form; a Pallas kernel (ROADMAP) is the proper TPU answer."""
+    parts = []
+    for i in range(NLIMBS):
+        term = xa[..., i:i + 1] * ya
+        parts.append(jnp.pad(
+            term, [(0, 0)] * (term.ndim - 1) + [(i, NLIMBS - 1 - i)]))
+    out = parts[0]
+    for p in parts[1:]:
+        out = out + p
+    return out
+
+
 def _outer_cols(x: FV, y: FV) -> jnp.ndarray:
-    prod = x.a[..., :, None] * y.a[..., None, :]
-    flat = prod.reshape(prod.shape[:-2] + (NLIMBS * NLIMBS,))
-    return flat @ _COLSUM
+    return _mul_cols(x.a, y.a)
 
 
 def fv_reduce(x: FV) -> FV:
@@ -308,6 +322,113 @@ def fv_mul_small(x: FV, k: int) -> FV:
               RED_BOUND)
 
 
+def fv_reduce_stack(fvs: List[FV]) -> List[FV]:
+    """Re-reduce a LIST of values below 4p with ONE stacked
+    `reduce_cols` instantiation (the graph-diet companion of
+    `fv_mul_pairs`: per-component `fv_reduce` calls were the
+    dominant trace-size term of the tower's combine steps).  All
+    inputs are reduced unconditionally — a caller batching mixed
+    bounds trades a little runtime for one shared body."""
+    for x in fvs:
+        assert x.bound < REDUCE_CAP
+    stacked = jnp.stack([x.a for x in fvs], axis=-2)
+    out = reduce_cols(stacked, _ELEM_LIMB + LMASK)
+    return [FV(out[..., k, :], RED_BOUND) for k in range(len(fvs))]
+
+
+def fv_mul_pairs(pairs) -> List[FV]:
+    """[(x, y), ...] -> [x*y, ...] with ONE stacked outer-product /
+    colsum / Barrett-reduce instantiation for the whole list — the
+    shared-subexpression limb kernel of the graph diet (ISSUE 13):
+    a tower multiply that funnels its K field products through here
+    costs a single `reduce_cols` body in the traced graph instead of
+    K copies of it, and the eager path pays one batched matmul
+    instead of K small ones.  Operands must share their leading batch
+    shape.  Pairs over the Barrett precondition auto-reduce like
+    `fv_mul` — but through ONE further stacked reduce over every
+    grown operand (reducing both sides of a hot pair lands at
+    4p * 4p = 16p^2, always inside the precondition)."""
+    fixed = [list(p) for p in pairs]
+    marks = []
+    for i, (x, y) in enumerate(fixed):
+        if x.bound * y.bound < REDUCE_CAP:
+            continue
+        hit = False
+        for j in (0, 1):
+            if fixed[i][j].bound > RED_BOUND:
+                marks.append((i, j))
+                hit = True
+        assert hit, "un-reducible operand pair"
+    if marks:
+        red = fv_reduce_stack([fixed[i][j] for i, j in marks])
+        for k, (i, j) in enumerate(marks):
+            fixed[i][j] = red[k]
+    for x, y in fixed:
+        assert x.bound * y.bound < REDUCE_CAP
+    xa = jnp.stack([x.a for x, _ in fixed], axis=-2)
+    ya = jnp.stack([y.a for _, y in fixed], axis=-2)
+    out = reduce_cols(_mul_cols(xa, ya),
+                      NLIMBS * _ELEM_LIMB * _ELEM_LIMB)
+    return [FV(out[..., k, :], RED_BOUND) for k in range(len(fixed))]
+
+
+#: static bit table of p - 2, MSB first (the Fermat-inversion chain)
+_INV_EXP_BITS = tuple((P - 2) >> i & 1
+                      for i in range((P - 2).bit_length() - 1, -1, -1))
+
+
+def fv_inv(x: FV) -> FV:
+    """x^(p-2) — the modular inverse (maps 0 to 0), as a ROLLED
+    square-and-multiply over the static bits of p - 2: the traced
+    graph holds ONE squaring and ONE multiply body however long the
+    exponent (the rolled-loop discipline the pairing's final
+    exponentiation is built on).  The multiply runs every iteration
+    against `select(bit, x, 1)` so the body stays branch-free."""
+    x = fv_reduce(x)
+    one = jnp.zeros_like(x.a).at[..., 0].set(1)
+    bits = jnp.asarray(_INV_EXP_BITS[1:], jnp.bool_)   # MSB consumed
+    xsel = x.a
+
+    def body(i, acc):
+        sq = fv_mul_pairs([(FV(acc, RED_BOUND), FV(acc, RED_BOUND))])[0]
+        rhs = jnp.where(bits[i], xsel, one)
+        return fv_mul_pairs([(sq, FV(rhs, RED_BOUND))])[0].a
+
+    import jax
+
+    acc = jax.lax.fori_loop(0, len(_INV_EXP_BITS) - 1, body, x.a)
+    return FV(acc, RED_BOUND)
+
+
+# --- canonical comparison (device verdicts) ---------------------------------
+#
+# Elements are 4p-reduced by design and the kernels never compare —
+# EXCEPT the pairing verdict, which must decide `== 1 in Fp12` and
+# `Z == 0` (identity inputs) on device.  A `reduce_cols` output is a
+# STRICT-limb representative < 4p, so its residue class has exactly
+# the four candidates value + {0,1,2,3}p, each with a unique strict
+# limb pattern: equality against a constant is four vector compares.
+
+def fv_strict(x: FV) -> jnp.ndarray:
+    """Strict limbs of a < 4p representative of x's residue class."""
+    assert x.bound < REDUCE_CAP
+    return reduce_cols(x.a, _ELEM_LIMB + LMASK)
+
+
+def strict_eq_mod_p(strict: jnp.ndarray, value: int) -> jnp.ndarray:
+    """strict (< 4p, strict limbs) == value (mod p) -> [...] bool."""
+    eq = None
+    for k in range(4):
+        c = to_limbs(value % P + k * P)
+        e = jnp.all(strict == c, axis=-1)
+        eq = e if eq is None else (eq | e)
+    return eq
+
+
+def fv_eq_mod_p(x: FV, value: int) -> jnp.ndarray:
+    return strict_eq_mod_p(fv_strict(x), value)
+
+
 # --- Fp2 (u^2 = -1), components as FV pairs ---------------------------------
 
 @dataclasses.dataclass(frozen=True)
@@ -329,15 +450,59 @@ def fv2_sub(x: FV2, y: FV2) -> FV2:
     return FV2(fv_sub(x.c0, y.c0), fv_sub(x.c1, y.c1))
 
 
-def fv2_mul(x: FV2, y: FV2) -> FV2:
-    """Karatsuba over u^2 = -1: v0 = a0b0, v1 = a1b1,
-    v2 = (a0+a1)(b0+b1); c0 = v0 - v1, c1 = v2 - v0 - v1 — THREE
-    Barrett reductions per Fp2 product (the dominant cost of the G2
-    lane; fv_mul's auto-reduce keeps the sum operands legal)."""
-    v0 = fv_mul(x.c0, y.c0)
-    v1 = fv_mul(x.c1, y.c1)
-    v2 = fv_mul(fv_add(x.c0, x.c1), fv_add(y.c0, y.c1))
+def fv2_mul_pairs_expand(x: FV2, y: FV2):
+    """The three Karatsuba operand pairs of x*y over u^2 = -1 —
+    v0 = a0b0, v1 = a1b1, v2 = (a0+a1)(b0+b1) — for a caller that
+    collects several Fp2 products into ONE `fv_mul_pairs` call (the
+    tower's graph diet); `fv2_mul_pairs_combine` folds the three
+    products back into the Fp2 result."""
+    return [(x.c0, y.c0), (x.c1, y.c1),
+            (fv_add(x.c0, x.c1), fv_add(y.c0, y.c1))]
+
+
+def fv2_mul_pairs_combine(v0: FV, v1: FV, v2: FV) -> FV2:
+    """c0 = v0 - v1, c1 = v2 - v0 - v1 (Karatsuba recombination)."""
     return FV2(fv_sub(v0, v1), fv_sub(v2, fv_add(v0, v1)))
+
+
+def fv2_mul(x: FV2, y: FV2) -> FV2:
+    """Karatsuba over u^2 = -1, its three field products funneled
+    through the ONE stacked Barrett body (`fv_mul_pairs`) — the
+    graph-diet rewire (ISSUE 13): an Fp2 product costs a single
+    reduce instantiation where it used to cost three (the dominant
+    trace-size term of the G2 lane's point-add bodies)."""
+    v0, v1, v2 = fv_mul_pairs(fv2_mul_pairs_expand(x, y))
+    return fv2_mul_pairs_combine(v0, v1, v2)
+
+
+def fv2_square(x: FV2) -> FV2:
+    """(a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0a1 u — TWO stacked
+    products (complex-squaring trick) instead of a mul's three."""
+    p0, p1 = fv_mul_pairs([
+        (fv_add(x.c0, x.c1), fv_sub(x.c0, x.c1)), (x.c0, x.c1)])
+    return FV2(p0, fv_add(p1, p1))
+
+
+def fv2_neg(x: FV2) -> FV2:
+    z = FV(jnp.zeros_like(x.c0.a), 1)
+    return FV2(fv_sub(z, x.c0), fv_sub(z, x.c1))
+
+
+def fv2_conj(x: FV2) -> FV2:
+    """a0 - a1 u: the p-power Frobenius on Fp2."""
+    z = FV(jnp.zeros_like(x.c1.a), 1)
+    return FV2(x.c0, fv_sub(z, x.c1))
+
+
+def fv2_inv(x: FV2) -> FV2:
+    """(a0 - a1 u) / (a0^2 + a1^2), the denominator inverted by the
+    Fermat chain (`fv_inv`); maps 0 to 0 — the pairing's degenerate
+    inputs collapse to a rejecting verdict, never a crash."""
+    s0, s1 = fv_mul_pairs([(x.c0, x.c0), (x.c1, x.c1)])
+    n = fv_inv(fv_add(s0, s1))
+    z = FV(jnp.zeros_like(x.c1.a), 1)
+    c0, c1 = fv_mul_pairs([(x.c0, n), (fv_sub(z, x.c1), n)])
+    return FV2(c0, c1)
 
 
 def fv2_mul_small(x: FV2, k: int) -> FV2:

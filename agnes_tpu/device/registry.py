@@ -48,6 +48,7 @@ CANONICAL_MODULES = (
     "agnes_tpu.crypto.ed25519_jax",
     "agnes_tpu.crypto.msm_jax",
     "agnes_tpu.crypto.bls_jax",
+    "agnes_tpu.crypto.bls_pairing_jax",
     "agnes_tpu.crypto.pallas_verify",
     "agnes_tpu.crypto.pallas_ed25519",
 )

@@ -56,15 +56,23 @@ class ShapeLadder:
     aggregation kernel — like the fused verify — compiles a
     logarithmic number of shapes for the service's lifetime and every
     one of them is warmable (ServePipeline.warmup covers them when a
-    lane is attached).  Empty = no BLS lane planned."""
+    lane is attached).  Empty = no BLS lane planned.
+
+    `bls_class_rungs` (ISSUE 13) paces the DEVICE PAIRING the same
+    way: `bls_pairing_product` clears all deadline-closed classes in
+    one dispatch whose compile key is the padded CLASS count — the
+    lane pads onto the smallest fitting rung (chunking above the top
+    one), so the pairing entry too compiles a fixed, warmable shape
+    set.  Empty = host-pairing lane (the PR 10 path)."""
 
     rungs: Tuple[int, ...]
     bls_rungs: Tuple[int, ...] = ()
+    bls_class_rungs: Tuple[int, ...] = ()
 
     def __post_init__(self):
         if not self.rungs:
             raise ValueError("empty shape ladder")
-        for r in self.rungs + self.bls_rungs:
+        for r in self.rungs + self.bls_rungs + self.bls_class_rungs:
             if r & (r - 1) or r <= 0:
                 raise ValueError(f"rungs must be powers of two: {r}")
         if list(self.rungs) != sorted(set(self.rungs)):
@@ -72,6 +80,10 @@ class ShapeLadder:
         if list(self.bls_rungs) != sorted(set(self.bls_rungs)):
             raise ValueError(
                 f"bls_rungs must be ascending: {self.bls_rungs}")
+        if list(self.bls_class_rungs) != sorted(set(
+                self.bls_class_rungs)):
+            raise ValueError(f"bls_class_rungs must be ascending: "
+                             f"{self.bls_class_rungs}")
 
     @property
     def min_rung(self) -> int:
@@ -154,11 +166,15 @@ class ShapeLadder:
             r <<= 1
         return cls(rungs=tuple(rungs))
 
-    def with_bls(self, n_validators: int,
-                 min_rung: int = 16) -> "ShapeLadder":
-        """Extend with BLS aggregation rungs: powers of two from
-        `min_rung` up to the validator count (a class can never hold
-        more signers than validators)."""
+    def with_bls(self, n_validators: int, min_rung: int = 16,
+                 class_rungs: Tuple[int, ...] = (1, 4)
+                 ) -> "ShapeLadder":
+        """Extend with BLS aggregation rungs (powers of two from
+        `min_rung` up to the validator count — a class can never hold
+        more signers than validators) AND the device-pairing CLASS
+        rungs (`class_rungs`, default one small + one burst shape:
+        every pairing compile is a warmup-time cost, so the set stays
+        tiny; closes above the top rung chunk)."""
         min_rung = _ceil_pow2(min_rung)
         top = max(_ceil_pow2(n_validators), min_rung)
         rungs = []
@@ -166,7 +182,9 @@ class ShapeLadder:
         while r <= top:
             rungs.append(r)
             r <<= 1
-        return dataclasses.replace(self, bls_rungs=tuple(rungs))
+        return dataclasses.replace(
+            self, bls_rungs=tuple(rungs),
+            bls_class_rungs=tuple(sorted(set(class_rungs))))
 
     def bls_rung_for(self, n_signers: int) -> int:
         """Smallest BLS rung holding `n_signers` aggregation lanes."""
@@ -177,12 +195,26 @@ class ShapeLadder:
             f"{n_signers} signers exceed the top BLS rung "
             f"{self.bls_rungs[-1] if self.bls_rungs else 0}")
 
+    def bls_class_rung_for(self, n_classes: int) -> int:
+        """Smallest pairing class rung holding `n_classes`; callers
+        CHUNK above the top rung (unlike lane shapes, a class batch
+        splits freely across sequential pairing dispatches)."""
+        for r in self.bls_class_rungs:
+            if n_classes <= r:
+                return r
+        if not self.bls_class_rungs:
+            raise ValueError("no bls_class_rungs planned")
+        return self.bls_class_rungs[-1]
+
     def describe(self) -> str:
         out = ("shape ladder: " + " ".join(str(r) for r in self.rungs)
                + " lanes")
         if self.bls_rungs:
             out += (" | bls: "
                     + " ".join(str(r) for r in self.bls_rungs))
+        if self.bls_class_rungs:
+            out += (" | bls classes: "
+                    + " ".join(str(r) for r in self.bls_class_rungs))
         return out
 
 

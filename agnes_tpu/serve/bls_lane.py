@@ -14,20 +14,35 @@ whole vote class costs ONE aggregate check:
   class closes (size-or-deadline, the micro-batcher discipline)
       ──> O(N) on DEVICE: `bls_aggregate` (crypto/bls_jax) MSMs the
           signer pubkeys (G1, stake-weighted) and shares (G2) onto a
-          padded ladder rung — one compiled shape per rung
-      ──> O(1) on HOST: two pairings through the `bls_ref` oracle
-          (one final exponentiation), memoized per
-          (class key, epoch, signer set)
+          padded ladder rung — one compiled shape per rung, queued
+          async back-to-back for every closing class
+      ──> O(1) on DEVICE (ISSUE 13): ALL closed classes' pairing
+          checks in ONE `bls_pairing_product` dispatch on a padded
+          class rung (`ShapeLadder.bls_class_rungs`) consuming the
+          MSM outputs in place — zero host crypto, only a [C] bool
+          vector crosses back; verdicts memoized per
+          (class key, epoch, signer set), memos pruned on epoch
+          advance (`bls_memo_evictions`)
   pairing clears ──> the class densifies to ONE dense phase row per
       signer set (VoteBatcher.add_class_votes, verified=True) and
       dispatches down the verify-free UNSIGNED step entries — the
       insert-after-verify discipline of the dedup cache: nothing
       reaches an unsigned entry without a cleared pairing behind it
   pairing fails ──> per-share fallback: every share is verified
-      individually against the oracle; good shares still dispatch
-      (host-verified, the `host_fallback_builds` analogue), forged
-      shares are dropped and counted — one forged share can never
-      poison the class, and can never suppress honest shares.
+      individually against the `bls_ref` HOST oracle (the oracle's
+      remaining production role, alongside the differential tests);
+      good shares still dispatch (host-verified, the
+      `host_fallback_builds` analogue), forged shares are dropped
+      and counted — one forged share can never poison the class, and
+      can never suppress honest shares.  The device pairing is
+      REJECT-safe on degenerate/wrong-subgroup aggregates
+      (bls_pairing_jax docstring), so soundness never rests on it:
+      a device False only ever costs this oracle sweep.
+
+Host-pairing mode (`device_pairing=False`, or no pairing class rungs
+planned): the PR 10 path — per-class MSM fetch + oracle pairing —
+kept for the bench's device-vs-host comparison and for hosts whose
+ladder never warmed the pairing entry.
 
 Rogue-key defense (the satellite): `BlsKeyRegistry` only folds shares
 from validators with a verified proof-of-possession
@@ -46,6 +61,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from agnes_tpu.utils.metrics import BLS_DEVICE_PAIRING_DISPATCHES
 
 #: wire record: the 96-byte Ed25519 record's 32-byte header followed
 #: by a 192-byte UNCOMPRESSED G2 share (bls_ref.g2_to_bytes layout) —
@@ -429,6 +446,7 @@ class BlsLane:
                  target_signers: Optional[int] = None,
                  max_delay_s: float = 0.005,
                  quarantine_after: int = 3,
+                 device_pairing: Optional[bool] = None,
                  clock=time.monotonic):
         self.registry = registry
         self.table = BlsClassTable(registry, n_instances,
@@ -439,6 +457,11 @@ class BlsLane:
         #: strikes before a proven forger's folds are refused at
         #: admission (registry docstring; <= 0 disables quarantine)
         self.quarantine_after = int(quarantine_after)
+        #: ISSUE 13: None = auto (device pairing iff the bound ladder
+        #: planned pairing class rungs — a host that never warmed the
+        #: pairing entry must not trip a live compile); True/False
+        #: forces it (the bench's device-vs-host comparison)
+        self.device_pairing = device_pairing
         self._clock = clock
         self.driver = None
         self.metrics = None
@@ -453,11 +476,20 @@ class BlsLane:
         #: ~2s host pairing; without this a single malicious
         #: PoP-verified validator could re-bill the pairing per tick
         self._share_memo: Dict[tuple, bool] = {}
+        #: the epoch the verdict/share memos were built under: an
+        #: epoch advance (set_powers / set_validators) prunes BOTH —
+        #: the keys already carry the epoch (no stale verdict could
+        #: ever be REUSED), but without the prune a long-lived
+        #: service's memos grow one dead generation per epoch,
+        #: unboundedly (the ISSUE 13 fix satellite)
+        self._memo_epoch = registry.epoch
         self.counters = {
             "agg_classes": 0, "agg_votes": 0,
             "fallback_classes": 0, "fallback_votes": 0,
             "rejected_share_signature": 0,
             "pairing_memo_hits": 0,
+            BLS_DEVICE_PAIRING_DISPATCHES: 0,
+            "bls_memo_evictions": 0,
         }
 
     def bind(self, driver, metrics=None, ladder=None) -> None:
@@ -511,11 +543,37 @@ class BlsLane:
             self._msg_memo[mk] = pt
         return pt
 
-    def _aggregate_device(self, cls: AggregateClass, signers):
-        """Dispatch the O(N) MSMs for one class on a padded ladder
-        rung; returns (agg_pk point, agg_sig point) as bls_ref affine
-        points.  The dispatch is retrace-observed like every other
-        device entry."""
+    @property
+    def uses_device_pairing(self) -> bool:
+        """Resolved pairing mode (constructor docstring): forced, or
+        auto = the bound ladder planned pairing class rungs."""
+        if self.device_pairing is not None:
+            return bool(self.device_pairing)
+        return (self.ladder is not None
+                and bool(self.ladder.bls_class_rungs))
+
+    def _prune_epoch_memos(self) -> None:
+        """Epoch advance (set_powers / the service's set_validators
+        path) -> drop every memoized pairing/share verdict of the old
+        generation (constructor docstring; counted
+        `bls_memo_evictions`).  The message-point memo survives: the
+        class message is epoch-independent."""
+        ep = self.registry.epoch
+        if ep == self._memo_epoch:
+            return
+        n = len(self._pair_memo) + len(self._share_memo)
+        self._pair_memo.clear()
+        self._share_memo.clear()
+        self._memo_epoch = ep
+        if n:
+            self.counters["bls_memo_evictions"] += n
+
+    def _msm_dispatch(self, cls: AggregateClass, signers):
+        """Queue one class's O(N) MSMs on a padded ladder rung;
+        returns the aggregated (G1P, G2P) as DEVICE pytrees — no
+        fetch, so consecutive classes' dispatches queue back-to-back
+        through JAX async dispatch.  Retrace-observed like every
+        other device entry."""
         import jax.numpy as jnp
 
         from agnes_tpu.crypto import bls_jax as BJ
@@ -536,54 +594,160 @@ class BlsLane:
         nw = self.registry.n_windows
         if self.driver is not None:
             self.driver._observe("bls_aggregate", args, statics=(nw,))
-        agg_pk, agg_sig = _registry.timed_entry("bls_aggregate")(
+        return _registry.timed_entry("bls_aggregate")(
             *args, n_windows=nw)
-        # the one host<->device sync of the lane: the pairing needs
-        # the aggregated points back as ints (class-close boundary,
-        # O(1) per class — not a per-vote sync)
+
+    def _aggregate_device(self, cls: AggregateClass, signers):
+        """Host-pairing mode's aggregation: MSM dispatch + the ONE
+        host<->device sync of that mode (class-close boundary, O(1)
+        per class — not a per-vote sync); returns bls_ref affine
+        points for the oracle pairing."""
         import jax
 
+        from agnes_tpu.crypto import bls_jax as BJ
+
+        agg_pk, agg_sig = self._msm_dispatch(cls, signers)
         agg_pk = jax.tree.map(np.asarray, agg_pk)  # lint: allow (class-close boundary fetch)
         agg_sig = jax.tree.map(np.asarray, agg_sig)  # lint: allow (class-close boundary fetch)
         return BJ.g1_from_device(agg_pk), BJ.g2_from_device(agg_sig)
+
+    def _host_pairing_sweep(self, pending) -> Dict[tuple, bool]:
+        """The PR 10 path: per class, fetch the aggregates and pay
+        one oracle pairing-product (~seconds of pure python each).
+        The histogram times EXACTLY the pairing-product (not the MSM
+        or a cold hash-to-curve)."""
+        from agnes_tpu.crypto import bls_ref as ref
+
+        out: Dict[tuple, bool] = {}
+        for memo_key, cls, signers, msg_pt in pending:
+            agg_pk, agg_sig = self._aggregate_device(cls, signers)
+            t0 = self._clock()
+            out[memo_key] = ref.pairing_product_is_one(
+                [(ref.point_neg(ref.G1), agg_sig),
+                 (agg_pk, msg_pt)])
+            if self._h_pairing is not None:
+                self._h_pairing.record(self._clock() - t0)
+        return out
+
+    def _device_pairing_sweep(self, pending) -> Dict[tuple, bool]:
+        """ISSUE 13 steady state — ZERO host crypto: every pending
+        class's MSMs queue async back-to-back, their device outputs
+        feed ONE `bls_pairing_product` dispatch per padded class rung
+        (chunked above the top rung), and only the [C] bool verdicts
+        cross back to the host.  The histogram records the pairing
+        dispatch wall divided over its classes (the per-class cost
+        the old host path reported in seconds)."""
+        import jax
+        import jax.numpy as jnp
+
+        from agnes_tpu.crypto import bls_pairing_jax as BP
+        from agnes_tpu.device import registry as _registry
+
+        if self.ladder is None or not self.ladder.bls_class_rungs:
+            # forced device_pairing=True without planned pairing
+            # class rungs: every dispatch would hit an UNWARMED
+            # ad-hoc shape — a live multi-minute XLA compile (and a
+            # retrace trip) mid-serve.  Fail loudly at the first use
+            # instead (auto mode never gets here: it resolves to the
+            # host path when no rungs are planned).
+            raise ValueError(
+                "device pairing needs planned bls_class_rungs "
+                "(ShapeLadder.with_bls) — bind a ladder with pairing "
+                "rungs or construct the lane with "
+                "device_pairing=False")
+        cap = self.ladder.bls_class_rungs[-1]
+        out: Dict[tuple, bool] = {}
+        neg_g1 = jnp.asarray(BP.NEG_G1_LIMBS)
+        for k0 in range(0, len(pending), cap):
+            chunk = pending[k0:k0 + cap]
+            p_rows, q_rows = [], []
+            for _mk, cls, signers, msg_pt in chunk:
+                agg_pk, agg_sig = self._msm_dispatch(cls, signers)
+                p_rows.append(jnp.stack(
+                    [neg_g1,
+                     jnp.stack([agg_pk.x, agg_pk.y, agg_pk.z])]))
+                q_rows.append(jnp.stack(
+                    [jnp.stack([agg_sig.x, agg_sig.y, agg_sig.z]),
+                     jnp.asarray(BP.pack_g2_proj(msg_pt))]))
+            C = len(chunk)
+            rung = self.ladder.bls_class_rung_for(C)
+            pad = rung - C
+            p = jnp.stack(p_rows + [jnp.zeros_like(p_rows[0])] * pad)
+            q = jnp.stack(q_rows + [jnp.zeros_like(q_rows[0])] * pad)
+            if self.driver is not None:
+                self.driver._observe("bls_pairing_product", (p, q))
+            # force the queued MSMs first so the histogram times the
+            # pairing dispatch itself, comparable to the host mode's
+            # pairing-product wall (the bench's speedup ratio)
+            jax.block_until_ready((p, q))  # lint: allow (class-close boundary; timing fence)
+            t0 = self._clock()
+            ok = np.asarray(_registry.timed_entry(
+                "bls_pairing_product")(p, q))  # lint: allow (class-close boundary fetch: the [C] bool verdicts)
+            wall = self._clock() - t0
+            if self._h_pairing is not None:
+                self._h_pairing.record(wall / C, n=C)
+            self.counters[BLS_DEVICE_PAIRING_DISPATCHES] += 1
+            if self.metrics is not None:
+                self.metrics.count(BLS_DEVICE_PAIRING_DISPATCHES)
+            fr = getattr(self.driver, "flightrec", None) \
+                if self.driver is not None else None
+            if fr is not None:
+                fr.event(BLS_DEVICE_PAIRING_DISPATCHES, classes=C,
+                         rung=rung, wall_s=round(wall, 4))
+            for (mk, *_rest), verdict in zip(chunk, ok[:C]):
+                out[mk] = bool(verdict)
+        return out
 
     def clear_classes(self, classes: List[AggregateClass]
                       ) -> Optional[dict]:
         """Aggregate + verify a batch of closed classes; returns the
         verified row columns (all verified=True — the unsigned-entry
-        contract) or None when nothing survived.  A class whose
-        pairing fails falls back to per-share oracle verification:
-        good shares still dispatch, forged shares are dropped and
-        counted (`rejected_share_signature`)."""
+        contract) or None when nothing survived.  In the steady state
+        every un-memoized class rides ONE device pairing dispatch
+        (`_device_pairing_sweep`); a class whose pairing fails falls
+        back to per-share oracle verification: good shares still
+        dispatch, forged shares are dropped and counted
+        (`rejected_share_signature`)."""
         from agnes_tpu.crypto import bls_ref as ref
 
-        out: List[tuple] = []
-        t_first = None
+        self._prune_epoch_memos()
+        entries: List[tuple] = []
+        pending: List[tuple] = []
+        # verdicts for THIS batch, resolved at lookup/sweep time —
+        # never re-read from _pair_memo below: the memo's capacity
+        # clear (4096 entries) may fire mid-update, and a memo-HIT
+        # class re-read after the clear would default to False and
+        # take a spurious host fallback sweep
+        verdicts: Dict[tuple, bool] = {}
         for cls in classes:
             signers = np.nonzero(cls.signers)[0]
             if not len(signers):
                 continue
-            key = cls.key
-            memo_key = (key, self.registry.epoch,
+            memo_key = (cls.key, self.registry.epoch,
                         signers.tobytes())
-            ok = self._pair_memo.get(memo_key)
-            msg_pt = self._class_msg_point(key)
-            if ok is None:
-                agg_pk, agg_sig = self._aggregate_device(cls, signers)
-                # the histogram times EXACTLY the pairing-product —
-                # the O(1)-per-class cost the lane trades N verifies
-                # for (not the O(N) MSM or a cold hash-to-curve)
-                t0 = self._clock()
-                ok = ref.pairing_product_is_one(
-                    [(ref.point_neg(ref.G1), agg_sig),
-                     (agg_pk, msg_pt)])
-                if self._h_pairing is not None:
-                    self._h_pairing.record(self._clock() - t0)
+            msg_pt = self._class_msg_point(cls.key)
+            entries.append((cls, signers, memo_key, msg_pt))
+            hit = self._pair_memo.get(memo_key)
+            if hit is not None:
+                self.counters["pairing_memo_hits"] += 1
+                verdicts[memo_key] = hit
+            else:
+                pending.append((memo_key, cls, signers, msg_pt))
+        if pending:
+            sweep = (self._device_pairing_sweep
+                     if self.uses_device_pairing
+                     else self._host_pairing_sweep)
+            swept = sweep(pending)
+            verdicts.update(swept)
+            for mk, verdict in swept.items():
                 if len(self._pair_memo) >= 4096:
                     self._pair_memo.clear()
-                self._pair_memo[memo_key] = ok
-            else:
-                self.counters["pairing_memo_hits"] += 1
+                self._pair_memo[mk] = verdict
+        out: List[tuple] = []
+        t_first = None
+        for cls, signers, memo_key, msg_pt in entries:
+            key = cls.key
+            ok = verdicts[memo_key]
             if ok:
                 good = signers
                 self.counters["agg_classes"] += 1

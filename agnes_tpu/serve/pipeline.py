@@ -694,6 +694,23 @@ class ServePipeline:
                 out = fn(*args, n_windows=nw)
                 jax.block_until_ready(out[0].x)
                 warmed += 1
+        if (self.bls_lane is not None and self.ladder.bls_class_rungs
+                and self.bls_lane.uses_device_pairing):
+            # the device pairing entry (ISSUE 13): one compiled shape
+            # per CLASS rung.  All-zero inputs are all-identity
+            # padding classes — the exact runtime padding encoding
+            from agnes_tpu.crypto import bls_jax as _bj  # noqa: F811
+            from agnes_tpu.crypto import bls_pairing_jax  # noqa: F401
+            #                      ^ import = entry registration
+
+            fn = registry.timed_entry("bls_pairing_product")
+            for r in self.ladder.bls_class_rungs:
+                args = (jnp.zeros((r, 2, 3, _bj.NLIMBS), jnp.int32),
+                        jnp.zeros((r, 2, 3, 2, _bj.NLIMBS),
+                                  jnp.int32))
+                d._observe("bls_pairing_product", args)
+                jax.block_until_ready(fn(*args))
+                warmed += 1
         if arm and getattr(d, "sentinel", None) is not None:
             d.sentinel.arm()
         return warmed

@@ -349,4 +349,23 @@ def render_postmortem(path: str,
         top = sorted(comp.items(), key=lambda kv: -kv[1])
         out.append("  first-dispatch compile walls: " + ", ".join(
             f"{k[len('compile_ms_'):]}={v:.0f}ms" for k, v in top[:8]))
+    # ISSUE 13: the BLS device-pairing steady state + the census
+    # gate's drift count, called out by name (a wedge inside the
+    # pairing dispatch or a silently-regrown graph should be the
+    # FIRST thing the post-mortem reader sees, not a dig through the
+    # events dict).  The names are spelled literally because this
+    # module is stdlib-only BY CONTRACT (loaded by file path before
+    # any package import) — they mirror utils/metrics.py's
+    # BLS_DEVICE_PAIRING_DISPATCHES / CENSUS_DRIFT_ENTRIES constants
+    # (one name serves as counter, gauge-source key AND event kind)
+    bls_disp = last.get("bls_device_pairing_dispatches")
+    if isinstance(ev, dict):
+        bls_disp = bls_disp or ev.get("bls_device_pairing_dispatches")
+    if bls_disp:
+        out.append(f"  bls device pairing: {bls_disp} dispatch(es)")
+    drift = last.get("census_drift_entries")
+    if isinstance(drift, (int, float)) and drift >= 0:
+        out.append(f"  jaxpr census drift: {int(drift)} entr"
+                   + ("y" if drift == 1 else "ies")
+                   + (" (clean)" if drift == 0 else " — GRAPH GREW"))
     return "\n".join(out)

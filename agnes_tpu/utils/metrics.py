@@ -371,6 +371,16 @@ SERVE_E2E_DECISION_S = "serve_submit_to_decision_s"
 #: `bls_pop_missing` — are named in serve/service.py next to the rest
 #: of the serve counter taxonomy.
 BLS_PAIRING_WALL_S = "bls_pairing_wall_s"
+#: ISSUE 13 (all-device pairing): batched `bls_pairing_product`
+#: dispatches the lane issued (counter — > 0 proves the steady state
+#: was device-paired; the flight recorder carries the same name as an
+#: event kind), and the jaxpr census gate's drift count (gauge on the
+#: serve smokes' registries; -1 = gate not run in this process tree).
+#: utils/flightrec.py's postmortem renderer spells both literally —
+#: it is stdlib-only BY CONTRACT (loaded by file path before any
+#: package import) and must not import this module.
+BLS_DEVICE_PAIRING_DISPATCHES = "bls_device_pairing_dispatches"
+CENSUS_DRIFT_ENTRIES = "census_drift_entries"
 #: per-entry first-dispatch wall gauges, `compile_ms_<entry>` (ISSUE 8
 #: satellite): the registry times the FIRST dispatch of every entry in
 #: the process (trace + compile dominates that call), so the next

@@ -81,7 +81,8 @@ _WRAPPER = "test_zz_heavy_isolated.py"
 _CHEAP = (          # no XLA compiles (stdlib / numpy / ctypes / refs)
     "test_admission_mc.py",
     "test_analysis.py",
-    "test_bench_deadline.py", "test_budget.py", "test_capi_fuzz.py",
+    "test_bench_deadline.py", "test_bls_pairing_host.py",
+    "test_budget.py", "test_capi_fuzz.py",
     "test_cli_shims.py",
     "test_ed25519_ref.py", "test_executor.py", "test_modelcheck.py",
     "test_native_core.py",

@@ -443,8 +443,9 @@ def test_weighted_msm_differential_eager():
 
 
 # ---------------------------------------------------------------------------
-# the acceptance differential: aggregate lane == per-vote Ed25519 ==
-# offline fused, leaf-for-leaf, incl. the forged-share fallback
+# the acceptance differential: DEVICE-pairing aggregate lane ==
+# HOST-pairing aggregate lane == per-vote Ed25519 == offline fused,
+# leaf-for-leaf, incl. the forged-share fallback (ISSUE 10 + 13)
 # ---------------------------------------------------------------------------
 
 
@@ -515,53 +516,72 @@ def test_serve_bls_differential_and_forged_fallback():
     # one forged prevote lane per instance at the forged height
     assert repB["rejected_signature_device"] == I
 
-    # -- BLS aggregate-lane serve -------------------------------------------
+    # -- BLS aggregate-lane serves: DEVICE pairing and HOST pairing ----------
     bls_pts, bls_pk = _incremental_keys(V)
-    reg = BlsKeyRegistry(bls_pk)
-    reg.mark_trusted(np.arange(V))
-    lane = BlsLane(reg, I, target_signers=V, max_delay_s=1e9)
-    dC = DeviceDriver(I, V, advance_height=True, defer_collect=True,
-                      audit=True)
-    svcC = VoteService(
-        dC, VoteBatcher(I, V, n_slots=4), None, bls_lane=lane,
-        capacity=4 * 2 * N, target_votes=2 * N, max_delay_s=1e9,
-        ladder=ShapeLadder.plan(I, V).with_bls(V, min_rung=4),
-        window_predictor=lambda: (np.zeros(I, np.int64),
-                                  np.full(I, box["h"], np.int64)))
-    svcC.pipeline.warmup()       # bls rung + unsigned entries; arms
-    for h in range(heights):
-        box["h"] = h
-        for typ in (pv, pc):
-            msg_pt = ref.hash_to_g2(vote_signing_bytes(h, 0, typ, 7))
-            shares = _class_shares(V, msg_pt)
-            if (h, typ) == (FORGED_H, pv):
-                # validator 1's share signs the WRONG message: the
-                # class pairing must fail and fall back per-share
-                wrong = ref.hash_to_g2(b"forged")
-                shares[FORGED_V] = np.frombuffer(
-                    ref.g2_to_bytes(ref.point_mul(FORGED_V + 1,
-                                                  wrong)), np.uint8)
-            svcC.submit_bls(pack_bls_wire(
-                inst, val, np.full(N, h), np.zeros(N),
-                np.full(N, typ), np.full(N, 7),
-                np.tile(shares, (I, 1))))
-            svcC.pump()
-            svcC.pump()
-        svcC.poll_decisions()
-    repC = svcC.drain()
-    assert repC["decisions_total"] == I * heights
-    bls = repC["bls"]
-    # the forged class fell back: I classes (one per instance) at the
-    # forged height, each dropping exactly the forged share and
-    # dispatching the honest remainder
-    assert bls["fallback_classes"] == I, bls
-    assert bls["rejected_share_signature"] == I, bls
-    assert bls["fallback_votes"] == I * (V - 1), bls
-    assert bls["agg_classes"] == 2 * heights * I - I, bls
-    assert repC["metrics"].get("retrace_unexpected", 0) == 0
 
-    # -- leaf-for-leaf equality across all three planes ---------------------
-    for name, dX in (("ed_serve", dB), ("bls_serve", dC)):
+    def bls_serve(device_pairing):
+        reg = BlsKeyRegistry(bls_pk)
+        reg.mark_trusted(np.arange(V))
+        lane = BlsLane(reg, I, target_signers=V, max_delay_s=1e9,
+                       device_pairing=device_pairing)
+        dX = DeviceDriver(I, V, advance_height=True,
+                          defer_collect=True, audit=True)
+        svcX = VoteService(
+            dX, VoteBatcher(I, V, n_slots=4), None, bls_lane=lane,
+            capacity=4 * 2 * N, target_votes=2 * N, max_delay_s=1e9,
+            ladder=ShapeLadder.plan(I, V).with_bls(
+                V, min_rung=4, class_rungs=(1,)),
+            window_predictor=lambda: (np.zeros(I, np.int64),
+                                      np.full(I, box["h"], np.int64)))
+        svcX.pipeline.warmup()   # bls + pairing rungs + unsigned; arms
+        for h in range(heights):
+            box["h"] = h
+            for typ in (pv, pc):
+                msg_pt = ref.hash_to_g2(
+                    vote_signing_bytes(h, 0, typ, 7))
+                shares = _class_shares(V, msg_pt)
+                if (h, typ) == (FORGED_H, pv):
+                    # validator 1's share signs the WRONG message:
+                    # the class pairing must fail and fall back
+                    # per-share
+                    wrong = ref.hash_to_g2(b"forged")
+                    shares[FORGED_V] = np.frombuffer(
+                        ref.g2_to_bytes(ref.point_mul(FORGED_V + 1,
+                                                      wrong)),
+                        np.uint8)
+                svcX.submit_bls(pack_bls_wire(
+                    inst, val, np.full(N, h), np.zeros(N),
+                    np.full(N, typ), np.full(N, 7),
+                    np.tile(shares, (I, 1))))
+                svcX.pump()
+                svcX.pump()
+            svcX.poll_decisions()
+        repX = svcX.drain()
+        assert repX["decisions_total"] == I * heights
+        bls = repX["bls"]
+        # the forged class fell back: I classes (one per instance)
+        # at the forged height, each dropping exactly the forged
+        # share and dispatching the honest remainder — identically
+        # in BOTH pairing modes (the device pairing is
+        # reject-equivalent on forged classes)
+        assert bls["fallback_classes"] == I, bls
+        assert bls["rejected_share_signature"] == I, bls
+        assert bls["fallback_votes"] == I * (V - 1), bls
+        assert bls["agg_classes"] == 2 * heights * I - I, bls
+        assert repX["metrics"].get("retrace_unexpected", 0) == 0
+        if device_pairing:
+            # the steady state really was device-paired
+            assert bls["bls_device_pairing_dispatches"] > 0, bls
+        else:
+            assert bls["bls_device_pairing_dispatches"] == 0, bls
+        return dX
+
+    dC = bls_serve(device_pairing=True)
+    dD = bls_serve(device_pairing=False)
+
+    # -- leaf-for-leaf equality across all four planes ----------------------
+    for name, dX in (("ed_serve", dB), ("bls_serve_device", dC),
+                     ("bls_serve_host", dD)):
         for a, b in zip(dA.state, dX.state):
             np.testing.assert_array_equal(np.asarray(a),
                                           np.asarray(b), err_msg=name)

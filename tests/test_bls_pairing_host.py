@@ -1,0 +1,196 @@
+"""Host-side units of the ISSUE 13 surface — zero XLA, zero
+pairings (sweeps stubbed): lane memo pruning on epoch advance, the
+pairing class-rung ladder extension, and the jaxpr op-count census
+gate's compare/baseline machinery.  Listed in conftest._CHEAP."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from agnes_tpu.serve.batcher import ShapeLadder
+
+
+# ---------------------------------------------------------------------------
+# ShapeLadder.bls_class_rungs
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_bls_class_rungs():
+    lad = ShapeLadder.plan(2, 4).with_bls(4, min_rung=4)
+    assert lad.bls_class_rungs == (1, 4)          # the default set
+    assert lad.bls_class_rung_for(1) == 1
+    assert lad.bls_class_rung_for(2) == 4
+    assert lad.bls_class_rung_for(4) == 4
+    # above the top rung: callers CHUNK (top rung returned)
+    assert lad.bls_class_rung_for(9) == 4
+    assert "bls classes: 1 4" in lad.describe()
+    with pytest.raises(ValueError):
+        ShapeLadder(rungs=(4,), bls_class_rungs=(3,))   # not pow2
+    with pytest.raises(ValueError):
+        ShapeLadder(rungs=(4,), bls_class_rungs=(4, 2))  # not ascending
+    bare = ShapeLadder.plan(2, 4)
+    assert bare.bls_class_rungs == ()
+    with pytest.raises(ValueError):
+        bare.bls_class_rung_for(1)
+
+
+# ---------------------------------------------------------------------------
+# BlsLane: epoch memo pruning + mode resolution
+# ---------------------------------------------------------------------------
+
+
+def _lane(V=2):
+    from agnes_tpu.crypto import bls_ref as ref
+    from agnes_tpu.serve.bls_lane import BlsKeyRegistry, BlsLane
+
+    pts, acc = [], None
+    for _ in range(V):
+        acc = ref.point_add(acc, ref.G1)
+        pts.append(acc)
+    pk = np.stack([np.frombuffer(ref.g1_compress(p), np.uint8)
+                   for p in pts])
+    reg = BlsKeyRegistry(pk)
+    reg.mark_trusted(np.arange(V))
+    lane = BlsLane(reg, 1, target_signers=V, max_delay_s=1e9)
+    # stub BOTH crypto sweeps: these units test memo lifecycle, not
+    # pairings
+    lane._host_pairing_sweep = lambda pending: {
+        mk: True for mk, *_ in pending}
+    lane._class_msg_point = lambda key: object()
+    return lane
+
+
+def _submit_class(lane, h=0):
+    from agnes_tpu.serve.bls_lane import pack_bls_wire
+
+    V = lane.registry.V
+    shares = np.zeros((V, 192), np.uint8)
+    lane.table.fold(pack_bls_wire(
+        [0] * V, list(range(V)), [h] * V, [0] * V, [1] * V, [7] * V,
+        shares), decode=False)
+
+
+def test_memo_pruned_on_epoch_advance():
+    lane = _lane()
+    assert lane.uses_device_pairing is False      # auto: no ladder
+    _submit_class(lane, h=0)
+    lane.clear_classes(lane.poll())
+    assert len(lane._pair_memo) == 1
+    # replay: memo hit, no new sweep
+    lane._host_pairing_sweep = lambda pending: (_ for _ in ()).throw(
+        AssertionError("sweep on a memoized class"))
+    _submit_class(lane, h=0)
+    lane.clear_classes(lane.poll())
+    assert lane.counters["pairing_memo_hits"] == 1
+    # epoch advance: BOTH memos pruned and counted, the same class
+    # re-pairs under the new epoch
+    lane._share_memo[("sentinel",)] = True
+    lane.registry.set_powers([3, 1])
+    lane._host_pairing_sweep = lambda pending: {
+        mk: True for mk, *_ in pending}
+    _submit_class(lane, h=0)
+    lane.clear_classes(lane.poll())
+    assert lane.counters["bls_memo_evictions"] == 2
+    assert len(lane._share_memo) == 0
+    assert len(lane._pair_memo) == 1              # new-epoch verdict
+    assert lane.counters["pairing_memo_hits"] == 1
+
+
+def test_memo_hit_survives_capacity_clear_mid_batch():
+    """Regression (review finding): the 4096-entry _pair_memo
+    capacity clear can fire while THIS batch's verdicts are being
+    memoized — a memo-HIT class in the same batch must still clear
+    as aggregated (its verdict was resolved at lookup time), never
+    take a spurious per-share fallback because a later re-read found
+    an emptied memo."""
+    lane = _lane()
+    _submit_class(lane, h=0)
+    lane.clear_classes(lane.poll())           # memoize class @ h=0
+    assert lane.counters["agg_classes"] == 1
+    # pack the memo to one under the cap: inserting the NEXT verdict
+    # trips the clear
+    for i in range(4095 - len(lane._pair_memo)):
+        lane._pair_memo[("dummy", i)] = True
+    _submit_class(lane, h=0)                  # memo hit
+    _submit_class(lane, h=1)                  # pending -> insert
+    lane.clear_classes(lane.poll())
+    assert lane.counters["pairing_memo_hits"] == 1
+    assert lane.counters["agg_classes"] == 3  # BOTH cleared as agg
+    assert lane.counters["fallback_classes"] == 0
+
+
+def test_device_pairing_mode_resolution():
+    from agnes_tpu.serve.bls_lane import BlsLane
+
+    lane = _lane()
+    assert lane.uses_device_pairing is False
+    lane.ladder = ShapeLadder.plan(2, 4).with_bls(4)
+    assert lane.uses_device_pairing is True       # auto: rungs planned
+    lane.device_pairing = False                   # forced host
+    assert lane.uses_device_pairing is False
+    lane2 = BlsLane(lane.registry, 1, device_pairing=True)
+    assert lane2.uses_device_pairing is True      # forced device
+    # forced device WITHOUT planned pairing rungs fails LOUDLY at
+    # first use (review finding: the alternative is a live
+    # multi-minute compile + a retrace trip mid-serve)
+    with pytest.raises(ValueError, match="bls_class_rungs"):
+        lane2._device_pairing_sweep([(("k",), None, None, None)])
+
+
+# ---------------------------------------------------------------------------
+# census gate machinery (analysis/jaxpr_audit.py — no jax import)
+# ---------------------------------------------------------------------------
+
+
+def test_census_findings_drift_and_missing():
+    from agnes_tpu.analysis.jaxpr_audit import census_findings
+
+    base = {"a": 1000, "b": 2000, "gone": 50}
+    measured = {"a": 1050, "b": 2500}             # a in, b +25%, gone absent
+    f = census_findings(measured, base)
+    codes = sorted((x.code, x.where) for x in f)
+    assert codes == [("AUD007", "b"), ("AUD008", "gone")], codes
+    assert census_findings({"a": 1099, "b": 1801, "gone": 45},
+                           base) == []            # all inside ±10%
+
+
+def test_census_baseline_roundtrip(tmp_path):
+    from agnes_tpu.analysis import jaxpr_audit as JA
+
+    path = str(tmp_path / "census.json")
+    JA.write_census_baseline(path, {"x": 123, "y": 456})
+    assert JA.load_census_baseline(path) == {"x": 123, "y": 456}
+    data = json.load(open(path))
+    assert data["tolerance"] == JA.CENSUS_TOLERANCE
+    assert data["dims"] == JA.AUDIT_DIMS
+
+
+def test_checked_in_census_baseline_shape():
+    """The repo's baseline file exists, parses, and pins the two BLS
+    entries the diet is about (plus at least one fused-step entry)."""
+    from agnes_tpu.analysis import jaxpr_audit as JA
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = JA.census_baseline_path(repo)
+    assert os.path.exists(path), path
+    base = JA.load_census_baseline(path)
+    assert "bls_aggregate" in base
+    assert "bls_pairing_product" in base
+    assert all(isinstance(v, int) and v > 0 for v in base.values())
+
+
+def test_census_coverage_flags_unbaselined_planned_entry(monkeypatch):
+    """A census-planned entry missing from the baseline is AUD010 —
+    a newly registered hot entry can never sit silently ungated
+    (review finding)."""
+    from agnes_tpu.analysis import jaxpr_audit as JA
+
+    monkeypatch.setattr(JA, "census_planned_names",
+                        lambda: ["old_entry", "brand_new_entry"])
+    f = JA.census_coverage_findings({"old_entry": 10})
+    assert len(f) == 1 and f[0].code == "AUD010"
+    assert "brand_new_entry" in f[0].where
+    assert JA.census_coverage_findings(
+        {"old_entry": 10, "brand_new_entry": 5}) == []
