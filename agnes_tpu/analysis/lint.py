@@ -17,6 +17,17 @@
            literal passed to a known static argname at a call site
            raises ``TypeError: unhashable`` only at runtime, usually
            minutes into a TPU round; flag it at review time.
+  LINT004  raw native C-API call outside the audited wrappers — the
+           ``ag_*`` ctypes surface (core/native/) takes raw pointers
+           and trusts its callers' length/shape screens; every call
+           must go through an AUDITED wrapper module
+           (core/native.py, bridge/native_ingest.py,
+           serve/native_admission.py) where those screens live.  A
+           hot-path ``_lib().ag_...`` sprinkled elsewhere bypasses
+           them — an OOB read two layers below the first test that
+           would notice.  Paired with lockcheck's LOCK005 (no
+           ``ag_*`` call under the admission lock): together they
+           pin the ISSUE-14 GIL-release contract statically.
 
 Pragma: ``# lint: allow`` on the offending line (reason after the
 marker), mirroring lockcheck's.
@@ -45,6 +56,9 @@ HOT_PATHS: Dict[str, Set[str]] = {
     "agnes_tpu/serve/threaded.py": {
         "submit", "_submit_loop", "_dispatch_loop",
     },
+    "agnes_tpu/serve/native_admission.py": {
+        "submit", "drain",
+    },
     "agnes_tpu/harness/device_driver.py": {
         "step_async",
     },
@@ -60,6 +74,15 @@ STATIC_KWARGS = frozenset({
 #: define must still be registered (identity check)
 SANCTIONED_JIT_MODULES = ("agnes_tpu/device/step.py",
                           "agnes_tpu/parallel/sharded.py")
+
+#: the audited ctypes wrapper modules — the ONLY places a raw
+#: ``ag_*`` C-API call may appear (LINT004); each pairs every call
+#: with the length/shape screens the raw ABI trusts its caller for
+AUDITED_CAPI_MODULES = frozenset({
+    "agnes_tpu/core/native.py",
+    "agnes_tpu/bridge/native_ingest.py",
+    "agnes_tpu/serve/native_admission.py",
+})
 
 
 def _has_pragma(lines, lineno: int) -> bool:
@@ -239,6 +262,42 @@ def check_import_time_jits(repo_root: str,
     return findings
 
 
+# -- LINT004: raw C-API calls outside the audited wrappers -------------------
+
+class _CapiVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.lines = source.splitlines()
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr.startswith("ag_") \
+                and not _has_pragma(self.lines, node.lineno):
+            self.findings.append(Finding(
+                "lint", "LINT004", f"{self.relpath}:{node.lineno}",
+                f"raw native C-API call .{f.attr}() outside the "
+                f"audited wrapper modules — the ctypes surface takes "
+                f"raw pointers and trusts its caller's length/shape "
+                f"screens (route through core/native.py, "
+                f"bridge/native_ingest.py or "
+                f"serve/native_admission.py)"))
+        self.generic_visit(node)
+
+
+def check_capi_wrappers(repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in package_modules(repo_root):
+        if rel.replace(os.sep, "/") in AUDITED_CAPI_MODULES:
+            continue
+        with open(os.path.join(repo_root, rel)) as fh:
+            src = fh.read()
+        v = _CapiVisitor(rel, src)
+        v.visit(ast.parse(src, filename=rel))
+        findings.extend(v.findings)
+    return findings
+
+
 # -- LINT003: unhashable static candidates -----------------------------------
 
 class _StaticKwVisitor(ast.NodeVisitor):
@@ -273,7 +332,8 @@ def check_static_kwargs(repo_root: str) -> List[Finding]:
 
 
 def check_repo(repo_root: str) -> List[Finding]:
-    """All three rules over the repo."""
+    """All four rules over the repo."""
     return (check_hot_paths(repo_root)
             + check_import_time_jits(repo_root)
-            + check_static_kwargs(repo_root))
+            + check_static_kwargs(repo_root)
+            + check_capi_wrappers(repo_root))

@@ -18,6 +18,16 @@ break until it deadlocks or stalls in production:
   LOCK004  admission lock held across a device-lock ACQUISITION —
            even in the right order, holding admission while waiting
            on the device lock serializes submit behind device work
+  LOCK005  native C-API call (``ag_*`` — the ingest loop's and the
+           admission front-end's ctypes surface) under the admission
+           lock — ctypes releases the GIL for the foreign call's
+           whole span, so a Python lock held across it blocks every
+           other thread that wants the lock for the full native call;
+           the native handles carry their own mutexes precisely so no
+           Python lock is needed (ISSUE 14: ThreadedVoteService
+           ELIDES the admission lock around a native queue).  Paired
+           with lint's LINT004, which keeps every ``ag_*`` call
+           inside the audited wrapper modules.
 
 Suppressions are explicit and greppable: a ``# lockcheck: allow``
 comment on the ``with`` line (reason after the marker).  The one
@@ -57,6 +67,12 @@ DISPATCH_CALLS = frozenset({
 
 PRAGMA = "lockcheck: allow"
 
+#: the native C ABI's symbol prefixes (core/native/ingest.cpp +
+#: admission.cpp): a call on an attribute with one of these prefixes
+#: IS a GIL-releasing ctypes call — LOCK005 forbids it under the
+#: admission lock
+NATIVE_CAPI_PREFIXES = ("ag_adm_", "ag_ing_")
+
 
 def _lock_name(node) -> Optional[str]:
     """The lock attribute acquired by a with-item expression, if any."""
@@ -95,7 +111,23 @@ class _LockVisitor(ast.NodeVisitor):
                     f"bare .{f.attr}() — an exception between acquire "
                     f"and release leaks the lock; use a `with` block")
         self._check_dispatch(node)
+        self._check_native(node)
         self.generic_visit(node)
+
+    def _check_native(self, node: ast.Call) -> None:
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr.startswith(NATIVE_CAPI_PREFIXES)):
+            return
+        if any(h in ADMISSION_LOCKS for h in self.held) \
+                and not _has_pragma(self.lines, node.lineno):
+            self._find(
+                "LOCK005", node,
+                f".{f.attr}() under the admission lock — the ctypes "
+                f"call releases the GIL for its whole span, so every "
+                f"thread contending this lock blocks for the full "
+                f"native call; the handle has its own mutex, elide "
+                f"the Python lock (serve/threaded.py ISSUE 14)")
 
     def _check_dispatch(self, node: ast.Call) -> None:
         f = node.func

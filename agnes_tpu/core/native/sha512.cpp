@@ -46,7 +46,63 @@ void compress(uint64_t h[8], const uint8_t block[128]) {
   h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
 }
 
+// --- SHA-256 (FIPS 180-4 §6.2) ---------------------------------------------
+
+inline uint32_t rotr32(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+void compress256(uint32_t h[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int t = 0; t < 16; ++t)
+    w[t] = (uint32_t(block[4 * t]) << 24) |
+           (uint32_t(block[4 * t + 1]) << 16) |
+           (uint32_t(block[4 * t + 2]) << 8) | uint32_t(block[4 * t + 3]);
+  for (int t = 16; t < 64; ++t) {
+    uint32_t s0 = rotr32(w[t - 15], 7) ^ rotr32(w[t - 15], 18) ^
+                  (w[t - 15] >> 3);
+    uint32_t s1 = rotr32(w[t - 2], 17) ^ rotr32(w[t - 2], 19) ^
+                  (w[t - 2] >> 10);
+    w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+  uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int t = 0; t < 64; ++t) {
+    uint32_t t1 = hh + (rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25)) +
+                  ((e & f) ^ (~e & g)) + kK256[t] + w[t];
+    uint32_t t2 = (rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22)) +
+                  ((a & b) ^ (a & c) ^ (b & c));
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
 }  // namespace
+
+void sha256(const uint8_t* data, size_t n, uint8_t out[32]) {
+  uint32_t h[8];
+  std::memcpy(h, kH256, sizeof(h));
+  size_t off = 0;
+  for (; off + 64 <= n; off += 64) compress256(h, data + off);
+  // final: remainder + 0x80 pad + zero fill + 64-bit bit length —
+  // at most two trailing blocks (rem <= 63, so rem + 1 + 8 <= 128)
+  uint8_t buf[128];
+  size_t rem = n - off;
+  if (rem) std::memcpy(buf, data + off, rem);
+  buf[rem++] = 0x80;
+  size_t blocks = (rem + 8 <= 64) ? 1 : 2;
+  std::memset(buf + rem, 0, blocks * 64 - 8 - rem);
+  uint64_t bits = uint64_t(n) << 3;
+  for (int i = 0; i < 8; ++i)
+    buf[blocks * 64 - 8 + i] = (bits >> (56 - 8 * i)) & 0xFF;
+  compress256(h, buf);
+  if (blocks == 2) compress256(h, buf + 64);
+  for (int i = 0; i < 8; ++i)
+    for (int b = 0; b < 4; ++b)
+      out[4 * i + b] = (h[i] >> (24 - 8 * b)) & 0xFF;
+}
 
 Sha512::Sha512() { std::memcpy(h, kH0, sizeof(h)); }
 

@@ -23,4 +23,12 @@ struct Sha512 {
 
 void sha512(const uint8_t* data, size_t n, uint8_t out[64]);
 
+// SHA-256 (FIPS 180-4 §6.2), one-shot: the serve plane's dedup-cache
+// digest (serve/cache.VerifiedCache keys on the SHA-256 of the
+// 96-byte wire record).  Same generated-constant source as SHA-512:
+// kK256/kH256 land in sha512_k.inc from their FIPS definitions (frac
+// parts of cube/square roots of the first primes), asserted against
+// the published first/last words at generation time.
+void sha256(const uint8_t* data, size_t n, uint8_t out[32]);
+
 }  // namespace agnes

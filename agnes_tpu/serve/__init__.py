@@ -43,6 +43,14 @@ rung).  Entries are inserted only after a dispatch's device verify
 settles with zero rejected lanes, so forged duplicates can never
 pre-populate the cache.
 
+native_admission.py (ISSUE 14 tentpole) adds the C++ admission
+front-end: the per-record hot path — wire parse, malformed/fairness/
+capacity screens, dedup-cache SHA-256, densify-to-columns — moves
+behind one GIL-releasing ctypes call per submit and per drain
+(core/native/admission.cpp), byte-compatible with AdmissionQueue and
+opt-in via `VoteService(native_admission=True)`; the threaded host
+elides its admission lock around the internally-synchronized handle.
+
 bls_lane.py (ISSUE 10 tentpole) adds the BLS aggregate-precommit
 lane: same-class precommits fold into per-(height, round, value)
 AggregateClass buckets at admission, aggregate on device
@@ -56,6 +64,12 @@ poison or suppress honest votes (README "BLS aggregate lane").
 
 from agnes_tpu.serve.batcher import MicroBatcher, ShapeLadder  # noqa: F401
 from agnes_tpu.serve.cache import VerifiedCache  # noqa: F401
+# the C++ admission front-end's wrapper (ISSUE 14) is jax-free at
+# import like the queue (building the shared library happens on first
+# use), so it rides the eager admission-side imports
+from agnes_tpu.serve.native_admission import (  # noqa: F401
+    NativeAdmissionQueue,
+)
 from agnes_tpu.serve.queue import (  # noqa: F401
     AdmissionQueue,
     AdmitResult,

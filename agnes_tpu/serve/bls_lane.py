@@ -257,6 +257,14 @@ class BlsClassTable:
         self.max_classes = int(max_classes)
         self._clock = clock
         self._mu = threading.Lock()
+        #: opt-in native header screen (ISSUE 14): `fold`'s pass-1
+        #: range/PoP/quarantine screens run in C++
+        #: (serve/native_admission.bls_screen) and the Python loop
+        #: touches only the survivors (which still pay the on-curve
+        #: decode — the oracle stays the authority on point validity).
+        #: VoteService(native_admission=True) flips this on; the
+        #: taxonomy is identical either way (differential-tested).
+        self.native_screen = False
         self.classes: Dict[tuple, AggregateClass] = {}
         self.counters = {
             "bls_shares_submitted": 0, "bls_shares_folded": 0,
@@ -287,27 +295,46 @@ class BlsClassTable:
         # pass 1, LOCK-FREE: range/PoP screens + the on-curve decode
         # (a pure-python Fp2 check per share — holding the mutex
         # across it would block the pipeline thread's poll() for the
-        # whole submit in the threaded host)
+        # whole submit in the threaded host).  With the native screen
+        # on (ISSUE 14), the header screens run in ONE C call and the
+        # Python loop walks only the survivors; the reject counts come
+        # from a bincount over the native verdict codes — same
+        # first-failing-screen-wins taxonomy, differential-tested.
+        if self.native_screen and n:
+            from agnes_tpu.serve.native_admission import bls_screen
+
+            codes = bls_screen(wire_bytes, self.I, reg.V, reg.pop_ok,
+                               reg.quarantined)
+            bc = np.bincount(codes, minlength=5)
+            res["malformed"] += int(bc[1])
+            res["unknown_validator"] += int(bc[2])
+            res["pop_missing"] += int(bc[3])
+            res["quarantined"] += int(bc[4])
+            candidates = np.flatnonzero(codes == 0)
+        else:
+            candidates = None
         staged = []
-        for j in range(n):
+        for j in (range(n) if candidates is None else candidates):
+            j = int(j)
             i, v = int(inst[j]), int(val[j])
-            if not (0 <= i < self.I and 0 <= typ[j] <= 1):
-                res["malformed"] += 1
-                continue
-            if not 0 <= v < reg.V:
-                res["unknown_validator"] += 1
-                continue
-            if not reg.pop_ok[v]:
-                # rogue-key defense: no verified proof of
-                # possession, no aggregation — ever
-                res["pop_missing"] += 1
-                continue
-            if reg.quarantined[v]:
-                # proven-forger liveness defense: this validator's
-                # shares have failed the per-share fallback
-                # repeatedly — stop paying pairings for them
-                res["quarantined"] += 1
-                continue
+            if candidates is None:
+                if not (0 <= i < self.I and 0 <= typ[j] <= 1):
+                    res["malformed"] += 1
+                    continue
+                if not 0 <= v < reg.V:
+                    res["unknown_validator"] += 1
+                    continue
+                if not reg.pop_ok[v]:
+                    # rogue-key defense: no verified proof of
+                    # possession, no aggregation — ever
+                    res["pop_missing"] += 1
+                    continue
+                if reg.quarantined[v]:
+                    # proven-forger liveness defense: this validator's
+                    # shares have failed the per-share fallback
+                    # repeatedly — stop paying pairings for them
+                    res["quarantined"] += 1
+                    continue
             share = shares[j].tobytes()
             if decode:
                 from agnes_tpu.crypto import bls_ref as ref
@@ -410,6 +437,7 @@ class BlsClassTable:
         t.I = self.I
         t.max_classes = self.max_classes
         t._clock = self._clock
+        t.native_screen = self.native_screen
         t._mu = threading.Lock()
         with self._mu:
             t.classes = {

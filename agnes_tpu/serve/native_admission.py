@@ -1,0 +1,347 @@
+"""NativeAdmissionQueue: the C++ admission front-end (ISSUE 14).
+
+The drop-in twin of `serve.queue.AdmissionQueue`, with the per-record
+hot path — wire parse, malformed/fairness/capacity screens, overload
+policy, SHA-256 dedup-cache digests, densify-to-columns — behind ONE
+ctypes call per submit and per drain (core/native/admission.cpp).
+ctypes releases the GIL for every foreign call, so the threaded host's
+submit thread spends its time in native code instead of serializing
+every producer behind the interpreter: `submit` is a memcpy into the
+native inbox plus the (vectorized) Python cache lookup.
+
+What stays in Python, deliberately:
+
+* **The VerifiedCache itself** (serve/cache.py).  The cache's insert
+  side is driven by settle (device-verify outcomes) and its poisoning
+  contract is subtle; the native side computes the digests (the
+  per-record cost) and the wrapper does one vectorized `lookup` per
+  submit, so hit/miss counters match the Python queue per record.
+* **BLS share decode** (bls_ref.g2_from_bytes).  The class-bucket
+  HEADER screens run natively (`bls_screen`, used by
+  `BlsClassTable.fold` when its `native_screen` flag is set); the
+  on-curve check stays with the oracle.
+* **Everything downstream.**  `drain` returns the same `WireColumns`
+  the Python queue yields — VoteBatcher/pipeline/dispatch are shared,
+  which is what makes the native-ON == native-OFF differential
+  (tests/test_native_admission.py) leaf-for-leaf.
+
+Thread safety: the native handle holds its own mutex, so submit and
+drain may race — ThreadedVoteService detects `queue.native` and drops
+the Python admission lock around both (the GIL-release span must never
+nest under that lock; analysis/lockcheck.py LOCK005 polices the
+inverse, and LINT004 keeps every `ag_*` C-API call inside this audited
+wrapper).  Behavioral parity with AdmissionQueue is specified by the
+admission model checker's corpus; where the two could disagree,
+serve/queue.py is the specification.
+
+Pure numpy + stdlib + ctypes at import; building the shared library
+happens on first use (core/native_build.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import math
+import time
+from typing import Optional
+
+import numpy as np
+
+from agnes_tpu.bridge.native_ingest import REC_SIZE
+from agnes_tpu.core.native_build import lib as _build_lib
+from agnes_tpu.serve.queue import (
+    AdmitResult,
+    DROP_OLDEST,
+    REJECT_NEWEST,
+    WireColumns,
+)
+
+_configured = False
+
+
+def _lib() -> ctypes.CDLL:
+    global _configured
+    L = _build_lib()
+    if not _configured:
+        c = ctypes
+        L.ag_adm_new.restype = c.c_void_p
+        L.ag_adm_new.argtypes = [c.c_int64, c.c_int64, c.c_int64,
+                                 c.c_int32, c.c_int32]
+        L.ag_adm_free.argtypes = [c.c_void_p]
+        L.ag_adm_submit.restype = c.c_int64
+        L.ag_adm_submit.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+                                    c.c_void_p, c.c_void_p]
+        L.ag_adm_set_chunk_ts.argtypes = [c.c_void_p, c.c_int64,
+                                          c.c_double]
+        L.ag_adm_mark_verified.argtypes = [c.c_void_p, c.c_int64,
+                                           c.c_char_p, c.c_int64]
+        L.ag_adm_depth.restype = c.c_int64
+        L.ag_adm_depth.argtypes = [c.c_void_p]
+        L.ag_adm_instance_depth.restype = c.c_int64
+        L.ag_adm_instance_depth.argtypes = [c.c_void_p, c.c_int64]
+        L.ag_adm_oldest_ts.restype = c.c_double
+        L.ag_adm_oldest_ts.argtypes = [c.c_void_p]
+        L.ag_adm_counters.argtypes = [c.c_void_p, c.c_void_p]
+        L.ag_adm_add_counters.argtypes = [c.c_void_p, c.c_void_p]
+        L.ag_adm_drain.restype = c.c_int64
+        L.ag_adm_drain.argtypes = [c.c_void_p, c.c_int64] + \
+            [c.c_void_p] * 10
+        L.ag_adm_export.restype = c.c_int64
+        L.ag_adm_export.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                    c.c_int64]
+        L.ag_adm_bls_screen.restype = c.c_int64
+        L.ag_adm_bls_screen.argtypes = [c.c_char_p, c.c_int64,
+                                        c.c_int64, c.c_int64,
+                                        c.c_char_p, c.c_char_p,
+                                        c.c_void_p]
+        _configured = True
+    return L
+
+
+def bls_screen(wire_bytes, n_instances: int, n_validators: int,
+               pop_ok: np.ndarray, quarantined: np.ndarray
+               ) -> np.ndarray:
+    """Native BLS class-bucket header screen: per whole record the
+    first failing screen's code (0 ok, 1 malformed, 2 unknown
+    validator, 3 PoP missing, 4 quarantined) in BlsClassTable.fold's
+    screen order.  The trailing-partial-record count stays with the
+    caller (len % BLS_REC_SIZE, as for the Python fold)."""
+    from agnes_tpu.serve.bls_lane import BLS_REC_SIZE
+
+    raw = bytes(wire_bytes)
+    n = len(raw) // BLS_REC_SIZE
+    codes = np.empty(max(n, 1), np.uint8)
+    pop = np.ascontiguousarray(pop_ok, np.uint8)
+    quar = np.ascontiguousarray(quarantined, np.uint8)
+    if pop.shape != (int(n_validators),) or quar.shape != pop.shape:
+        raise ValueError(
+            f"pop_ok/quarantined must be [{n_validators}]: "
+            f"{pop.shape}/{quar.shape}")
+    got = _lib().ag_adm_bls_screen(
+        raw, len(raw), int(n_instances), int(n_validators),
+        pop.tobytes(), quar.tobytes(), codes.ctypes.data)
+    return codes[:got]
+
+
+class NativeAdmissionQueue:
+    """C++-backed FIFO of admitted wire records — AdmissionQueue's
+    interface (submit / submit_bls / drain / counters / depth /
+    oldest_ts / instance_depth / wait_hist), native hot path (module
+    docstring)."""
+
+    #: the threaded host's lock-elision marker: this queue is
+    #: internally synchronized, so holding the Python admission lock
+    #: across its GIL-releasing calls is exactly the nesting LOCK005
+    #: forbids
+    native = True
+
+    def __init__(self, n_instances: int, capacity: int,
+                 instance_cap: Optional[int] = None,
+                 policy: str = REJECT_NEWEST,
+                 cache=None,
+                 bls_table=None,
+                 clock=time.monotonic):
+        # the same validation + defaulting as AdmissionQueue.__init__
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive: {capacity}")
+        if policy not in (REJECT_NEWEST, DROP_OLDEST):
+            raise ValueError(f"unknown overload policy: {policy}")
+        self.I = int(n_instances)
+        self.capacity = int(capacity)
+        self.instance_cap = (int(instance_cap)
+                             if instance_cap is not None
+                             else max(1, (2 * self.capacity) // self.I))
+        if self.instance_cap <= 0:
+            raise ValueError(
+                f"instance_cap must be positive: {instance_cap}")
+        self.policy = policy
+        self.cache = cache
+        self.bls_table = bls_table
+        self.wait_hist = None        # duck-typed .record(s, n) sink
+        #: drain wall-clock sink (serve_native_drain_wall_s): the
+        #: service wires the shared registry's histogram in
+        self.drain_hist = None
+        self._clock = clock
+        L = _lib()
+        self._h = L.ag_adm_new(
+            self.I, self.capacity, self.instance_cap,
+            0 if policy == REJECT_NEWEST else 1,
+            1 if cache is not None else 0)
+        if not self._h:
+            # the C side fails closed (NULL) on hostile dimensions
+            raise ValueError(
+                f"invalid admission dimensions: I={n_instances} "
+                f"capacity={capacity} instance_cap={instance_cap}")
+        self._free = L.ag_adm_free   # bound now: module globals are
+        #                              gone at interpreter shutdown
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._free(self._h)
+            self._h = None
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, wire_bytes) -> AdmitResult:
+        """Admit packed wire records: parse/screen/fairness/policy/
+        digest in ONE GIL-releasing native call, then (cache attached)
+        one vectorized lookup + one native mark-back.  Counts are
+        byte-compatible with AdmissionQueue.submit."""
+        raw = wire_bytes if isinstance(wire_bytes, bytes) \
+            else bytes(wire_bytes)
+        n_whole = len(raw) // REC_SIZE
+        counts = np.zeros(5, np.int64)
+        dig = (np.empty((n_whole, 32), np.uint8)
+               if self.cache is not None and n_whole else None)
+        seq = _lib().ag_adm_submit(
+            self._h, raw, len(raw), counts.ctypes.data,
+            dig.ctypes.data if dig is not None else None)
+        accepted = int(counts[0])
+        if accepted:
+            # the Python queue reads its clock once per ACCEPTED
+            # submit, after admission — fake-clock differentials count
+            # invocations, so the native path keeps that discipline
+            _lib().ag_adm_set_chunk_ts(self._h, seq, self._clock())
+        pre_verified = 0
+        if self.cache is not None and accepted:
+            # the lookup covers exactly the admitted records, so the
+            # cache's hit + miss counters still sum to `admitted`
+            ver = self.cache.lookup(dig[:accepted])
+            pre_verified = int(ver.sum())
+            if pre_verified:
+                _lib().ag_adm_mark_verified(
+                    self._h, seq,
+                    np.ascontiguousarray(ver, np.uint8).tobytes(),
+                    accepted)
+        return AdmitResult(accepted, int(counts[1]), int(counts[2]),
+                           int(counts[3]), int(counts[4]), pre_verified)
+
+    def submit_bls(self, wire_bytes) -> AdmitResult:
+        """Class-bucketing admission: the fold itself lives with the
+        BlsClassTable (which runs the native header screen when its
+        `native_screen` flag is set); the reject taxonomy maps onto
+        this queue's counters exactly like AdmissionQueue.submit_bls."""
+        if self.bls_table is None:
+            raise ValueError(
+                "submit_bls on a queue without a bls_table (pass "
+                "BlsClassTable/BlsLane at construction)")
+        res = self.bls_table.fold(wire_bytes)
+        fairness = (res["pop_missing"] + res["unknown_validator"]
+                    + res["duplicate"] + res["quarantined"])
+        deltas = np.asarray(
+            [res["folded"] + fairness + res["malformed"]
+             + res["overflow"],
+             res["folded"], res["overflow"], fairness,
+             res["malformed"]], np.int64)
+        _lib().ag_adm_add_counters(self._h, deltas.ctypes.data)
+        return AdmitResult(res["folded"], res["overflow"], fairness,
+                           res["malformed"], 0)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        return int(_lib().ag_adm_depth(self._h))
+
+    @property
+    def oldest_ts(self) -> Optional[float]:
+        v = _lib().ag_adm_oldest_ts(self._h)
+        return None if math.isnan(v) else v
+
+    def instance_depth(self, instance: int) -> int:
+        return int(_lib().ag_adm_instance_depth(self._h, int(instance)))
+
+    @property
+    def counters(self) -> dict:
+        buf = np.empty(7, np.int64)
+        _lib().ag_adm_counters(self._h, buf.ctypes.data)
+        return {"submitted": int(buf[0]), "admitted": int(buf[1]),
+                "rejected_overflow": int(buf[2]),
+                "rejected_fairness": int(buf[3]),
+                "rejected_malformed": int(buf[4]),
+                "evicted": int(buf[5]), "drained": int(buf[6])}
+
+    def native_snapshot(self) -> dict:
+        """The drain report's native-admission section."""
+        out = self.counters
+        out["depth"] = self.depth
+        return out
+
+    # -- state-space surface -------------------------------------------------
+
+    def mc_canonical(self) -> tuple:
+        """AdmissionQueue.mc_canonical's row format, rebuilt from the
+        native FIFO export — the native-vs-Python queue-content
+        differential.  (No mc_clone: state-space BRANCHING stays with
+        the Python queue the model checker explores.)"""
+        from agnes_tpu.bridge.native_ingest import unpack_wire_votes
+
+        n = self.depth
+        raw = np.empty((max(n, 1), REC_SIZE), np.uint8)
+        ver = np.empty(max(n, 1), np.uint8)
+        # cap = the buffers' size: a concurrent submit may have grown
+        # the queue since the depth read above; the C side clamps
+        n = int(_lib().ag_adm_export(self._h, raw.ctypes.data,
+                                     ver.ctypes.data, n))
+        inst, val, hts, rnd, typ, value, _sigs = unpack_wire_votes(
+            raw[:n].tobytes())
+        rows = [(int(inst[j]), int(val[j]), int(hts[j]), int(rnd[j]),
+                 int(typ[j]), int(value[j]), int(ver[j]))
+                for j in range(n)]
+        return (tuple(rows), n)
+
+    # -- drain ---------------------------------------------------------------
+
+    def drain(self, max_records: Optional[int] = None
+              ) -> Optional[WireColumns]:
+        """Pop up to `max_records` oldest records, densified to the
+        WireColumns arrays in ONE GIL-releasing native call (None when
+        empty).  Wait-histogram recording keeps the Python queue's
+        chunk granularity: records of one submit share one admission
+        instant, so the run-length groups of the ts column ARE the
+        chunks."""
+        n = self.depth
+        if n == 0:
+            return None
+        if max_records is not None:
+            n = min(n, int(max_records))
+        inst = np.empty(n, np.int64)
+        val = np.empty(n, np.int64)
+        hts = np.empty(n, np.int64)
+        rnd = np.empty(n, np.int64)
+        typ = np.empty(n, np.int64)
+        value = np.empty(n, np.int64)
+        sigs = np.empty((n, 64), np.uint8)
+        ver = np.empty(n, np.uint8)
+        dig = (np.empty((n, 32), np.uint8)
+               if self.cache is not None else None)
+        ts = np.empty(n, np.float64)
+        t0 = time.perf_counter()
+        _lib().ag_adm_drain(
+            self._h, n, inst.ctypes.data, val.ctypes.data,
+            hts.ctypes.data, rnd.ctypes.data, typ.ctypes.data,
+            value.ctypes.data, sigs.ctypes.data, ver.ctypes.data,
+            dig.ctypes.data if dig is not None else None,
+            ts.ctypes.data)
+        if self.drain_hist is not None:
+            self.drain_hist.record(time.perf_counter() - t0, n)
+        # a record popped between a lock-free submit and its
+        # set_chunk_ts stamp carries NaN — substitute "admitted just
+        # now" so neither the wait histogram nor t_first (and the
+        # batch-close-age histogram downstream of it) ever sees an
+        # epoch-scale outlier.  Never taken single-threaded, so the
+        # fake-clock invocation parity of the differentials holds.
+        if np.isnan(ts).any():
+            ts[np.isnan(ts)] = self._clock()
+        if self.wait_hist is not None:
+            # one clock read, and ONLY with a histogram attached —
+            # AdmissionQueue.drain's exact clock discipline
+            now = self._clock()
+            edges = np.flatnonzero(np.diff(ts)) + 1
+            starts = np.concatenate(([0], edges))
+            ends = np.concatenate((edges, [n]))
+            for s, e in zip(starts, ends):
+                self.wait_hist.record(now - ts[s].item(), int(e - s))
+        return WireColumns(inst, val, hts, rnd, typ, value, sigs,
+                           ver.astype(bool), digest=dig,
+                           t_first=ts.min().item())

@@ -213,7 +213,20 @@ class Inbox:
 class AdmissionQueue:
     """FIFO of admitted wire records, bounded with per-instance
     fairness (module docstring).  `submit` admits, `drain` hands FIFO
-    column batches to the micro-batcher."""
+    column batches to the micro-batcher.
+
+    This class is the SPECIFICATION of the admission plane: the C++
+    front-end (serve/native_admission.NativeAdmissionQueue, ISSUE 14)
+    is a byte-compatible twin — identical reject taxonomy, counters,
+    digest bytes and drained columns — differential-tested against it
+    (tests/test_native_admission.py) and against the admission model
+    checker's corpus."""
+
+    #: NOT internally synchronized: the threaded host guards this
+    #: queue with its admission lock.  The native twin overrides this
+    #: (its handle holds its own mutex), which is what lets the host
+    #: elide the Python lock around the GIL-releasing C calls.
+    native = False
 
     def __init__(self, n_instances: int, capacity: int,
                  instance_cap: Optional[int] = None,

@@ -42,6 +42,11 @@ from agnes_tpu.utils.metrics import (
     SERVE_ADMIT_WAIT_S,
     SERVE_BATCH_CLOSE_AGE_S,
     SERVE_E2E_DECISION_S,
+    SERVE_NATIVE_DRAIN_WALL_S,
+    SERVE_NATIVE_INBOX_DEPTH,
+    SERVE_NATIVE_REJECTS_FAIRNESS,
+    SERVE_NATIVE_REJECTS_MALFORMED,
+    SERVE_NATIVE_REJECTS_OVERFLOW,
 )
 from agnes_tpu.utils.tracing import Tracer
 
@@ -144,6 +149,7 @@ class VoteService:
                  donate: bool = True,
                  dedup_cache=None,
                  bls_lane=None,
+                 native_admission: bool = False,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
                  flightrec=None,
@@ -174,7 +180,20 @@ class VoteService:
         verifies populate the cache.  Off (None) by default: dedup is
         a pure throughput optimization — decisions are bit-identical
         either way (tests/test_serve_pipeline.py) — and an unsigned
-        deployment has nothing to dedup.  Requires `pubkeys`."""
+        deployment has nothing to dedup.  Requires `pubkeys`.
+
+        `native_admission` (ISSUE 14) swaps the admission queue for
+        its C++ twin (serve/native_admission.NativeAdmissionQueue):
+        per-record parse/screen/fairness/digest work runs behind one
+        GIL-releasing ctypes call per submit and per drain, the BLS
+        class table's header screens go native too
+        (`BlsClassTable.native_screen`), and the threaded host elides
+        its Python admission lock around the internally-synchronized
+        handle.  Default OFF, pure opt-in like `dedup_cache`: the
+        native path is byte-compatible with the Python queue
+        (identical reject taxonomy, cache hit/miss counts and
+        dispatch streams — tests/test_native_admission.py), so
+        flipping it changes throughput, never decisions."""
         I, V = driver.I, driver.V
         if dedup_cache is not None and dedup_cache is not False:
             from agnes_tpu.serve.cache import VerifiedCache
@@ -208,12 +227,32 @@ class VoteService:
         # absorb a burst while one tick is in flight, small enough
         # that overload surfaces as rejects, not as unbounded memory
         capacity = capacity if capacity is not None else 4 * I * V
-        self.queue = AdmissionQueue(
+        self.native_admission = bool(native_admission)
+        if self.native_admission:
+            from agnes_tpu.serve.native_admission import (
+                NativeAdmissionQueue,
+            )
+
+            queue_cls = NativeAdmissionQueue
+        else:
+            queue_cls = AdmissionQueue
+        # ONE construction site: the two queues are byte-compatible
+        # twins, so a config kwarg can never apply to one and not the
+        # other
+        self.queue = queue_cls(
             I, capacity, instance_cap=instance_cap,
             policy=overload_policy, cache=self.cache,
             bls_table=(bls_lane.table if bls_lane is not None
                        else None),
             clock=clock)
+        if self.native_admission:
+            # ISSUE 14 observability: wall of the GIL-releasing
+            # drain-and-densify span, into the shared registry
+            self.queue.drain_hist = self.metrics.histogram(
+                SERVE_NATIVE_DRAIN_WALL_S)
+            if bls_lane is not None:
+                # the class table's header screens go native too
+                bls_lane.table.native_screen = True
         # serve latency histograms (ISSUE 8): admission wait recorded
         # by the queue at drain; close age + submit->decision here;
         # dispatch/settle walls inside the pipeline — one registry
@@ -294,7 +333,22 @@ class VoteService:
                 "reject", overflow=res.rejected_overflow,
                 fairness=res.rejected_fairness,
                 malformed=res.rejected_malformed)
-        m.gauge(SERVE_QUEUE_DEPTH, self.queue.depth)
+        depth = self.queue.depth
+        if self.native_admission:
+            # ISSUE 14: the native screens' reject taxonomy and the
+            # native-inbox depth, mirrored beside the shared serve
+            # counters so a native-vs-Python A/B reads off one scrape
+            # (counter writes only when something was rejected — this
+            # is the per-submit hot path)
+            if res.rejected:
+                m.count(SERVE_NATIVE_REJECTS_OVERFLOW,
+                        res.rejected_overflow)
+                m.count(SERVE_NATIVE_REJECTS_FAIRNESS,
+                        res.rejected_fairness)
+                m.count(SERVE_NATIVE_REJECTS_MALFORMED,
+                        res.rejected_malformed)
+            m.gauge(SERVE_NATIVE_INBOX_DEPTH, depth)
+        m.gauge(SERVE_QUEUE_DEPTH, depth)
         return res
 
     def submit_bls(self, wire_bytes) -> AdmitResult:
@@ -549,6 +603,11 @@ class VoteService:
             "preverified_votes": self.pipeline.preverified_votes,
             "serve_cache": (self.cache.snapshot()
                             if self.cache is not None else None),
+            # ISSUE 14: the native front-end's counters + resident
+            # depth (None = Python admission) — the drain report's
+            # mirror of the serve_native_* registry names
+            "native_admission": (self.queue.native_snapshot()
+                                 if self.native_admission else None),
             "bls": (self.bls.snapshot() if self.bls is not None
                     else None),
             "bls_votes": self.pipeline.bls_votes,

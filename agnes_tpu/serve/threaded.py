@@ -25,6 +25,16 @@ WAIT-FREE relative to in-flight XLA dispatch:
 `submit()` itself takes NEITHER lock — it appends to the Inbox (its
 own nanosecond mutex).  So a socket thread can always hand bytes off,
 even while the dispatch thread sits inside a multi-second XLA call.
+
+With the NATIVE admission front-end (ISSUE 14,
+serve/native_admission.py) the admission lock is elided entirely: the
+C++ queue handle holds its own mutex, queue.submit/drain are single
+GIL-releasing ctypes calls, and holding a Python lock across a
+GIL-release span would let a second Python thread block on that lock
+for the whole native call (the nesting lockcheck's LOCK005 forbids on
+the C-API surface).  The submit thread's work becomes a memcpy into
+the native inbox; everything else it touches (Metrics, the cache's
+leaf mutex, the flight recorder's ring) is thread-safe on its own.
 The verified-vote dedup lookup (ISSUE 5, serve/cache.py) runs inside
 `queue.submit` on the SUBMIT thread under the admission lock — never
 under the device lock — and the cache's own leaf mutex is held for
@@ -80,6 +90,23 @@ class ThreadedVoteService:
         self._clock = clock
         self._admission = threading.Lock()
         self._device = threading.Lock()
+        #: native admission (ISSUE 14): the queue's handle holds its
+        #: own mutex, so the admission lock is ELIDED around submit
+        #: and the micro-batch close — the GIL-releasing C call must
+        #: never run under a Python lock another thread waits on
+        #: (lockcheck LOCK005 polices the nesting on the C-API
+        #: surface; everything the lock otherwise guards is either
+        #: inside the native handle or thread-safe on its own)
+        self._native = bool(getattr(service.queue, "native", False))
+        #: monotone per-loop busy seconds (single writer each) +
+        #: the shared sample window sample_busy_gauges() closes —
+        #: the busy-frac gauges used to refresh only when a loop's
+        #: PRIVATE window rolled, so the final partial window was
+        #: dropped at drain and a heartbeat between rolls read stale
+        #: values (the ISSUE 14 satellite fix)
+        self._busy_totals = {"submit": 0.0, "dispatch": 0.0}
+        self._busy_sample = {"t": None, "submit": 0.0, "dispatch": 0.0}
+        self._busy_mu = threading.Lock()
         self._stop = threading.Event()       # stop intake, finish work
         self._started = False
         #: first exception that killed a loop (None = healthy).  A
@@ -141,36 +168,75 @@ class ThreadedVoteService:
 
     # -- the loops -----------------------------------------------------------
 
+    def busy_seconds(self) -> dict:
+        """Lifetime busy seconds per loop (monotone totals — the
+        sampler's source).  A probe divides by its own measured span
+        for a whole-run busy fraction instead of whatever the last
+        gauge window happened to cover."""
+        return dict(self._busy_totals)
+
+    def sample_busy_gauges(self, now: Optional[float] = None) -> None:
+        """Refresh `serve_submit_busy_frac` / `serve_dispatch_busy_frac`
+        from the loops' monotone busy totals over ONE shared sample
+        window (the ISSUE 14 satellite fix).  Callable from any thread
+        — the loops call it on their gauge cadence, poll_decisions and
+        drain call it so the final partial window still lands, and a
+        bench heartbeat source may call it so the native-vs-Python
+        busy comparison reads live between loop wakeups."""
+        m = self.service.metrics
+        with self._busy_mu:
+            now = self._clock() if now is None else now
+            t0 = self._busy_sample["t"]
+            if t0 is None:
+                self._busy_sample["t"] = now
+                return
+            dt = now - t0
+            if dt <= 0:
+                return
+            for name, gauge in (("submit", SERVE_SUBMIT_BUSY_FRAC),
+                                ("dispatch", SERVE_DISPATCH_BUSY_FRAC)):
+                total = self._busy_totals[name]
+                m.gauge(gauge,
+                        (total - self._busy_sample[name]) / dt)
+                self._busy_sample[name] = total
+            self._busy_sample["t"] = now
+
     def _submit_loop(self) -> None:
         m = self.service.metrics
         if self.service.tracer is not None:
             # label this row in chrome-trace (stable-id metadata —
             # the ISSUE 8 tracer satellite)
             self.service.tracer.name_thread(self._submit_t.name)
-        busy = 0.0
+        self.sample_busy_gauges()        # open the shared window
         win_t0 = self._clock()
         while not (self._stop.is_set() and self.inbox.depth == 0):
             blob = self.inbox.get(timeout=self.idle_wait_s)
             if blob is not None:
                 t0 = self._clock()
-                with self._admission:
+                if self._native:
+                    # internally-synchronized native queue: the
+                    # GIL-releasing C call runs LOCK-FREE (ISSUE 14)
                     self.service.submit(blob)
-                busy += self._clock() - t0
+                else:
+                    with self._admission:
+                        self.service.submit(blob)
+                self._busy_totals["submit"] += self._clock() - t0
             now = self._clock()
             if now - win_t0 >= self.gauge_interval_s:
-                m.gauge(SERVE_SUBMIT_BUSY_FRAC, busy / (now - win_t0))
+                self.sample_busy_gauges(now)
                 m.gauge(SERVE_INBOX_DEPTH, self.inbox.depth)
-                busy, win_t0 = 0.0, now
+                win_t0 = now
 
     def _dispatch_loop(self) -> None:
-        m = self.service.metrics
         if self.service.tracer is not None:
             self.service.tracer.name_thread(self._dispatch_t.name)
-        busy = 0.0
         win_t0 = self._clock()
         while True:
-            with self._admission:
+            if self._native:
                 batch = self.service._close_batch()
+            else:
+                with self._admission:
+                    batch = self.service._close_batch()
             # pump when there is a closed batch OR builds staged by a
             # previous tick wait for their dispatch (reading the FIFO's
             # truthiness unlocked is benign: worst case one extra tick)
@@ -184,22 +250,26 @@ class ThreadedVoteService:
                 t0 = self._clock()
                 with self._device:
                     self.service._pump_batch(batch)
-                busy += self._clock() - t0
+                self._busy_totals["dispatch"] += self._clock() - t0
             elif self._stop.is_set():
                 break          # idle AND draining: nothing left to pump
             else:
                 time.sleep(self.idle_wait_s)
             now = self._clock()
             if now - win_t0 >= self.gauge_interval_s:
-                m.gauge(SERVE_DISPATCH_BUSY_FRAC, busy / (now - win_t0))
-                busy, win_t0 = 0.0, now
+                self.sample_busy_gauges(now)
+                win_t0 = now
 
     # -- egress (calling thread) ----------------------------------------------
 
     def poll_decisions(self) -> List:
         """Newly latched decisions (VoteService.poll_decisions under
         the device lock — serialized against the dispatch thread's
-        pipeline work, never against submit)."""
+        pipeline work, never against submit).  Also refreshes the
+        busy-fraction gauges on the shared sample window, so a poll
+        cadence keeps them live even when the loops sit in long
+        device calls."""
+        self.sample_busy_gauges()
         with self._device:
             return self.service.poll_decisions()
 
@@ -240,6 +310,10 @@ class ThreadedVoteService:
                     f"{timeout_s}s: {', '.join(stuck)} (an in-flight "
                     f"XLA trace can hold the dispatch thread for "
                     f"minutes; retry drain with a larger timeout_s)")
+        # flush the final partial busy window: without this, the last
+        # < gauge_interval_s of loop work never reached the gauges and
+        # a short-lived service reported busy fractions of 0
+        self.sample_busy_gauges()
         # Surfaced by analysis/lockcheck.py (LOCK004): holding the
         # admission lock across the device-lock acquisition is exactly
         # what the two-lock discipline forbids on the serve path.

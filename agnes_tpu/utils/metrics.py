@@ -381,6 +381,30 @@ BLS_PAIRING_WALL_S = "bls_pairing_wall_s"
 #: package import) and must not import this module.
 BLS_DEVICE_PAIRING_DISPATCHES = "bls_device_pairing_dispatches"
 CENSUS_DRIFT_ENTRIES = "census_drift_entries"
+#: ISSUE 14 (native admission front-end, serve/native_admission.py):
+#:   serve_native_inbox_depth     gauge — records resident in the C++
+#:                                admission queue (the native inbox the
+#:                                submit thread memcpys into)
+#:   serve_native_drain_wall_s    histogram — wall of one GIL-releasing
+#:                                drain-and-densify native call
+#:   serve_native_rejects_<cause> counters (<cause> in overflow /
+#:                                fairness / malformed) — the native
+#:                                screens' reject taxonomy, mirrored
+#:                                beside the shared serve_rejected_*
+#:                                counters so a native-vs-Python
+#:                                comparison reads off one scrape.
+#: All three live in the shared registry, so the drain report, the
+#: heartbeat NDJSON, the /metrics scrape and the agnes-metrics
+#: postmortem carry them like every other serve metric.
+SERVE_NATIVE_INBOX_DEPTH = "serve_native_inbox_depth"
+SERVE_NATIVE_DRAIN_WALL_S = "serve_native_drain_wall_s"
+SERVE_NATIVE_REJECTS_PREFIX = "serve_native_rejects_"
+#: the three cause counters spelled out (hot submit path: no
+#: per-submit string concatenation)
+SERVE_NATIVE_REJECTS_OVERFLOW = SERVE_NATIVE_REJECTS_PREFIX + "overflow"
+SERVE_NATIVE_REJECTS_FAIRNESS = SERVE_NATIVE_REJECTS_PREFIX + "fairness"
+SERVE_NATIVE_REJECTS_MALFORMED = (SERVE_NATIVE_REJECTS_PREFIX
+                                  + "malformed")
 #: per-entry first-dispatch wall gauges, `compile_ms_<entry>` (ISSUE 8
 #: satellite): the registry times the FIRST dispatch of every entry in
 #: the process (trace + compile dominates that call), so the next

@@ -222,12 +222,21 @@ _SERVE_DEDUP_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_DEDUP_SMOKE"))
 #: one Ed25519 verify per vote — then the SAME traffic per-vote
 #: Ed25519 in-process for the bls_agg_speedup ratio; CPU, crash-safe
 _SERVE_BLS_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_BLS_SMOKE"))
+#: native-admission-smoke mode (ci.sh gate, ISSUE 14): ONLY the
+#: native-admission serve probe — the threaded host over the C++
+#: admission front-end, then the SAME traffic through the Python
+#: queue in-process (shared compiles) plus a host-only submit/drain
+#: A/B for native_admission_speedup; CPU, crash-safe
+_SERVE_NATIVE_SMOKE = bool(
+    os.environ.get("AGNES_BENCH_SERVE_NATIVE_SMOKE"))
 _SENTINEL_METRIC = ("pipeline_serve_mesh_votes_per_sec"
                     if _SERVE_MESH_SMOKE
                     else "pipeline_serve_dedup_votes_per_sec"
                     if _SERVE_DEDUP_SMOKE
                     else "pipeline_serve_bls_votes_per_sec"
                     if _SERVE_BLS_SMOKE
+                    else "pipeline_serve_native_votes_per_sec"
+                    if _SERVE_NATIVE_SMOKE
                     else "pipeline_fused_votes_per_sec" if _SERVE_SMOKE
                     else "pipeline_votes_per_sec")
 _SENTINEL_STAGE = ("bench_pipeline_serve_mesh" if _SERVE_MESH_SMOKE
@@ -235,6 +244,8 @@ _SENTINEL_STAGE = ("bench_pipeline_serve_mesh" if _SERVE_MESH_SMOKE
                    if _SERVE_DEDUP_SMOKE
                    else "bench_pipeline_serve_bls"
                    if _SERVE_BLS_SMOKE
+                   else "bench_pipeline_serve_native"
+                   if _SERVE_NATIVE_SMOKE
                    else "bench_pipeline_serve" if _SERVE_SMOKE
                    else "bench_pipeline")
 
@@ -245,7 +256,8 @@ _EXTRA_RECORD: dict = {}
 
 #: every serve smoke is a CPU-only CI gate (no TPU claim/lease/probe)
 _ANY_SERVE_SMOKE = (_SERVE_SMOKE or _SERVE_MESH_SMOKE
-                    or _SERVE_DEDUP_SMOKE or _SERVE_BLS_SMOKE)
+                    or _SERVE_DEDUP_SMOKE or _SERVE_BLS_SMOKE
+                    or _SERVE_NATIVE_SMOKE)
 
 
 def _emit_sentinel(note: str) -> None:
@@ -1512,6 +1524,180 @@ def _pipeline_serve_dedup(n_instances: int, n_validators: int,
     return rate_on
 
 
+def _pipeline_serve_native(n_instances: int, n_validators: int,
+                           heights: int) -> float:
+    """CLOSED-LOOP through the serve plane behind the NATIVE admission
+    front-end (ISSUE 14): the FULL concurrent production shape —
+    ThreadedVoteService's inbox -> submit thread -> C++ admission
+    (parse/screen/fairness/SHA-256 behind one GIL-releasing call) ->
+    dispatch thread — with a dedup cache attached so the digest path
+    is exercised.  Then the SAME traffic through the Python
+    AdmissionQueue in-process (shared compiles — native admission is
+    host-only, so the second run must add ZERO new XLA compiles;
+    asserted, exported as `native_new_compiles`), recording
+    `serve_submit_busy_frac` from both runs for the before/after the
+    verdict record carries.
+
+    The headline `native_admission_speedup` comes from a HOST-ONLY
+    submit/drain A/B over the same wire bytes: at smoke shapes the
+    end-to-end rate is compile/dispatch-bound and would bury the
+    admission delta in device noise, while the submit/drain path is
+    exactly what the front-end moved to C++."""
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+    from agnes_tpu.core import native
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.device import registry as _registry
+    from agnes_tpu.serve import (
+        AdmissionQueue,
+        ShapeLadder,
+        ThreadedVoteService,
+        VerifiedCache,
+        VoteService,
+    )
+    from agnes_tpu.serve.native_admission import NativeAdmissionQueue
+    from agnes_tpu.utils.config import RunConfig
+    from agnes_tpu.utils.metrics import (
+        SERVE_NATIVE_DRAIN_WALL_S,
+    )
+    from agnes_tpu.serve.service import SERVE_SUBMIT_BUSY_FRAC
+
+    I, V = n_instances, n_validators
+    seeds = [i.to_bytes(4, "little") + bytes(28) for i in range(V)]
+    pubkeys = np.stack([np.frombuffer(native.pubkey(s), np.uint8)
+                        for s in seeds])
+    n = I * V
+    rung = 1 << (2 * n - 1).bit_length()       # one full tick's votes
+    inst = np.repeat(np.arange(I), V)
+    val = np.tile(np.arange(V), I)
+
+    def wire_height(h, sigs_by_typ):
+        return b"".join(
+            pack_wire_votes(inst, val, np.full(n, h), np.zeros(n),
+                            np.full(n, typ), np.full(n, 7), sigs[val])
+            for typ, sigs in sigs_by_typ.items())
+
+    all_wire = [wire_height(h, _sign_height_sigs(seeds, h))
+                for h in range(heights + 1)]
+
+    def run(native_admission: bool):
+        d = DeviceDriver(I, V, advance_height=True, defer_collect=True,
+                         audit=True)
+        bat = RunConfig(n_validators=V, n_instances=I,
+                        n_slots=4).validate().make_batcher()
+        cur = {"h": 0}
+        svc = VoteService(
+            d, bat, pubkeys, capacity=4 * n, target_votes=2 * n,
+            max_delay_s=1e9,                   # size-closed batches
+            ladder=ShapeLadder.plan(I, V, min_rung=rung),
+            dedup_cache=VerifiedCache(),
+            native_admission=native_admission,
+            window_predictor=lambda: (np.zeros(I, np.int64),
+                                      np.full(I, cur["h"], np.int64)),
+            flightrec=_FLIGHTREC)
+        tsvc = ThreadedVoteService(svc, idle_wait_s=1e-4)
+        # the heartbeat source samples the busy gauges on the shared
+        # window first (the ISSUE 14 satellite: busy fracs read live
+        # under heartbeat, not only when a loop's window rolls)
+        def source():
+            tsvc.sample_busy_gauges()
+            return svc.metrics.snapshot(window=True,
+                                        window_key="heartbeat")
+        _set_probe_source(source)
+        tsvc.start()
+
+        def feed(h, wire, spin_timeout_s=3600.0):
+            cur["h"] = h
+            if not tsvc.submit(wire):
+                raise RuntimeError("inbox refused the height's wire")
+            want = 2 * n * (h + 1)
+            t_end = time.monotonic() + spin_timeout_s
+            while svc.pipeline.dispatched_votes < want:
+                if tsvc.failure is not None:
+                    raise RuntimeError(
+                        f"serve loop thread died at height {h}"
+                    ) from tsvc.failure
+                if time.monotonic() > t_end:
+                    raise RuntimeError(
+                        f"native serve probe stalled at height {h}")
+                time.sleep(5e-4)
+
+        feed(0, all_wire[0])                   # warmup + compiles
+        warm = tsvc.poll_decisions()
+        if len(warm) != I:
+            raise RuntimeError(f"warm height decided {len(warm)}/{I}")
+        busy0 = tsvc.busy_seconds()["submit"]
+        t0 = time.perf_counter()
+        for h in range(1, heights + 1):
+            feed(h, all_wire[h])
+        tsvc.poll_decisions()       # the one sync point
+        dt = time.perf_counter() - t0
+        # whole-measured-span busy fraction (the lifetime totals, not
+        # the last gauge window — which is idle by drain time); the
+        # windowed SERVE_SUBMIT_BUSY_FRAC gauge stays the live
+        # heartbeat view
+        busy = (tsvc.busy_seconds()["submit"] - busy0) / dt
+        assert d.stats.decisions_total == I * (heights + 1), \
+            d.stats.decisions_total
+        rep = tsvc.drain()
+        assert rep["rejected_signature_device"] == 0
+        assert rep["queue"]["rejected_overflow"] == 0
+        assert rep["inbox"]["dropped"] == 0
+        assert SERVE_SUBMIT_BUSY_FRAC in rep["metrics"], \
+            "busy gauges missing from the drain snapshot"
+        _harvest_audit(d)
+        return 2 * n * heights / dt, busy, rep
+
+    rate_on, busy_on, rep_on = run(native_admission=True)
+    assert rep_on["native_admission"]["admitted"] > 0, rep_on
+    compiles_after_on = len(_registry.compile_ms())
+    rate_off, busy_off, _rep_off = run(native_admission=False)
+    # native admission is host-only: the Python replay (and the native
+    # run before it) must share every compiled shape
+    new_compiles = len(_registry.compile_ms()) - compiles_after_on
+
+    # host-only submit/drain A/B on the same wire (docstring).
+    # GOSSIP-SHAPED submits: a real frontend hands over a few records
+    # per peer call, so the A/B splits each height's wire into
+    # 16-record submits — the shape where per-call Python overhead
+    # (vs one GIL-releasing C call) is the workload, not an
+    # amortized-away constant
+    def admission_votes_per_sec(native: bool) -> float:
+        cls_ = NativeAdmissionQueue if native else AdmissionQueue
+        q = cls_(I, 4 * n, cache=VerifiedCache())
+        chunk = 16 * 96
+        per_height = [[w[k:k + chunk] for k in range(0, len(w), chunk)]
+                      for w in all_wire]
+        per_pass = 2 * n * (heights + 1)
+        reps = max(1, 30_000 // per_pass)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for height_chunks in per_height:
+                for wire in height_chunks:
+                    q.submit(wire)
+                while q.depth:
+                    q.drain(2 * n)
+        dt = time.perf_counter() - t0
+        assert q.counters["admitted"] == reps * per_pass, q.counters
+        return reps * per_pass / dt
+
+    adm_native = admission_votes_per_sec(True)
+    adm_python = admission_votes_per_sec(False)
+    _EXTRA_RECORD.update({
+        "pipeline_serve_native_off_votes_per_sec": round(rate_off),
+        "native_admission_speedup": (round(adm_native / adm_python, 2)
+                                     if adm_python > 0 else -1),
+        "native_admission_votes_per_sec": round(adm_native),
+        "python_admission_votes_per_sec": round(adm_python),
+        "serve_submit_busy_frac_native": round(busy_on, 4),
+        "serve_submit_busy_frac_python": round(busy_off, 4),
+        "native_new_compiles": new_compiles,
+        "serve_native_drain_wall_p50_s":
+            rep_on["metrics"].get(SERVE_NATIVE_DRAIN_WALL_S + "_p50",
+                                  -1),
+    })
+    return rate_on
+
+
 def _pipeline_serve_bls(n_instances: int, n_validators: int,
                         heights: int) -> float:
     """CLOSED-LOOP through the serve plane's BLS AGGREGATE lane
@@ -1789,6 +1975,17 @@ def bench_pipeline_serve_dedup(n_instances: int = 1024,
     return _pipeline_serve_dedup(n_instances, n_validators, heights)
 
 
+def bench_pipeline_serve_native(n_instances: int = 1024,
+                                n_validators: int = 128,
+                                heights: int = 6) -> float:
+    """End-to-end through the serve plane behind the C++ native
+    admission front-end (ISSUE 14): threaded host, GIL-releasing
+    submit/drain, dedup digests hashed natively — with an in-process
+    Python-admission replay of the same traffic and a host-only
+    submit/drain A/B for `native_admission_speedup`."""
+    return _pipeline_serve_native(n_instances, n_validators, heights)
+
+
 def bench_pipeline_serve_bls(n_instances: int = 64,
                              n_validators: int = 128,
                              heights: int = 6) -> float:
@@ -1895,6 +2092,23 @@ def main_serve_bls_smoke() -> None:
                 "Ed25519")
 
 
+def main_serve_native_smoke() -> None:
+    """The ci.sh native-admission gate's entry (ISSUE 14): ONLY the
+    native-admission serve probe — threaded host over the C++
+    front-end, Python-admission replay for the busy-frac before/after,
+    host-only submit/drain A/B for the speedup — tiny shape, CPU, same
+    crash-safe contract.  The record carries
+    `native_admission_speedup`, both `serve_submit_busy_frac_*`
+    gauges and `native_new_compiles` via _EXTRA_RECORD."""
+    _smoke_main("bench_pipeline_serve_native",
+                "pipeline_serve_native_votes_per_sec",
+                "pipeline_serve_native_votes_per_sec",
+                "votes/sec/chip",
+                "AGNES_SERVE_NATIVE_SMOKE", bench_pipeline_serve_native,
+                "native admission smoke: C++ ingest front-end vs "
+                "Python admission")
+
+
 def main_serve_mesh_smoke() -> None:
     """The ci.sh mesh-serve gate's entry (ISSUE 3): ONLY the mesh
     serve probe — ThreadedVoteService event loop + dense sharded
@@ -1946,6 +2160,8 @@ def main() -> None:
     pipeline_serve_mesh = guarded(bench_pipeline_serve_mesh)
     # duplicated-traffic serve: dedup cache + split-rung dispatch
     pipeline_serve_dedup = guarded(bench_pipeline_serve_dedup)
+    # native admission front-end: C++ submit/drain + Python replay
+    pipeline_serve_native = guarded(bench_pipeline_serve_native)
     # BLS aggregate lane: one pairing per vote class
     pipeline_serve_bls = guarded(bench_pipeline_serve_bls)
     tally = guarded(bench_tally)
@@ -1976,6 +2192,7 @@ def main() -> None:
         "pipeline_serve_votes_per_sec": pipeline_serve,
         "pipeline_serve_mesh_votes_per_sec": pipeline_serve_mesh,
         "pipeline_serve_dedup_votes_per_sec": pipeline_serve_dedup,
+        "pipeline_serve_native_votes_per_sec": pipeline_serve_native,
         "pipeline_serve_bls_votes_per_sec": pipeline_serve_bls,
         **_EXTRA_RECORD,
         "fused_tally_step_votes_per_sec": tally,
@@ -1996,6 +2213,7 @@ if __name__ == "__main__":
         (main_serve_mesh_smoke() if _SERVE_MESH_SMOKE
          else main_serve_dedup_smoke() if _SERVE_DEDUP_SMOKE
          else main_serve_bls_smoke() if _SERVE_BLS_SMOKE
+         else main_serve_native_smoke() if _SERVE_NATIVE_SMOKE
          else main_serve_smoke() if _SERVE_SMOKE else main())
     except BaseException as e:  # noqa: BLE001 — the contract: a
         # parseable record is the LAST stdout line no matter how this

@@ -26,6 +26,8 @@
 #   3b. serve smoke gate (single-device streaming plane, CPU);
 #   3c. mesh serve smoke gate (ISSUE 3: threaded host + dense-lane
 #       sharded dispatch on a faked 2-device CPU mesh);
+#   3f. native admission smoke gate (ISSUE 14: the C++ admission
+#       front-end vs the Python queue on the same traffic);
 #   4.  bench smoke (CI_BENCH=0 skips; the driver runs the real bench
 #       on TPU hardware at end of round).
 #
@@ -43,12 +45,17 @@ UBSAN_SO="$(g++ -print-file-name=libubsan.so)"
 # san_report.* files (pytest's capture can swallow the stderr report
 # when halt_on_error kills the process mid-test).
 SAN_LOG="$(mktemp -d)/san_report"
+# tests/test_native_admission.py rides the same sanitized build: the
+# ISSUE 14 admission screens (admission.cpp + the sha512.cpp SHA-256
+# schedule) get their differential + hostile-record suites under
+# ASan/UBSan, wired into the existing native build gate
 AGNES_NATIVE_SANITIZE="address,undefined" \
   LD_PRELOAD="$ASAN_SO $UBSAN_SO" \
   ASAN_OPTIONS="detect_leaks=0,halt_on_error=1,log_path=$SAN_LOG" \
   UBSAN_OPTIONS="halt_on_error=1,print_stacktrace=1,log_path=$SAN_LOG" \
   python -m pytest tests/test_native_core.py tests/test_capi_fuzz.py \
-    tests/test_native_ingest.py -q -p no:cacheprovider \
+    tests/test_native_ingest.py tests/test_native_admission.py \
+    -q -p no:cacheprovider \
   || { cat "$SAN_LOG".* 2>/dev/null; exit 1; }
 
 echo "=== [1b/3] TSAN: ingest worker-thread stress ==="
@@ -190,6 +197,7 @@ echo "=== [2/4] full test suite (virtual 8-device CPU mesh) ==="
 python -m pytest tests/ -q -p no:cacheprovider \
   --ignore=tests/test_native_core.py --ignore=tests/test_capi_fuzz.py \
   --ignore=tests/test_native_ingest.py \
+  --ignore=tests/test_native_admission.py \
   --ignore=tests/test_zz_heavy_isolated.py
 
 echo "=== [2b/4] isolated heavy crypto tests (child interpreters) ==="
@@ -439,6 +447,54 @@ else:
           f"votes/s; device pairing "
           f"{rec['bls_pairing_device_speedup']}x vs host, per-class "
           f"p50 {rec['bls_pairing_wall_p50_s']}s)")
+PY
+
+echo "=== [3f/4] native admission smoke gate (CPU) ==="
+# ISSUE 14: the C++ admission front-end — threaded host submitting
+# through one GIL-releasing native call per blob (parse/screen/
+# fairness/SHA-256 in admission.cpp), then the SAME traffic through
+# the Python AdmissionQueue in-process, plus a host-only submit/drain
+# A/B for native_admission_speedup.  Same crash-safe contract as
+# [3c]/[3d]: a real pipeline_serve_native_votes_per_sec record (which
+# must then show speedup > 1, zero unexpected retraces and ZERO new
+# XLA compiles on the Python replay — native admission is host-only)
+# or the -1 sentinel, rc 0 either way.
+NATIVE_DIR="$(mktemp -d)"
+NATIVE_RC=0
+AGNES_BENCH_SERVE_NATIVE_SMOKE=1 \
+  AGNES_TPU_LEASE_PATH="$NATIVE_DIR/tpu.lease" \
+  timeout -k 10 900 python bench.py > "$NATIVE_DIR/serve_native.json" \
+  2> "$NATIVE_DIR/serve_native.err" || NATIVE_RC=$?
+if [ "$NATIVE_RC" -ne 0 ]; then
+  echo "native admission smoke gate FAILED: bench exited rc=$NATIVE_RC"
+  tail -5 "$NATIVE_DIR/serve_native.err"
+  exit 1
+fi
+python - "$NATIVE_DIR/serve_native.json" <<'PY'
+import json, sys
+lines = [l for l in open(sys.argv[1]).read().strip().splitlines() if l]
+assert lines, "native admission smoke printed no stdout"
+rec = json.loads(lines[-1])
+assert rec["metric"] == "pipeline_serve_native_votes_per_sec", rec
+assert isinstance(rec["value"], (int, float)), rec
+assert rec["value"] == -1 or rec["value"] > 0, rec
+if rec["value"] == -1:
+    print("native admission smoke gate OK: -1 sentinel "
+          "(deadline contract)")
+else:
+    # acceptance: the native submit/drain path must beat the Python
+    # queue on the same wire (measured well above 1 on an idle box;
+    # > 1 is the conservative floor so a loaded CI box cannot flake
+    # while a native path SLOWER than Python still fails), with zero
+    # unexpected retraces and zero new compiles on the Python replay
+    assert rec["native_admission_speedup"] > 1, rec
+    assert rec["retrace_unexpected"] == 0, rec
+    assert rec["native_new_compiles"] == 0, rec
+    print(f"native admission smoke gate OK: {rec['value']:.0f} votes/s "
+          f"(admission {rec['native_admission_speedup']}x vs Python "
+          f"{rec['python_admission_votes_per_sec']:.0f} rec/s; submit "
+          f"busy frac {rec['serve_submit_busy_frac_native']} native "
+          f"vs {rec['serve_submit_busy_frac_python']} python)")
 PY
 
 echo "=== GATE SUMMARY: heavy isolated files ==="

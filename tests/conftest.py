@@ -85,6 +85,7 @@ _CHEAP = (          # no XLA compiles (stdlib / numpy / ctypes / refs)
     "test_budget.py", "test_capi_fuzz.py",
     "test_cli_shims.py",
     "test_ed25519_ref.py", "test_executor.py", "test_modelcheck.py",
+    "test_native_admission.py",
     "test_native_core.py",
     "test_native_ingest.py", "test_observability.py",
     "test_round_votes.py",

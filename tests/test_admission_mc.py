@@ -165,11 +165,14 @@ def test_admission_corpus_replays_deterministically(entry):
 # ---------------------------------------------------------------------------
 
 
-def _real_service(cfg: am.AdmissionMCConfig):
+def _real_service(cfg: am.AdmissionMCConfig,
+                  native_admission: bool = False):
     """A VoteService assembled from the REAL queue/batcher/pipeline
     with step_async stubbed (test_serve_cache.py pattern) and a
     1-round batcher window so the model's held-vote semantics map
-    onto the real hold-back path."""
+    onto the real hold-back path.  `native_admission=True` swaps in
+    the C++ admission front-end (ISSUE 14) — the conformance
+    differential drives both and asserts leaf-for-leaf equality."""
     from agnes_tpu.bridge import VoteBatcher
     from agnes_tpu.harness.device_driver import DeviceDriver
     from agnes_tpu.harness.fixtures import (
@@ -211,6 +214,7 @@ def _real_service(cfg: am.AdmissionMCConfig):
         capacity=cfg.capacity, instance_cap=cfg.instance_cap,
         overload_policy=cfg.policy, target_votes=cfg.target,
         max_delay_s=0.0,
+        native_admission=native_admission,
         ladder=ShapeLadder.plan(I, V, min_rung=4),
         window_predictor=lambda: (window["base"].copy(),
                                   np.zeros(I, np.int64)))
@@ -227,11 +231,13 @@ def _real_service(cfg: am.AdmissionMCConfig):
     return svc, window, dispatches
 
 
-def _replay_on_serve(cfg: am.AdmissionMCConfig, actions):
+def _replay_on_serve(cfg: am.AdmissionMCConfig, actions,
+                     native_admission: bool = False):
     """Drive the real serve plane through an admission schedule:
     submit/pump/settle/window map onto the production calls."""
     sys_model = am.AdmissionSystem(cfg)      # for the wire bytes
-    svc, window, dispatches = _real_service(cfg)
+    svc, window, dispatches = _real_service(
+        cfg, native_admission=native_admission)
     for a in actions:
         act = am.AdmissionSystem.action_from_json(a) \
             if a and a[0] in am._ACT_CODES else tuple(a)
@@ -294,6 +300,32 @@ def test_admission_corpus_replays_through_real_serve_plane(entry):
             and svc.pipeline.host_fallback_builds == 0:
         assert svc.pipeline.preverified_votes > 0
         assert svc.cache is not None and svc.cache.counters["hits"] > 0
+
+
+@pytest.mark.parametrize(
+    "entry",
+    [e for e in mc.load_corpus(CORPUS_DIR)],
+    ids=lambda e: e["name"])
+def test_admission_corpus_native_admission_conformance(entry):
+    """ISSUE 14 conformance differential: every corpus schedule
+    through native-ON vs native-OFF VoteService — dispatch streams
+    bit-identical, reject taxonomy and dedup-cache counters
+    leaf-for-leaf (the checker's corpus IS the admission spec, so
+    the native front-end conforms by replay, not re-derivation).
+    The deeper queue/column/BLS differentials live in
+    tests/test_native_admission.py."""
+    cfg = am.AdmissionMCConfig.from_json(entry["config"])
+    svc_off, disp_off = _replay_on_serve(cfg, entry["actions"])
+    svc_on, disp_on = _replay_on_serve(cfg, entry["actions"],
+                                       native_admission=True)
+    assert disp_on == disp_off, entry["name"]
+    assert svc_on.queue.counters == svc_off.queue.counters
+    assert svc_on.queue.mc_canonical()[0] == \
+        svc_off.queue.mc_canonical()[0]
+    if svc_off.cache is not None:
+        assert svc_on.cache.counters == svc_off.cache.counters
+    assert svc_on.pipeline.dispatched_votes == \
+        svc_off.pipeline.dispatched_votes
 
 
 def test_serve_replay_dedup_roundtrip_goes_unsigned():
