@@ -67,11 +67,12 @@ DISPATCH_CALLS = frozenset({
 
 PRAGMA = "lockcheck: allow"
 
-#: the native C ABI's symbol prefixes (core/native/ingest.cpp +
-#: admission.cpp): a call on an attribute with one of these prefixes
-#: IS a GIL-releasing ctypes call — LOCK005 forbids it under the
-#: admission lock
-NATIVE_CAPI_PREFIXES = ("ag_adm_", "ag_ing_")
+#: the native C ABI's symbol prefix (core/native/*.cpp — every
+#: exported symbol is ``ag_*``, including ag_apply and the
+#: ag_ed25519_* batch entries): a call on an attribute with this
+#: prefix IS a GIL-releasing ctypes call — LOCK005 forbids it under
+#: the admission lock, exactly as the LINT004 docs promise
+NATIVE_CAPI_PREFIXES = ("ag_",)
 
 
 def _lock_name(node) -> Optional[str]:

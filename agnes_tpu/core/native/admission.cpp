@@ -320,6 +320,7 @@ int64_t ag_adm_drain(void* h, int64_t n, int64_t* inst, int64_t* val,
                      uint8_t* out_dig, double* ts) {
   auto* A = static_cast<AdmQ*>(h);
   std::lock_guard<std::mutex> g(A->mu);
+  if (n < 0) n = 0;   // hostile caller: never count drained backwards
   if (n > static_cast<int64_t>(A->q.size()))
     n = static_cast<int64_t>(A->q.size());
   for (int64_t k = 0; k < n; ++k) {

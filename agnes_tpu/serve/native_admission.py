@@ -92,7 +92,7 @@ def _lib() -> ctypes.CDLL:
         L.ag_adm_bls_screen.restype = c.c_int64
         L.ag_adm_bls_screen.argtypes = [c.c_char_p, c.c_int64,
                                         c.c_int64, c.c_int64,
-                                        c.c_char_p, c.c_char_p,
+                                        c.c_void_p, c.c_void_p,
                                         c.c_void_p]
         _configured = True
     return L
@@ -119,7 +119,7 @@ def bls_screen(wire_bytes, n_instances: int, n_validators: int,
             f"{pop.shape}/{quar.shape}")
     got = _lib().ag_adm_bls_screen(
         raw, len(raw), int(n_instances), int(n_validators),
-        pop.tobytes(), quar.tobytes(), codes.ctypes.data)
+        pop.ctypes.data, quar.ctypes.data, codes.ctypes.data)
     return codes[:got]
 
 
@@ -155,7 +155,10 @@ class NativeAdmissionQueue:
             raise ValueError(
                 f"instance_cap must be positive: {instance_cap}")
         self.policy = policy
-        self.cache = cache
+        #: digest computation is FROZEN into the native handle at
+        #: construction — the cache property's setter enforces it
+        self._digests = cache is not None
+        self._cache = cache
         self.bls_table = bls_table
         self.wait_hist = None        # duck-typed .record(s, n) sink
         #: drain wall-clock sink (serve_native_drain_wall_s): the
@@ -180,6 +183,27 @@ class NativeAdmissionQueue:
             self._free(self._h)
             self._h = None
 
+    @property
+    def cache(self):
+        return self._cache
+
+    @cache.setter
+    def cache(self, value) -> None:
+        # AdmissionQueue reads self.cache per submit, but the native
+        # handle freezes its digest flag at construction: attaching a
+        # cache to a digest-less handle would hand cache.lookup
+        # uninitialized digest bytes and settle all-zero keys.  Fail
+        # loudly instead of silently diverging from the twin contract.
+        # (Detaching — or re-attaching on a digest-enabled handle — is
+        # fine: the C side keeps hashing either way.)
+        if value is not None and not self._digests:
+            raise ValueError(
+                "NativeAdmissionQueue cannot attach a dedup cache "
+                "after construction: the native handle was created "
+                "without digest computation (pass cache= to "
+                "__init__)")
+        self._cache = value
+
     # -- admission -----------------------------------------------------------
 
     def submit(self, wire_bytes) -> AdmitResult:
@@ -191,8 +215,13 @@ class NativeAdmissionQueue:
             else bytes(wire_bytes)
         n_whole = len(raw) // REC_SIZE
         counts = np.zeros(5, np.int64)
+        # snapshot: submit runs LOCK-FREE on the threaded host's
+        # submit thread while the setter blesses runtime detach /
+        # re-attach — one read, used throughout, or a re-attach
+        # landing mid-submit pairs `cache is not None` with dig=None
+        cache = self.cache
         dig = (np.empty((n_whole, 32), np.uint8)
-               if self.cache is not None and n_whole else None)
+               if cache is not None and n_whole else None)
         seq = _lib().ag_adm_submit(
             self._h, raw, len(raw), counts.ctypes.data,
             dig.ctypes.data if dig is not None else None)
@@ -203,10 +232,10 @@ class NativeAdmissionQueue:
             # invocations, so the native path keeps that discipline
             _lib().ag_adm_set_chunk_ts(self._h, seq, self._clock())
         pre_verified = 0
-        if self.cache is not None and accepted:
+        if cache is not None and accepted:
             # the lookup covers exactly the admitted records, so the
             # cache's hit + miss counters still sum to `admitted`
-            ver = self.cache.lookup(dig[:accepted])
+            ver = cache.lookup(dig[:accepted])
             pre_verified = int(ver.sum())
             if pre_verified:
                 _lib().ag_adm_mark_verified(
@@ -245,6 +274,14 @@ class NativeAdmissionQueue:
 
     @property
     def oldest_ts(self) -> Optional[float]:
+        """Admission instant of the oldest queued record, None when
+        empty — with one documented transient: the front record can be
+        drained-visible between a lock-free submit and its
+        set_chunk_ts stamp, in which case its ts is still NaN and this
+        reads None while depth > 0.  MicroBatcher.poll treats that as
+        "no deadline anchor yet" and just defers the deadline close by
+        one poll; the next read sees the stamp.  Never taken
+        single-threaded, so differentials are unaffected."""
         v = _lib().ag_adm_oldest_ts(self._h)
         return None if math.isnan(v) else v
 
@@ -296,15 +333,23 @@ class NativeAdmissionQueue:
               ) -> Optional[WireColumns]:
         """Pop up to `max_records` oldest records, densified to the
         WireColumns arrays in ONE GIL-releasing native call (None when
-        empty).  Wait-histogram recording keeps the Python queue's
-        chunk granularity: records of one submit share one admission
-        instant, so the run-length groups of the ts column ARE the
-        chunks."""
+        empty).  The batch is sized from the native call's RETURN
+        value, not the pre-read depth — the queue may shrink between
+        the two under concurrent drains.  Wait-histogram recording
+        keeps the Python queue's chunk granularity: records of one
+        submit share one admission instant, so the run-length groups
+        of the ts column ARE the chunks (two submits stamped with an
+        identical coarse-clock value merge into one record() call —
+        histogram contents identical, invocation count not)."""
         n = self.depth
         if n == 0:
             return None
         if max_records is not None:
             n = min(n, int(max_records))
+            if n <= 0:
+                # zero/negative cap: None, matching AdmissionQueue
+                # (np.empty(n < 0) would raise; the C side clamps >= 0)
+                return None
         inst = np.empty(n, np.int64)
         val = np.empty(n, np.int64)
         hts = np.empty(n, np.int64)
@@ -317,22 +362,38 @@ class NativeAdmissionQueue:
                if self.cache is not None else None)
         ts = np.empty(n, np.float64)
         t0 = time.perf_counter()
-        _lib().ag_adm_drain(
+        got = int(_lib().ag_adm_drain(
             self._h, n, inst.ctypes.data, val.ctypes.data,
             hts.ctypes.data, rnd.ctypes.data, typ.ctypes.data,
             value.ctypes.data, sigs.ctypes.data, ver.ctypes.data,
             dig.ctypes.data if dig is not None else None,
-            ts.ctypes.data)
+            ts.ctypes.data))
+        wall = time.perf_counter() - t0
+        # the C side clamps n to the LIVE queue size under its mutex —
+        # a concurrent drain (or anything else shrinking the queue)
+        # between the unlocked depth read above and the native call
+        # means rows past `got` are uninitialized np.empty memory and
+        # must never reach VoteBatcher
+        if got == 0:
+            return None
+        if got < n:
+            n = got
+            inst, val, hts, rnd, typ, value, ts = (
+                a[:n] for a in (inst, val, hts, rnd, typ, value, ts))
+            sigs, ver = sigs[:n], ver[:n]
+            if dig is not None:
+                dig = dig[:n]
         if self.drain_hist is not None:
-            self.drain_hist.record(time.perf_counter() - t0, n)
+            self.drain_hist.record(wall, n)
         # a record popped between a lock-free submit and its
         # set_chunk_ts stamp carries NaN — substitute "admitted just
         # now" so neither the wait histogram nor t_first (and the
         # batch-close-age histogram downstream of it) ever sees an
         # epoch-scale outlier.  Never taken single-threaded, so the
         # fake-clock invocation parity of the differentials holds.
-        if np.isnan(ts).any():
-            ts[np.isnan(ts)] = self._clock()
+        nan = np.isnan(ts)
+        if nan.any():
+            ts[nan] = self._clock()
         if self.wait_hist is not None:
             # one clock read, and ONLY with a histogram attached —
             # AdmissionQueue.drain's exact clock discipline

@@ -468,6 +468,10 @@ class AdmissionQueue:
             return None
         n = self.depth if max_records is None else min(self.depth,
                                                        int(max_records))
+        if n <= 0:
+            # a zero/negative cap pops nothing — None, same as empty
+            # (NOT _pop(n): a negative n would corrupt depth/counters)
+            return None
         chunks = self._pop(n)
         if self.wait_hist is not None:
             # submit -> drain wait, chunk granularity: every record of

@@ -56,6 +56,7 @@ settle) on the calling thread and returns its report.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import List, Optional
@@ -105,6 +106,12 @@ class ThreadedVoteService:
         #: dropped at drain and a heartbeat between rolls read stale
         #: values (the ISSUE 14 satellite fix)
         self._busy_totals = {"submit": 0.0, "dispatch": 0.0}
+        #: start instant of a loop's call currently in flight (None =
+        #: idle), so a mid-call sample attributes the elapsed span to
+        #: the CURRENT window — without it a 60 s XLA compile looked
+        #: idle for 60 heartbeat samples and then landed whole in one
+        #: 1 s window as busy_frac = 60
+        self._busy_inflight = {"submit": None, "dispatch": None}
         self._busy_sample = {"t": None, "submit": 0.0, "dispatch": 0.0}
         self._busy_mu = threading.Lock()
         self._stop = threading.Event()       # stop intake, finish work
@@ -189,17 +196,46 @@ class ThreadedVoteService:
             t0 = self._busy_sample["t"]
             if t0 is None:
                 self._busy_sample["t"] = now
+                for name in ("submit", "dispatch"):
+                    self._busy_sample[name] = self._observed(name, now)
                 return
             dt = now - t0
             if dt <= 0:
                 return
             for name, gauge in (("submit", SERVE_SUBMIT_BUSY_FRAC),
                                 ("dispatch", SERVE_DISPATCH_BUSY_FRAC)):
-                total = self._busy_totals[name]
-                m.gauge(gauge,
-                        (total - self._busy_sample[name]) / dt)
-                self._busy_sample[name] = total
+                observed = self._observed(name, now)
+                # clamp: attribution keeps windows consistent, the
+                # min() only absorbs clock-read jitter at the edges
+                m.gauge(gauge, min(
+                    1.0, (observed - self._busy_sample[name]) / dt))
+                self._busy_sample[name] = observed
             self._busy_sample["t"] = now
+
+    def _observed(self, name: str, now: float) -> float:
+        """Busy seconds observable at `now`: the completed total plus
+        the elapsed span of any call still in flight (callers hold
+        _busy_mu).  A loop sitting in a minutes-long device call is
+        BUSY for every window the call spans, not idle-then-60x."""
+        start = self._busy_inflight[name]
+        inflight = max(0.0, now - start) if start is not None else 0.0
+        return self._busy_totals[name] + inflight
+
+    @contextlib.contextmanager
+    def _busy(self, name: str):
+        """Busy-span bookkeeping for one loop call: mark in flight so
+        mid-call samples attribute the elapsed span to their window,
+        accumulate + clear on the way out — in a finally, so a raising
+        call never leaves a dead thread reading 100% busy forever."""
+        t0 = self._clock()
+        with self._busy_mu:
+            self._busy_inflight[name] = t0
+        try:
+            yield
+        finally:
+            with self._busy_mu:
+                self._busy_totals[name] += self._clock() - t0
+                self._busy_inflight[name] = None
 
     def _submit_loop(self) -> None:
         m = self.service.metrics
@@ -212,15 +248,14 @@ class ThreadedVoteService:
         while not (self._stop.is_set() and self.inbox.depth == 0):
             blob = self.inbox.get(timeout=self.idle_wait_s)
             if blob is not None:
-                t0 = self._clock()
-                if self._native:
-                    # internally-synchronized native queue: the
-                    # GIL-releasing C call runs LOCK-FREE (ISSUE 14)
-                    self.service.submit(blob)
-                else:
-                    with self._admission:
+                with self._busy("submit"):
+                    if self._native:
+                        # internally-synchronized native queue: the
+                        # GIL-releasing C call runs LOCK-FREE (ISSUE 14)
                         self.service.submit(blob)
-                self._busy_totals["submit"] += self._clock() - t0
+                    else:
+                        with self._admission:
+                            self.service.submit(blob)
             now = self._clock()
             if now - win_t0 >= self.gauge_interval_s:
                 self.sample_busy_gauges(now)
@@ -247,10 +282,9 @@ class ThreadedVoteService:
             if (batch is not None or self.service.pipeline._staged
                     or (self.service.bls is not None
                         and self.service.bls.ready())):
-                t0 = self._clock()
-                with self._device:
-                    self.service._pump_batch(batch)
-                self._busy_totals["dispatch"] += self._clock() - t0
+                with self._busy("dispatch"):
+                    with self._device:
+                        self.service._pump_batch(batch)
             elif self._stop.is_set():
                 break          # idle AND draining: nothing left to pump
             else:

@@ -168,6 +168,13 @@ def test_submit_drain_differential(policy, cache):
         if k % 4 == 3:
             take = int(rng.integers(1, 9))
             _assert_batches_equal(qa.drain(take), qb.drain(take))
+        if k == 10:
+            # zero/negative caps pop nothing on BOTH implementations
+            # (review regression: the Python queue raised from min()
+            # over no chunks, the native queue returned None)
+            assert qa.drain(0) is None and qb.drain(0) is None
+            assert qa.drain(-3) is None and qb.drain(-3) is None
+            assert qa.counters == qb.counters and qa.depth == qb.depth
     assert qa.mc_canonical()[0] == qb.mc_canonical()[0]
     while qa.depth:
         _assert_batches_equal(qa.drain(6), qb.drain(6))
@@ -197,6 +204,67 @@ def test_drop_oldest_eviction_parity():
     _assert_batches_equal(qa.drain(), qb.drain())
 
 
+def test_threaded_drain_clamp_stress_drop_oldest():
+    """Producer + TWO racing drainers over a drop_oldest queue: the C
+    side clamps each drain to the live queue size under its mutex
+    AFTER the wrapper's unlocked depth read, so the wrapper must size
+    its batch from the native RETURN value (review regression:
+    trailing np.empty garbage rows reached VoteBatcher and the
+    Python-side record count diverged from the native `drained`
+    counter).  Every drained row must be an initialized record and the
+    record totals must reconcile exactly."""
+    rng = np.random.default_rng(29)
+    q = NativeAdmissionQueue(I, 8, instance_cap=100,
+                             policy="drop_oldest")
+    wires = [rand_wire(rng, n) for n in (2, 3, 5, 8)]
+    stop = threading.Event()
+    errs = []
+    drained = [0, 0]
+
+    def producer():
+        k = 0
+        while not stop.is_set():
+            q.submit(wires[k % len(wires)])
+            k += 1
+
+    def consumer(slot):
+        try:
+            for _ in range(1500):
+                b = q.drain(6)
+                if b is None:
+                    continue
+                # a clamped drain returns a SHORT batch, never a
+                # garbage-padded one: every row initialized
+                assert 1 <= len(b) <= 6, len(b)
+                inst = np.asarray(b.instance)
+                assert inst.min() >= 0 and inst.max() < I, inst
+                assert np.isfinite(b.t_first) and b.t_first > 0.0
+                drained[slot] += len(b)
+        except Exception as e:          # pragma: no cover - fail path
+            errs.append(e)
+
+    threads = [threading.Thread(target=producer)] + \
+        [threading.Thread(target=consumer, args=(s,)) for s in (0, 1)]
+    for t in threads[1:]:
+        t.start()
+    threads[0].start()
+    for t in threads[1:]:
+        t.join()
+    stop.set()
+    threads[0].join()
+    assert not errs, errs
+    total = sum(drained)
+    while (b := q.drain(16)) is not None:   # quiesce single-threaded
+        total += len(b)
+    c = q.counters
+    # Python-side record count == native drained counter, and the
+    # full taxonomy reconciles (evicted records never count drained)
+    assert c["drained"] == total, (c, total)
+    assert c["admitted"] == c["drained"] + c["evicted"]
+    assert c["evicted"] > 0                 # drop_oldest actually bit
+    assert q.depth == 0
+
+
 def test_wrapper_validation_parity():
     with pytest.raises(ValueError):
         NativeAdmissionQueue(I, 0)
@@ -207,6 +275,14 @@ def test_wrapper_validation_parity():
     q = NativeAdmissionQueue(I, 8)
     with pytest.raises(ValueError):
         q.submit_bls(b"")
+    # the digest flag is frozen into the native handle: attaching a
+    # cache to a digest-less queue must fail loudly, not hand lookup
+    # uninitialized digest bytes (review regression)
+    with pytest.raises(ValueError):
+        q.cache = VerifiedCache()
+    qc = NativeAdmissionQueue(I, 8, cache=VerifiedCache())
+    qc.cache = None                  # detach: fine, C keeps hashing
+    qc.cache = VerifiedCache()       # re-attach on a digest handle
 
 
 def test_noncanonical_nil_flag_byte_drains_identically():

@@ -194,6 +194,9 @@ def test_threaded_loop_failure_fails_closed():
     assert rep["thread_failure"] is not None
     assert "synthetic XLA death" in rep["thread_failure"]
     assert rep["metrics"]["serve_thread_failures"] == 1
+    # the dying dispatch call cleared its in-flight marker (finally):
+    # a dead thread must not read 100% busy in every later window
+    assert tsvc._busy_inflight == {"submit": None, "dispatch": None}
 
 
 # -- metrics thread-safety ----------------------------------------------------
@@ -258,3 +261,39 @@ def test_threaded_lock_order_instrumented():
     assert rep["thread_failure"] is None
     assert state.violations == [], state.violations
     assert state.acquisitions > 0
+
+
+def test_busy_gauges_attribute_inflight_spans_and_clamp():
+    """A loop sitting in one long device call is BUSY for every sample
+    window the call spans: mid-call samples must read ~1.0 (not 0) and
+    the first sample after completion must not publish the whole span
+    into one short window (review regression: a 60 s compile under a
+    1 s heartbeat read idle 60x then busy_frac = 60)."""
+
+    class _Svc:                           # threads never started
+        queue = object()
+        metrics = Metrics()
+
+    t = {"now": 100.0}
+    tsvc = ThreadedVoteService(_Svc(), clock=lambda: t["now"])
+    g = _Svc.metrics.gauges
+    tsvc.sample_busy_gauges()             # open the shared window
+    # the dispatch loop enters a long call at t=100
+    with tsvc._busy_mu:
+        tsvc._busy_inflight["dispatch"] = t["now"]
+    for k in range(3):                    # heartbeat samples mid-call
+        t["now"] += 1.0
+        tsvc.sample_busy_gauges()
+        assert g[SERVE_DISPATCH_BUSY_FRAC] == pytest.approx(1.0), k
+        assert g[SERVE_SUBMIT_BUSY_FRAC] == pytest.approx(0.0), k
+    # the call completes at t=104 (4 s busy total)
+    t["now"] += 1.0
+    with tsvc._busy_mu:
+        tsvc._busy_totals["dispatch"] += t["now"] - 100.0
+        tsvc._busy_inflight["dispatch"] = None
+    t["now"] += 1.0                       # one idle second
+    tsvc.sample_busy_gauges()             # window covers [103, 105]
+    assert 0.0 <= g[SERVE_DISPATCH_BUSY_FRAC] <= 1.0
+    assert g[SERVE_DISPATCH_BUSY_FRAC] == pytest.approx(0.5)
+    # lifetime totals stay the probe's whole-run source
+    assert tsvc.busy_seconds()["dispatch"] == pytest.approx(4.0)
