@@ -538,6 +538,22 @@ def shard_coverage_findings(union_names) -> List[Finding]:
         "from planned_names()")]
 
 
+def _pod_audit_mesh():
+    """A pod-shaped hierarchical mesh (slice=2 hosts x data=1 x
+    val=2) over 4 devices, or None below 4 — the ISSUE 15 census
+    dimension: the multi-host driver dispatches the SAME sharded
+    entries over a mesh whose outer slice axis crosses hosts (DCN),
+    and the layout's promise is that NOTHING ever reduces over it."""
+    import jax
+
+    from agnes_tpu.parallel.mesh import make_hierarchical_mesh
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        return None
+    return make_hierarchical_mesh(2, 1, 2, devs[:4])
+
+
 def _audit_mesh():
     """A small (data x val) mesh over the available devices, or None
     when the backend has a single device (sharded entries skipped)."""
@@ -604,24 +620,48 @@ def audit(quick: bool = False, names: Optional[List[str]] = None,
         _audit_one(spec, dict(ENTRY_STATICS[name]), mesh, metrics,
                    findings, reports, dims)
 
-    # chunk invariance: chunking the sharded fused verify must add
-    # ZERO collectives (the chunk loop is shard-local)
-    name = "sharded_step_seq_signed"
-    if (name in plan and mesh is not None
-            and not any(f.where == name for f in findings)):
+    def _census_compare(name, statics, cmp_mesh, code, what):
+        """Re-trace `name` under a VARIED configuration and assert
+        its collective census is IDENTICAL to the already-audited
+        base — the shared scaffold of the two invariance gates below
+        (one shape: skip if the entry wasn't planned / already has a
+        finding, trace, compare, count the extra audit)."""
+        if (name not in plan or cmp_mesh is None
+                or any(f.where == name for f in findings)):
+            return
         base = next(r.collectives for r in reports if r.entry == name)
-        statics = dict(ENTRY_STATICS[name], verify_chunk=1)
-        traced = trace_entry(specs[name], statics, mesh, dims)
-        chunked = collective_census(traced.jaxpr.jaxpr)
-        if chunked != base:
+        traced = trace_entry(specs[name], statics, cmp_mesh, dims)
+        varied = collective_census(traced.jaxpr.jaxpr)
+        if varied != base:
             findings.append(Finding(
-                "jaxpr", "AUD002", name,
-                f"verify_chunk changes the collective census: "
-                f"unchunked {base} vs chunk=1 {chunked} (chunking "
-                f"must add zero collectives per chunk)"))
+                "jaxpr", code, name,
+                f"{what} changes the collective census: "
+                f"base {base} vs varied {varied}"))
         if metrics is not None:
             from agnes_tpu.utils.metrics import ANALYSIS_ENTRIES_AUDITED
 
             metrics.count(ANALYSIS_ENTRIES_AUDITED)
+
+    name = "sharded_step_seq_signed"
+    # chunk invariance (AUD002): chunking the sharded fused verify
+    # must add ZERO collectives (the chunk loop is shard-local)
+    _census_compare(
+        name, dict(ENTRY_STATICS[name], verify_chunk=1), mesh,
+        "AUD002", "verify_chunk=1 (chunking must add zero "
+        "collectives per chunk)")
+    # pod-mesh census (AUD011, ISSUE 15): the global-SPMD serve entry
+    # traced over a (slice=hosts, data, val) POD mesh must carry the
+    # exact census of the flat mesh — the slice axis is the
+    # cross-host (DCN) dimension and parallel/sharded.py's layout
+    # promises it carries ZERO collectives, so the cross-host psum
+    # count is pinned AT zero the same way the single-host counts are
+    # pinned by the baseline.  A psum riding the slice axis is a
+    # per-step DCN round-trip — the class of silent regression that
+    # only surfaces as a wedged pod round.
+    _census_compare(
+        name, dict(ENTRY_STATICS[name]), _pod_audit_mesh(),
+        "AUD011", "the pod (slice=hosts) mesh (a collective is "
+        "riding the cross-host slice axis; instance DP never "
+        "communicates across hosts)")
     return AuditReport(findings=findings, entries=reports,
                        skipped=skipped)

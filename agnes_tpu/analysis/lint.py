@@ -62,6 +62,17 @@ HOT_PATHS: Dict[str, Set[str]] = {
     "agnes_tpu/harness/device_driver.py": {
         "step_async",
     },
+    # ISSUE 15: the multi-host serve plane's between-dispatch code —
+    # the pod front door's screen/rebase, the lifted dispatch
+    # closures, and the local-block output views all run while a pod
+    # step is in flight on every host
+    "agnes_tpu/distributed/shard.py": {
+        "submit", "submit_local", "pump",
+    },
+    "agnes_tpu/distributed/driver.py": {
+        "_lift", "_dense_dispatch_fn", "_make_sharded_seq",
+        "step_async", "_agree", "_plan_sig",
+    },
 }
 
 #: static argnames across the registered entries (device/registry.py);

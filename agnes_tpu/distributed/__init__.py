@@ -1,0 +1,59 @@
+"""Multi-host distributed serve: pod-scale drivers over
+jax.distributed (ISSUE 15).
+
+Layout:
+  topology.py  jax-free sharding math, decision codec, liveness
+  pod.py       lockstep agree/barrier + byte-frame allgather
+  driver.py    DistributedDriver (global-SPMD dispatch, local views)
+  shard.py     HostShard (per-host serve front-end)
+  smoke.py     spawnable worker + pod spawner (CI / bench / tests)
+
+Imports are LAZY for every jax-bearing member (the serve/__init__
+pattern): the topology layer, the admission path and the CLIs stay
+importable with no backend.
+"""
+
+from agnes_tpu.distributed.topology import (  # noqa: F401 (jax-free)
+    DeadHostError,
+    HostPlan,
+    PodConfigError,
+    PodDecision,
+    StragglerMonitor,
+    frame_capacity_bytes,
+    pack_decision_frame,
+    rebase_wire_instances,
+    unpack_decision_frame,
+    unpack_decision_frames,
+)
+
+_LAZY = {
+    "PodCoordinator": ("agnes_tpu.distributed.pod", "PodCoordinator"),
+    "PodDivergenceError": ("agnes_tpu.distributed.pod",
+                           "PodDivergenceError"),
+    "DistributedDriver": ("agnes_tpu.distributed.driver",
+                          "DistributedDriver"),
+    "initialize_pod": ("agnes_tpu.distributed.pod",
+                       "initialize_pod"),
+    "make_pod_mesh": ("agnes_tpu.distributed.driver", "make_pod_mesh"),
+    "fetch_local_block": ("agnes_tpu.distributed.driver",
+                          "fetch_local_block"),
+    "HostShard": ("agnes_tpu.distributed.shard", "HostShard"),
+    "spawn_pod": ("agnes_tpu.distributed.smoke", "spawn_pod"),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(entry[0]), entry[1])
+
+
+__all__ = [
+    "DeadHostError", "HostPlan", "PodConfigError", "PodDecision",
+    "StragglerMonitor", "frame_capacity_bytes", "pack_decision_frame",
+    "rebase_wire_instances", "unpack_decision_frame",
+    "unpack_decision_frames", *_LAZY,
+]

@@ -1,0 +1,422 @@
+"""DistributedDriver: the fused signed step as a pod-wide global-SPMD
+dispatch over ``jax.distributed`` (ISSUE 15 tentpole).
+
+One process per host.  ``initialize_pod`` brings up the coordination
+service (and, on CPU, the gloo collectives backend — XLA:CPU's default
+client refuses multi-process computations, which is why the 2-process
+CI smoke ever works at all); ``make_pod_mesh`` builds ONE global mesh
+over (hosts x local devices) with hosts on the OUTER slice axis of
+parallel/mesh.py — DCN-crossing, and by the sharded layout's design
+carrying ZERO collectives: the tally's quorum psums stay on the
+intra-host val axis, so a pod step communicates exactly as much
+across hosts as a single-host step does (nothing).
+
+The driver subclasses DeviceDriver with ``I = the host's instance
+slice``: the per-host serve plane (admission, batching, densify)
+builds everything at LOCAL shape exactly as a single-host deployment
+would, and this class lifts the host-local arrays into global jax
+Arrays at the dispatch boundary (``jax.make_array_from_process_local_
+data`` against the SAME PartitionSpec trees the shard_map wrappers
+use — parallel/sharded.seq_in_specs/dense_lane_specs, one source of
+truth).  Outputs come back as global arrays; the driver reads ONLY
+its addressable block (``fetch_local_block``), so stats, decisions
+and reject settlement stay host-local and fetch-free across hosts.
+
+Lockstep: a pod dispatch is a pod-wide program — every host must
+launch the same entries in the same order.  With a PodCoordinator
+attached, every dispatch first ``agree()``s on a digest of its plan
+(entry, statics, local signature); divergence fails loudly on every
+host instead of wedging the fabric (distributed/pod.py docstring).
+
+step()/step_seq()/the canned offline scenarios are deliberately
+NotImplemented here: the pod driver exists for the serve plane's
+``step_async`` path (the offline differential runs single-process —
+that's the acceptance bar it is compared against).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from agnes_tpu.distributed.topology import HostPlan
+from agnes_tpu.harness.device_driver import DeviceDriver
+
+
+from agnes_tpu.distributed.pod import initialize_pod  # noqa: F401
+#                      ^ re-export: lives in pod.py (the light module
+#                        a worker can import BEFORE the backend pins)
+
+
+def make_pod_mesh(n_val: int = 1, devices=None):
+    """The pod's ONE global mesh: (slice=n_hosts, data=local/n_val,
+    val=n_val) with hosts on the slice axis.  Requires jax's global
+    device enumeration to be host-major (it is: devices sort by
+    process index first) — asserted, because an interleaved grid
+    would silently scatter each host's instance block across the pod
+    and every "local" fetch would be wrong."""
+    import jax
+
+    from agnes_tpu.parallel.mesh import make_hierarchical_mesh
+
+    devs = list(jax.devices()) if devices is None else list(devices)
+    n_hosts = jax.process_count()
+    if len(devs) % n_hosts:
+        raise ValueError(f"{len(devs)} devices do not split over "
+                         f"{n_hosts} hosts")
+    per_host = len(devs) // n_hosts
+    for k, d in enumerate(devs):
+        if d.process_index != k // per_host:
+            raise ValueError(
+                "device enumeration is not host-major: device "
+                f"{k} belongs to process {d.process_index}, expected "
+                f"{k // per_host} — build the mesh from an explicitly "
+                f"grouped device list")
+    if per_host % n_val:
+        raise ValueError(f"{per_host} local devices do not split into "
+                         f"val={n_val}")
+    return make_hierarchical_mesh(n_hosts, per_host // n_val, n_val,
+                                  devs)
+
+
+def _shifted_slices(index, offsets, global_shape):
+    """A global shard `index` (tuple of slices, Nones = whole dim)
+    rebased into a local block that starts at `offsets` — THE one
+    place the contiguous-host-block layout arithmetic lives, shared
+    by the output fetch and the dispatch lift so the two can never
+    disagree about where a host's block sits in the global array."""
+    return tuple(
+        slice((ix.start or 0) - off,
+              (ix.stop if ix.stop is not None else dim) - off)
+        for ix, off, dim in zip(index, offsets, global_shape))
+
+
+def fetch_local_block(x) -> np.ndarray:
+    """This process's addressable block of a (possibly global) array,
+    as numpy.  Fully-addressable arrays (single-host pods, host
+    numpy) fetch whole; multi-host arrays assemble the host's
+    contiguous region from its addressable shards (replicated shards
+    overlap-write identical bytes — harmless)."""
+    if not hasattr(x, "addressable_shards") or \
+            getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)  # lint: allow (host/local fetch by contract)
+    shards = list(x.addressable_shards)
+    ndim = x.ndim
+    lo = [min((s.index[a].start or 0) for s in shards)
+          for a in range(ndim)]
+    hi = [max((s.index[a].stop if s.index[a].stop is not None
+               else x.shape[a]) for s in shards) for a in range(ndim)]
+    out = np.empty([h - l for l, h in zip(lo, hi)], x.dtype)
+    for s in shards:
+        sel = _shifted_slices(s.index, lo, x.shape)
+        out[sel] = np.asarray(s.data)  # lint: allow (addressable shard)
+    return out
+
+
+class _LocalRejects:
+    """Lazy view of a pod dispatch's [global_I] rejected-lane count
+    that materializes only THIS host's block — the serve pipeline's
+    dedup-cache gate does ``np.asarray(rejects).sum()`` at settle,
+    and a host's cache holds only digests of its own admitted lanes,
+    so the local block is exactly the verdict that gates them."""
+
+    def __init__(self, global_counts):
+        self._x = global_counts
+
+    def __array__(self, dtype=None, copy=None):
+        block = fetch_local_block(self._x)
+        return block.astype(dtype) if dtype is not None else block
+
+
+class DistributedDriver(DeviceDriver):
+    """DeviceDriver lifted to a (hosts x local devices) pod (module
+    docstring).  `n_instances` is the GLOBAL deployment figure; the
+    instance block this host owns (`HostPlan`) becomes `self.I`, so
+    the whole serve plane composes unchanged at host-local shape."""
+
+    def __init__(self, n_instances: int, n_validators: int,
+                 n_rounds: int = 4, n_slots: int = 4,
+                 proposer_is_self: bool = True,
+                 advance_height: bool = False,
+                 defer_collect: bool = False,
+                 verify_chunk=None, hbm_budget_bytes: int = None,
+                 audit: bool = False,
+                 n_val: int = 1, mesh=None,
+                 coordinator=None, lockstep_check: bool = True):
+        import jax
+
+        from agnes_tpu.parallel import sharded as _sh
+
+        self.n_hosts = jax.process_count()
+        self.process_index = jax.process_index()
+        self.plan = HostPlan(self.n_hosts, n_instances)
+        self.global_I = int(n_instances)
+        self.coordinator = coordinator
+        self.lockstep_check = bool(lockstep_check)
+        pod_mesh = mesh if mesh is not None else make_pod_mesh(n_val)
+        if n_validators % n_val:
+            raise ValueError(f"V={n_validators} does not shard over "
+                             f"val={n_val}")
+        # build everything host-LOCAL through the parent (mesh=None so
+        # its single-device placement path never device_puts onto a
+        # non-addressable sharding), then lift state onto the pod
+        super().__init__(self.plan.local_instances, n_validators,
+                         n_rounds=n_rounds, n_slots=n_slots,
+                         proposer_is_self=proposer_is_self,
+                         advance_height=advance_height,
+                         defer_collect=defer_collect,
+                         verify_chunk=verify_chunk,
+                         hbm_budget_bytes=hbm_budget_bytes,
+                         audit=audit, mesh=None)
+        self.mesh = pod_mesh
+        self._sh = _sh
+        self._seq_specs = _sh.seq_in_specs(pod_mesh)
+        self._dense_specs = _sh.dense_lane_specs(pod_mesh)
+        self._sharded_signed_cache = {}
+        self._seq_fn_cache = {}
+        self._copy_fn = None
+        # replicated-over-hosts operands stay HOST numpy: jit shards an
+        # uncommitted array per the in_specs, and numpy is the one form
+        # that is never committed to a wrong (single-device) sharding
+        self.powers = np.ones((self.V,), np.int32)
+        self.total = np.asarray(self.V, np.int32)
+        # instance-dim operands lift: each host contributes its block
+        self.proposer_flag = self._lift(
+            np.full((self.I, n_rounds), proposer_is_self, bool),
+            self._seq_specs[6])
+        self.propose_value = self._lift(np.full((self.I,), 1, np.int32),
+                                        self._seq_specs[7])
+        self.state = self._lift_tree(
+            jax.tree.map(np.asarray, self.state), self._seq_specs[0])
+        self.tally = self._lift_tree(
+            jax.tree.map(np.asarray, self.tally), self._seq_specs[1])
+
+    # -- global-array plumbing -----------------------------------------------
+
+    def _global_shape(self, local_shape, spec) -> Tuple[int, ...]:
+        """Local block shape -> global shape: only the slice axis
+        crosses processes, so a dim sharded on it scales by
+        n_hosts."""
+        from agnes_tpu.parallel.mesh import SLICE_AXIS
+
+        return tuple(
+            dim * (self.n_hosts
+                   if SLICE_AXIS in self._spec_dim_axes(spec, a)
+                   else 1)
+            for a, dim in enumerate(local_shape))
+
+    def _lift(self, local, spec):
+        """Host-local block -> global jax Array on the pod mesh.
+
+        Two paths, chosen by where the block lives: HOST (numpy)
+        blocks assemble via make_array_from_process_local_data;
+        DEVICE-RESIDENT blocks (the serve plane's freshly built
+        phases/lanes are jnp arrays) scatter per-device pieces with
+        local device_puts + make_array_from_single_device_arrays —
+        never a device->host fetch, because this runs per dispatch on
+        the pod hot path and on real hardware np.asarray here would
+        be a blocking HBM round trip of the very tensors the host
+        just uploaded."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, spec)
+        if self.n_hosts == 1:
+            return jax.device_put(local, sharding)
+        if not isinstance(local, jax.Array):
+            local = np.asarray(local)  # lint: allow (host-built block by contract)
+            return jax.make_array_from_process_local_data(
+                sharding, local, self._global_shape(local.shape,
+                                                    spec))
+        gshape = self._global_shape(local.shape, spec)
+        offs = self._host_offsets(local.shape, spec)
+        pieces = []
+        for dev, idx in sharding.addressable_devices_indices_map(
+                gshape).items():
+            sel = _shifted_slices(idx, offs, gshape)
+            pieces.append(jax.device_put(local[sel], dev))
+        return jax.make_array_from_single_device_arrays(
+            gshape, sharding, pieces)
+
+    @staticmethod
+    def _spec_dim_axes(spec, a):
+        """The mesh-axis set sharding dim `a` of `spec`, normalized
+        to a tuple (shared by _global_shape/_host_offsets so the
+        slice-axis test can never diverge between them)."""
+        spec_t = tuple(spec)
+        axes = spec_t[a] if a < len(spec_t) else None
+        return (axes,) if isinstance(axes, str) else (axes or ())
+
+    def _host_offsets(self, local_shape, spec):
+        """Per-dim global offset of this host's block (nonzero only
+        on slice-sharded dims — the instance axes)."""
+        from agnes_tpu.parallel.mesh import SLICE_AXIS
+
+        return [dim * self.process_index
+                if SLICE_AXIS in self._spec_dim_axes(spec, a) else 0
+                for a, dim in enumerate(local_shape)]
+
+    def _lift_tree(self, tree, spec_tree):
+        import jax
+        from jax.sharding import PartitionSpec
+
+        return jax.tree.map(self._lift, tree, spec_tree,
+                            is_leaf=lambda x: isinstance(
+                                x, PartitionSpec))
+
+    def _agree(self, entry: str, statics, sig) -> None:
+        """Pre-dispatch lockstep check (module docstring): digest the
+        plan every host is about to launch; mismatch fails loudly on
+        every host (PodDivergenceError)."""
+        if (self.coordinator is not None and self.lockstep_check
+                and self.n_hosts > 1):
+            self.coordinator.agree((entry, tuple(statics), sig))
+
+    def _plan_sig(self, args) -> tuple:
+        """Cheap shape/dtype tag of the LOCAL args (identical across
+        hosts iff the hosts' builds agree — local slices are
+        same-shaped by the HostPlan's even split)."""
+        import jax
+
+        return tuple((tuple(getattr(x, "shape", ())),
+                      str(getattr(x, "dtype", type(x).__name__)))
+                     for x in jax.tree_util.tree_leaves(args))
+
+    # -- dispatch (the step_async surface) -----------------------------------
+
+    def _dense_dispatch_fn(self, n_dense_phases: int, donate: bool):
+        from agnes_tpu.device import registry as _registry
+
+        chunk = self._resolve_dense_chunk(n_dense_phases)
+        key = (chunk, bool(donate))
+        if key not in self._sharded_signed_cache:
+            self._sharded_signed_cache[key] = \
+                self._sh.make_sharded_step_seq_signed(
+                    self.mesh, advance_height=self.advance_height,
+                    verify_chunk=chunk, donate=donate)
+        fn = self._sharded_signed_cache[key]
+
+        def dispatch(st, ta, ex, ph, dn):
+            largs = (st, ta, ex, ph, dn, self.powers, self.total,
+                     self.proposer_flag, self.propose_value)
+            self._observe("sharded_step_seq_signed", largs,
+                          (self.advance_height, chunk, donate))
+            self._agree("sharded_step_seq_signed",
+                        (self.advance_height, chunk, donate),
+                        self._plan_sig((ex, ph, dn)))
+            ex_g = self._lift_tree(ex, self._seq_specs[2])
+            ph_g = self._lift_tree(ph, self._seq_specs[3])
+            dn_g = self._lift_tree(dn, self._dense_specs)
+            return _registry.timed_call(
+                "sharded_step_seq_signed", fn, st, ta, ex_g, ph_g,
+                dn_g, self.powers, self.total, self.proposer_flag,
+                self.propose_value)
+
+        return dispatch
+
+    def _make_sharded_seq(self, mesh, advance_height: bool = False,
+                          donate: bool = False):
+        """The unsigned sharded sequence entry (pre-verified/unsigned
+        builds), lifted the same way.  Bound-method override of the
+        attribute the parent's mesh branch installs."""
+        key = (bool(advance_height), bool(donate))
+        if key not in self._seq_fn_cache:
+            self._seq_fn_cache[key] = self._sh.make_sharded_step_seq(
+                mesh, advance_height=advance_height, donate=donate)
+        fn = self._seq_fn_cache[key]
+
+        def call(st, ta, ex, ph, powers, total, prop, pv):
+            self._agree("sharded_step_seq",
+                        (advance_height, donate),
+                        self._plan_sig((ex, ph)))
+            ex_g = self._lift_tree(ex, self._seq_specs[2])
+            ph_g = self._lift_tree(ph, self._seq_specs[3])
+            return fn(st, ta, ex_g, ph_g, powers, total, prop, pv)
+
+        return call
+
+    # -- local views of global outputs ---------------------------------------
+
+    def step_async(self, phases, lanes=None, exts=None,
+                   donate: bool = True, tick: Optional[int] = None):
+        msgs = super().step_async(phases, lanes, exts, donate=donate,
+                                  tick=tick)
+        if self.last_step_rejects is not None:
+            # the serve pipeline's settle gate reads this with
+            # np.asarray — hand it a lazily-local view (class doc)
+            self.last_step_rejects = _LocalRejects(
+                self.last_step_rejects)
+        return msgs
+
+    def _collect(self, msgs) -> None:
+        import jax
+
+        super()._collect(jax.tree.map(fetch_local_block, msgs))
+
+    def _settle_rejects(self) -> None:
+        rejects, self._pending_rejects = self._pending_rejects, []
+        for r in rejects:
+            n = int(np.asarray(r).sum() if isinstance(r, _LocalRejects)
+                    else fetch_local_block(r).sum())
+            self.rejected_signature_device += n
+            self.stats.votes_ingested -= n
+
+    def _local_shape(self):
+        from agnes_tpu.utils.budget import mesh_local_shape
+
+        # self.I is already the per-HOST slice: divide only by the
+        # data extent this host owns (the ISSUE 15 satellite fix)
+        return mesh_local_shape(self.mesh, self.I, self.V,
+                                n_hosts=self.n_hosts)
+
+    def state_copies(self):
+        """Warmup's throwaway state/tally copies, as a jitted pod
+        computation: an EAGER per-leaf .copy() on a multi-host array
+        is an unsupported eager op, and warmup runs at the same point
+        on every host, so a jitted copy is both legal and lockstep."""
+        if self.n_hosts == 1:
+            return super().state_copies()
+        import jax
+
+        if self._copy_fn is None:
+            self._copy_fn = jax.jit(
+                lambda s, t: jax.tree.map(lambda x: x.copy(), (s, t)))
+        return self._copy_fn(self.state, self.tally)
+
+    def set_validators(self, powers) -> None:
+        pw = np.asarray(powers)
+        if pw.shape != (self.V,):
+            raise ValueError(f"powers must be [{self.V}], got "
+                             f"{pw.shape}")
+        self.powers = pw.astype(np.int32)
+        self.total = np.asarray(int(pw.sum()), np.int32)
+
+    def set_proposer_table(self, flags, rotation_period: int) -> None:
+        raise NotImplementedError(
+            "proposer tables on a pod driver: lift flags per host "
+            "(not yet wired — the serve plane uses the constant "
+            "default)")
+
+    # -- offline surfaces: single-process only -------------------------------
+
+    def _pod_only(self, what: str):
+        raise NotImplementedError(
+            f"{what} is a single-process surface; the pod driver "
+            f"serves through step_async (module docstring)")
+
+    def step(self, ext=None, phase=None):
+        self._pod_only("step()")
+
+    def step_seq(self, phases, exts=None):
+        self._pod_only("step_seq()")
+
+    def step_seq_signed(self, phases, lanes, exts=None):
+        self._pod_only("step_seq_signed()")
+
+    def step_seq_signed_dense(self, phases, dense, exts=None):
+        self._pod_only("step_seq_signed_dense()")
+
+    def run_heights_fused(self, n_heights: int, slot: int = 1,
+                          frac: float = 1.0):
+        self._pod_only("run_heights_fused()")

@@ -1,0 +1,163 @@
+"""Pod coordination: lockstep agreement, barriers, and the byte-frame
+allgather transport (ISSUE 15 tentpole, coordination layer).
+
+Global-SPMD serving has ONE hard protocol rule: every host must launch
+the SAME pod computations in the SAME order (a dispatch is a pod-wide
+program — on real hardware a host sitting one out wedges the ICI
+collective, and a host launching a DIFFERENT shape wedges it with a
+mismatched executable).  The per-host serve fronts make that a traffic
+property, so this layer turns a violation into a loud, dated failure
+instead of a silent pod-wide hang:
+
+* **agree(tag)** — before every pod dispatch each host contributes a
+  digest of its dispatch plan (entry name, statics, local arg
+  signature) to a tiny fixed-size allgather; any mismatch raises
+  PodDivergenceError ON EVERY HOST naming who diverged.  Because all
+  hosts run the same code path, the check itself stays in lockstep:
+  when plans diverge, both sides are sitting in the SAME agree call
+  when it fails — the check can never deadlock the pod worse than
+  the divergence it just caught.
+* **barrier(name, payload)** — agree() with rendezvous semantics: the
+  multi-process warmup brackets itself in barriers whose payload is a
+  digest of the warmup PLAN (entries × rungs × shapes), so "every
+  host warms the identical set" is checked, not hoped.
+* **allgather_bytes(frame)** — the decision-gather transport: each
+  host contributes one fixed-size uint8 frame, gets [n_hosts, len]
+  back (process-index-major).  Rides
+  jax.experimental.multihost_utils.process_allgather, i.e. the same
+  device fabric as the steps — no second network stack.
+
+A pod of ONE degenerates to no-ops (agree/barrier trivially pass,
+allgather returns the caller's frame), so every consumer is testable
+single-process with zero collectives.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+from agnes_tpu.distributed.topology import StragglerMonitor
+
+#: digest frame bytes (blake2b-16 — collision strength is irrelevant,
+#: the check is against honest config/traffic drift, not an adversary)
+DIGEST_BYTES = 16
+
+
+def initialize_pod(coordinator_address: str, num_processes: int,
+                   process_id: int):
+    """Bring up jax.distributed for this process and return
+    (process_index, process_count).  MUST run before ANY backend use
+    — the first jit/devices()/default_backend() call pins the client
+    and jax.distributed then refuses to initialize; heavyweight agnes
+    imports count too (device/step and the crypto modules build
+    device constants at import), which is why this lives HERE in the
+    light coordination module and not beside DistributedDriver: a
+    worker imports pod.py, initializes, and only then imports the
+    serve stack (distributed/smoke.py is the reference ordering).
+    On CPU the collectives implementation is forced to gloo — without
+    it every cross-process computation dies with "Multiprocess
+    computations aren't implemented on the CPU backend", the failure
+    mode the 2-process CI smoke exists to keep caught."""
+    import os
+
+    import jax
+
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # noqa: BLE001 — older jaxlib: surface the
+            pass           # real capability error at first dispatch
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id)
+    return jax.process_index(), jax.process_count()
+
+
+class PodDivergenceError(RuntimeError):
+    """Hosts disagreed on a pod-wide dispatch plan or barrier."""
+
+
+def plan_digest(tag) -> bytes:
+    """Stable digest of a (nested, repr-able) dispatch-plan tag."""
+    return hashlib.blake2b(repr(tag).encode(),
+                           digest_size=DIGEST_BYTES).digest()
+
+
+class PodCoordinator:
+    """Lockstep/gather primitives over process_allgather (module
+    docstring).  Constructed AFTER jax.distributed is initialized;
+    `monitor` (topology.StragglerMonitor) is beaten on every completed
+    collective — an allgather that returned IS a pod-wide liveness
+    proof; `flightrec` gets one event per divergence so the heartbeat
+    trail dates a wedge's cause."""
+
+    def __init__(self, n_hosts: Optional[int] = None,
+                 host: Optional[int] = None,
+                 monitor: Optional[StragglerMonitor] = None,
+                 flightrec=None):
+        if n_hosts is None or host is None:
+            import jax
+
+            n_hosts = jax.process_count() if n_hosts is None else n_hosts
+            host = jax.process_index() if host is None else host
+        self.n_hosts = int(n_hosts)
+        self.host = int(host)
+        self.monitor = monitor
+        self.flightrec = flightrec
+        self.agrees = 0
+        self.barriers = 0
+        self.gathered_frames = 0
+
+    # -- transport -----------------------------------------------------------
+
+    def allgather_bytes(self, frame: np.ndarray) -> np.ndarray:
+        """One fixed-size uint8 frame per host -> [n_hosts, len]
+        (process-index order).  Every host MUST call with the same
+        frame length — that is the lockstep contract this class
+        exists to police, and process_allgather enforces it at the
+        device level."""
+        frame = np.ascontiguousarray(frame, np.uint8)
+        if self.n_hosts == 1:
+            out = frame[None]
+        else:
+            from jax.experimental import multihost_utils
+
+            out = np.asarray(
+                multihost_utils.process_allgather(frame), np.uint8)
+        self.gathered_frames += 1
+        if self.monitor is not None:
+            self.monitor.beat(None)     # completed == everybody live
+        return out
+
+    # -- lockstep ------------------------------------------------------------
+
+    def agree(self, tag, kind: str = "dispatch") -> bytes:
+        """All-hosts digest compare of `tag`; raises
+        PodDivergenceError on mismatch (module docstring).  Returns
+        the agreed digest."""
+        mine = plan_digest(tag)
+        if self.n_hosts > 1:
+            frames = self.allgather_bytes(
+                np.frombuffer(mine, np.uint8))
+            digests = [bytes(row.tobytes()) for row in frames]
+            bad = [h for h, d in enumerate(digests) if d != mine]
+            if bad:
+                if self.flightrec is not None:
+                    self.flightrec.event("pod_divergence", kind=kind,
+                                         host=self.host, differing=bad)
+                raise PodDivergenceError(
+                    f"{kind} plan diverged across the pod: host "
+                    f"{self.host} disagrees with host(s) {bad} "
+                    f"(local tag: {tag!r}) — a global-SPMD dispatch "
+                    f"with mismatched plans would wedge the pod; "
+                    f"failing loudly instead")
+        self.agrees += 1
+        return mine
+
+    def barrier(self, name: str, payload=()) -> None:
+        """Rendezvous + payload-digest compare (module docstring)."""
+        self.agree((name, payload), kind=f"barrier:{name}")
+        self.barriers += 1

@@ -1,0 +1,233 @@
+"""HostShard: the per-host serve front-end of the pod (ISSUE 15).
+
+One HostShard per process, wrapping a REAL VoteService built over the
+DistributedDriver at host-local shape — its own admission queue
+(native front-end eligible: ``native_admission=True`` flows straight
+through), its own inbox-fed threaded host if the caller wraps it, its
+own dedup cache / BLS class table, flight recorder and metrics — and
+adding exactly the pod-facing parts a single-host service doesn't
+have:
+
+* **Instance-range screening** (the front door): gossip traffic
+  carries GLOBAL instance ids; ``submit`` drops records outside this
+  host's block (counted ``pod_foreign``), rebases the survivors'
+  instance field IN the 96-byte wire layout
+  (topology.shift_instances_inplace on the one survivor copy — no
+  unpack/repack round trip) and
+  feeds the local VoteService, whose queue then screens/fairness-caps
+  the local range exactly as a single-host deployment would.
+* **Barrier-synchronized warmup**: every host warms the identical
+  (entry, rung) set — the warmup PLAN is digest-compared at a pod
+  barrier before and after, and each host's retrace sentinel arms its
+  own no-recompile invariant, so an off-ladder dispatch on ANY host
+  fails loudly (that host's RetraceError) and a mismatched PLAN fails
+  every host (PodDivergenceError).
+* **Per-tick decision gather**: newly latched local decisions ride
+  the existing 96-byte wire ABI in fixed-size frames through one
+  allgather (topology codec + pod transport), so every host holds the
+  pod-wide decision view.
+* **Fail-closed liveness**: a StragglerMonitor fed by completed
+  collectives (and, when co-located, peer heartbeat files) gates
+  every pod collective; a peer past the dead age raises DeadHostError
+  BEFORE this host walks into an allgather that can never complete,
+  and ``drain`` degrades to a local-only drain with the failure
+  recorded in the report.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from agnes_tpu.distributed.pod import PodCoordinator
+from agnes_tpu.distributed.topology import (
+    DeadHostError,
+    PodDecision,
+    StragglerMonitor,
+    pack_decision_frame,
+    unpack_decision_frames,
+)
+from agnes_tpu.serve.queue import AdmitResult
+from agnes_tpu.utils.metrics import POD_FOREIGN_REJECTS  # noqa: F401
+#     ^ the front-door screen counter (well-known name, ISSUE 15)
+
+
+class HostShard:
+    """Per-host serve front-end (module docstring).  `driver` must be
+    a DistributedDriver; `service_kwargs` forward to VoteService
+    (dedup_cache, bls_lane, native_admission, metrics, flightrec,
+    window_predictor, target_votes ... — the full single-host
+    surface)."""
+
+    def __init__(self, driver, batcher, pubkeys=None, *,
+                 coordinator: Optional[PodCoordinator] = None,
+                 monitor: Optional[StragglerMonitor] = None,
+                 dead_after_s: float = 60.0,
+                 straggler_after_s: float = 10.0,
+                 clock=time.monotonic,
+                 **service_kwargs):
+        from agnes_tpu.serve import VoteService
+
+        self.driver = driver
+        self.plan = driver.plan
+        self.host = driver.process_index
+        self.n_hosts = driver.n_hosts
+        self.monitor = monitor if monitor is not None else \
+            StragglerMonitor(self.n_hosts, self.host,
+                             dead_after_s=dead_after_s,
+                             straggler_after_s=straggler_after_s,
+                             clock=clock)
+        self.coordinator = coordinator if coordinator is not None else \
+            PodCoordinator(self.n_hosts, self.host,
+                           monitor=self.monitor,
+                           flightrec=service_kwargs.get("flightrec"))
+        if self.coordinator.monitor is None:
+            self.coordinator.monitor = self.monitor
+        # the driver's per-dispatch lockstep agree() rides the same
+        # coordinator (one collective ordering domain for the pod)
+        driver.coordinator = self.coordinator
+        self.service = VoteService(driver, batcher, pubkeys,
+                                   clock=clock, **service_kwargs)
+        self.lo, self.hi = self.plan.instance_range(self.host)
+        self._frame_cap = self.plan.local_instances
+        self.foreign_rejects = 0
+        self.pod_decisions: List[PodDecision] = []
+        self._gather_failed: Optional[str] = None
+
+    # -- ingress: the pod front door -----------------------------------------
+
+    def submit(self, wire_bytes) -> AdmitResult:
+        """Admit pod-wide gossip: screen to this host's instance
+        block, rebase ids onto the local service, count the foreign
+        remainder (module docstring).  One parse, one survivor copy:
+        the fancy-indexed `rec[mine]` IS the kept copy, rebased in
+        place before the single serialization."""
+        buf = np.frombuffer(bytes(wire_bytes), np.uint8)
+        from agnes_tpu.bridge.native_ingest import REC_SIZE
+        from agnes_tpu.distributed.topology import (
+            shift_instances_inplace,
+            wire_instance_ids,
+        )
+
+        n = len(buf) // REC_SIZE
+        tail = buf[n * REC_SIZE:]
+        if n:
+            rec = buf[:n * REC_SIZE].reshape(n, REC_SIZE)
+            inst = wire_instance_ids(rec)
+            mine = (inst >= self.lo) & (inst < self.hi)
+            foreign = int(n - mine.sum())
+            kept = rec[mine]                 # fancy index = new copy
+            shift_instances_inplace(kept, -self.lo)
+            local_wire = kept.tobytes() + tail.tobytes()
+        else:
+            foreign = 0
+            local_wire = tail.tobytes()
+        self.foreign_rejects += foreign
+        if foreign:
+            self.service.metrics.count(POD_FOREIGN_REJECTS, foreign)
+        return self.service.submit(local_wire)
+
+    def submit_local(self, wire_bytes) -> AdmitResult:
+        """Admit traffic already in LOCAL instance ids (a router that
+        pre-shards by host skips the screen)."""
+        return self.service.submit(wire_bytes)
+
+    # -- lifecycle (delegates + pod semantics) -------------------------------
+
+    def warmup(self, n_phases=(2, 3), arm: bool = True) -> int:
+        """Barrier-synchronized pod warmup (module docstring)."""
+        lad = self.service.pipeline.ladder
+        plan = ("warmup", tuple(n_phases), self.driver.I,
+                self.driver.V, self.driver.global_I, lad.rungs,
+                lad.bls_rungs, lad.bls_class_rungs,
+                self.service.pipeline.dense, bool(arm))
+        self.coordinator.barrier("warmup_enter", plan)
+        warmed = self.service.pipeline.warmup(n_phases, arm=arm)
+        self.coordinator.barrier("warmup_exit", ("warmed", warmed))
+        return warmed
+
+    def pump(self, now: Optional[float] = None) -> dict:
+        return self.service.pump(now)
+
+    def poll_decisions(self):
+        """LOCAL decisions only (no collective — safe at any cadence
+        on any host)."""
+        return self.service.poll_decisions()
+
+    def poll_pod_decisions(self) -> List[PodDecision]:
+        """Local poll + pod-wide decision gather (ONE allgather; all
+        hosts must call in lockstep).  Returns the NEW pod-wide
+        decisions this gather surfaced; `pod_decisions` accumulates
+        them.  Fails closed on a dead peer (module docstring)."""
+        self.monitor.check()
+        local = self.service.poll_decisions()
+        inst = self.plan.to_global(
+            self.host, np.asarray([d.instance for d in local],
+                                  np.int64))
+        # height stamp: the instance's first-advance height (exactly
+        # its latched first decision's height — pipeline bookkeeping);
+        # an instance polled before its window ever advanced is still
+        # ON its decided height, so the live height is the fallback
+        fah = self.service.pipeline.first_advance_height
+        hts = np.asarray(
+            [fah.get(d.instance,
+                     int(self.service.batcher.heights[d.instance]))
+             for d in local], np.int64)
+        frame = pack_decision_frame(
+            self.host, inst,
+            np.asarray([(d.value_id if d.value_id is not None else -1)
+                        for d in local], np.int64),
+            np.asarray([d.round for d in local], np.int64),
+            hts, self._frame_cap)
+        frames = self.coordinator.allgather_bytes(frame)
+        new = unpack_decision_frames(frames)
+        self.pod_decisions.extend(new)
+        return new
+
+    def drain(self, gather: bool = True) -> dict:
+        """Drain the local slice and (lockstep) run one final
+        decision gather; a dead peer degrades to local-only drain
+        with the failure in the report — never a hang."""
+        if gather:
+            try:
+                self.monitor.check()
+            except DeadHostError as e:
+                self._gather_failed = str(e)
+                gather = False
+        rep = self.service.drain()
+        final: List[PodDecision] = []
+        if gather:                 # pod-of-1 gathers are local no-ops
+            try:
+                final = self.poll_pod_decisions()
+            except DeadHostError as e:
+                self._gather_failed = str(e)
+        rep["pod"] = {
+            "host_id": self.host,
+            "n_hosts": self.n_hosts,
+            "instance_range": [self.lo, self.hi],
+            "foreign_rejects": self.foreign_rejects,
+            "final_gathered": len(final),
+            "pod_decisions": len(self.pod_decisions),
+            "stragglers": self.monitor.stragglers(),
+            "dead_hosts": self.monitor.dead(),
+            "gather_failed": self._gather_failed,
+            "agrees": self.coordinator.agrees,
+            "barriers": self.coordinator.barriers,
+        }
+        return rep
+
+    # -- passthroughs --------------------------------------------------------
+
+    @property
+    def metrics(self):
+        return self.service.metrics
+
+    @property
+    def pipeline(self):
+        return self.service.pipeline
+
+    @property
+    def queue(self):
+        return self.service.queue

@@ -1,0 +1,504 @@
+"""Spawnable multi-host serve worker + pod spawner (ISSUE 15).
+
+THE one executable the 2-process CPU CI path runs for real — shared
+by the bench probe (`_pipeline_serve_multihost`), the ci.sh gate and
+the slow differential test, so all three exercise the identical
+worker:
+
+  python -m agnes_tpu.distributed.smoke --mode pod --pid 0 \
+      --n-processes 2 --coordinator localhost:PORT ...
+
+Three modes, each dumping a result JSON (and optionally the final
+state/tally as .npz) so a jax-free parent can compare planes
+leaf-for-leaf:
+
+* ``pod``     one pod process: jax.distributed + gloo CPU
+              collectives over faked local devices, DistributedDriver
+              + HostShard height-paced serve, per-host heartbeat,
+              warmup barrier, per-height decision gathers, drain.
+              Dumps this host's LOCAL state/tally block.
+* ``single``  the SAME deployment served by ONE process over the
+              same-shaped (hierarchical) mesh — the single-host mesh
+              serve plane the differential compares against.  Dumps
+              the full global state/tally.
+* ``offline`` the offline fused reference (VoteBatcher dense build ->
+              step_seq_signed_dense on one device) — the third plane
+              of the acceptance differential.
+
+Environment discipline: main() pins XLA_FLAGS (forced host device
+count + the single-threaded-codegen workaround), JAX_PLATFORMS=cpu
+and the in-process config BEFORE any backend init — the same
+two-step tests/conftest.py uses, because this environment's
+sitecustomize forces an axon TPU platform.
+
+``spawn_pod`` is the parent-side helper: picks a coordinator port,
+launches N workers, enforces a wall-clock deadline (SIGKILL on
+breach — a wedged pod must never outlive its budget), and returns
+each worker's parsed result record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+# NOTE: numpy/agnes imports stay inside the run functions — main()
+# must fix the environment before anything can touch a jax backend.
+
+PV, PC = 0, 1                   # VoteType.{PREVOTE,PRECOMMIT} values
+
+
+def _setup_env(devices: int) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags
+                 + f" --xla_force_host_platform_device_count={devices}"
+                 ).strip()
+    if "xla_cpu_parallel_codegen_split_count" not in flags:
+        # the XLA:CPU codegen/serialization race workaround
+        # (utils/compile_cache.py has the post-mortem)
+        flags = (flags
+                 + " --xla_cpu_parallel_codegen_split_count=1").strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _setup_jax():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from agnes_tpu.utils.compile_cache import disable_persistent_cache
+
+    disable_persistent_cache()
+    return jax
+
+
+def _wire_height(I: int, V: int, seeds, h: int) -> bytes:
+    """Both vote classes of one honest pod-wide height (GLOBAL ids)."""
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+    from agnes_tpu.harness.fixtures import full_mesh_cols
+
+    return b"".join(
+        pack_wire_votes(*full_mesh_cols(I, V, seeds, h, typ, 7))
+        for typ in (PV, PC))
+
+
+def _dump_state(npz_path: str, driver, local: bool) -> None:
+    """state/tally (+ decision stats) -> npz.  `local=True` dumps
+    this host's block (distributed/driver.fetch_local_block); the
+    parent concatenates blocks host-major, which IS global instance
+    order because the pod mesh puts hosts on the outer data axis."""
+    import numpy as np
+
+    from agnes_tpu.distributed.driver import fetch_local_block
+
+    fetch = fetch_local_block if local else \
+        (lambda x: np.asarray(x))
+    out = {}
+    for name, leaf in zip(type(driver.state)._fields, driver.state):
+        out[f"state_{name}"] = fetch(leaf)
+    for name, leaf in zip(type(driver.tally)._fields, driver.tally):
+        out[f"tally_{name}"] = fetch(leaf)
+    out["decided"] = driver.stats.decided
+    out["decision_value"] = driver.stats.decision_value
+    out["decision_round"] = driver.stats.decision_round
+    np.savez(npz_path, **out)
+
+
+def _result(path: str, rec: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(rec, f, sort_keys=True)
+        f.write("\n")
+
+
+def run_pod_worker(args) -> dict:
+    """One pod process's serve loop (module docstring).  Import
+    order is load-bearing: jax.distributed must initialize before
+    ANY backend use, and the heavyweight agnes imports (device/step,
+    crypto) build device constants at import — so initialize_pod runs
+    first, against the minimal distributed.driver import (which
+    defers its own serve-stack imports)."""
+    import numpy as np
+
+    _setup_jax()
+    from agnes_tpu.distributed.pod import initialize_pod
+
+    pid, I, V = args.pid, args.instances, args.validators
+    initialize_pod(args.coordinator, args.n_processes, pid)
+    from agnes_tpu.distributed.driver import DistributedDriver
+    from agnes_tpu.distributed.shard import HostShard
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        validator_pubkeys,
+    )
+    from agnes_tpu.serve import ShapeLadder
+    from agnes_tpu.utils.flightrec import FlightRecorder, Heartbeat
+    flightrec = FlightRecorder()
+    hb = None
+    if args.heartbeat:
+        hb = Heartbeat(args.heartbeat, interval_s=args.hb_interval,
+                       recorder=flightrec, host_id=pid).start()
+    d = DistributedDriver(I, V, advance_height=True,
+                          defer_collect=True, audit=True,
+                          n_val=args.n_val)
+    n_local = d.I * V
+    box = {"h": 0}
+    shard = HostShard(
+        d, VoteBatcher(d.I, V, n_slots=4),
+        validator_pubkeys(deterministic_seeds(V)),
+        capacity=4 * 2 * n_local, target_votes=2 * n_local,
+        max_delay_s=1e9,                 # size-closed batches
+        ladder=ShapeLadder.plan_dense(
+            I, V, local_shape=d._local_shape(), n_hosts=d.n_hosts,
+            min_rung=1 << (2 * n_local - 1).bit_length()),
+        window_predictor=lambda: (np.zeros(d.I, np.int64),
+                                  np.full(d.I, box["h"], np.int64)),
+        flightrec=flightrec,
+        native_admission=args.native_admission)
+    if hb is not None:
+        hb.sources.append(lambda: shard.metrics.snapshot(
+            window=True, window_key="heartbeat"))
+    # barrier-synchronized warmup: P=3 (entry + both classes) is the
+    # only shape honest height-paced traffic dispatches; each host's
+    # sentinel then ARMS the no-recompile invariant
+    warmed = shard.warmup(n_phases=(3,), arm=True)
+
+    seeds = deterministic_seeds(V)
+
+    def feed(h: int, wire: bytes, budget_s: float = 3600.0) -> None:
+        box["h"] = h
+        res = shard.submit(wire)
+        if res.accepted != 2 * n_local:
+            raise RuntimeError(
+                f"host {pid} admitted {res.accepted} of the expected "
+                f"{2 * n_local} local records at height {h}: {res}")
+        want = 2 * n_local * (h + 1)
+        t_end = time.monotonic() + budget_s
+        while shard.pipeline.dispatched_votes < want:
+            shard.pump()
+            if time.monotonic() > t_end:
+                raise RuntimeError(
+                    f"host {pid} stalled at height {h}: "
+                    f"{shard.pipeline.dispatched_votes}/{want}")
+
+    # height 0: the (warmed) steady shape's first real traffic
+    feed(0, _wire_height(I, V, seeds, 0))
+    pod0 = shard.poll_pod_decisions()
+    if len(pod0) != I:
+        raise RuntimeError(f"host {pid}: height-0 gather surfaced "
+                           f"{len(pod0)} decisions, expected {I}")
+
+    all_wire = [_wire_height(I, V, seeds, h)
+                for h in range(1, args.heights + 1)]
+    t0 = time.perf_counter()
+    for h in range(1, args.heights + 1):
+        feed(h, all_wire[h - 1])
+    shard.poll_pod_decisions()       # settle + lockstep gather
+    dt = time.perf_counter() - t0
+    rep = shard.drain()
+    if hb is not None:
+        hb.stop()
+    retrace = d.sentinel.metrics.counters.get("retrace_unexpected", 0)
+    if args.state_npz:
+        _dump_state(args.state_npz, d, local=True)
+    from agnes_tpu.device import registry as _registry
+
+    rate = 2 * I * V * args.heights / dt     # pod-wide votes/sec
+    return {
+        "mode": "pod", "host": pid, "n_hosts": d.n_hosts,
+        "devices_per_host": args.devices_per_host,
+        "instances": I, "validators": V, "heights": args.heights,
+        "local_instances": d.I,
+        "votes_per_sec": round(rate, 1),
+        "decisions_total": d.stats.decisions_total,
+        "pod_decisions": len(shard.pod_decisions),
+        "pod_decision_rows": sorted(
+            [pd.instance, pd.host, pd.round,
+             -1 if pd.value_id is None else pd.value_id]
+            for pd in shard.pod_decisions),
+        "foreign_rejects": shard.foreign_rejects,
+        "rejected_signature_device": d.rejected_signature_device,
+        "retrace_unexpected": int(retrace),
+        "warmed_shapes": warmed,
+        "offladder_builds": rep["offladder_builds"],
+        "host_fallback_builds": rep["host_fallback_builds"],
+        "agrees": rep["pod"]["agrees"],
+        "barriers": rep["pod"]["barriers"],
+        "native_admission": bool(args.native_admission),
+        "compile_entries": sorted(_registry.compile_ms()),
+        "heartbeat_path": args.heartbeat or None,
+    }
+
+
+def run_single_worker(args) -> dict:
+    """The single-process mesh serve plane over the SAME global mesh
+    shape (differential plane 2)."""
+    import numpy as np
+
+    _setup_jax()
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        validator_pubkeys,
+    )
+    from agnes_tpu.parallel import make_hierarchical_mesh
+    from agnes_tpu.serve import ShapeLadder, VoteService
+
+    I, V = args.instances, args.validators
+    dph = args.devices_per_host
+    mesh = make_hierarchical_mesh(args.n_processes,
+                                  dph // args.n_val, args.n_val)
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True,
+                     mesh=mesh, audit=True)
+    n = I * V
+    box = {"h": 0}
+    svc = VoteService(
+        d, VoteBatcher(I, V, n_slots=4),
+        validator_pubkeys(deterministic_seeds(V)),
+        capacity=4 * 2 * n, target_votes=2 * n, max_delay_s=1e9,
+        ladder=ShapeLadder.plan_dense(
+            I, V, local_shape=d._local_shape(),
+            min_rung=1 << (2 * n - 1).bit_length()),
+        window_predictor=lambda: (np.zeros(I, np.int64),
+                                  np.full(I, box["h"], np.int64)))
+    svc.pipeline.warmup(n_phases=(3,), arm=True)
+    seeds = deterministic_seeds(V)
+    for h in range(args.heights + 1):
+        box["h"] = h
+        res = svc.submit(_wire_height(I, V, seeds, h))
+        if res.accepted != 2 * n:
+            raise RuntimeError(f"single plane admitted {res.accepted}")
+        t_end = time.monotonic() + 3600
+        while svc.pipeline.dispatched_votes < 2 * n * (h + 1):
+            svc.pump()
+            if time.monotonic() > t_end:
+                raise RuntimeError(f"single plane stalled at {h}")
+    rep = svc.drain()
+    if args.state_npz:
+        _dump_state(args.state_npz, d, local=False)
+    return {
+        "mode": "single", "decisions_total": d.stats.decisions_total,
+        "rejected_signature_device": d.rejected_signature_device,
+        "offladder_builds": rep["offladder_builds"],
+    }
+
+
+def run_offline_worker(args) -> dict:
+    """The offline fused dense reference (differential plane 3)."""
+    import numpy as np
+
+    _setup_jax()
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.harness.device_driver import DeviceDriver
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        full_mesh_cols,
+        validator_pubkeys,
+    )
+
+    I, V = args.instances, args.validators
+    seeds = deterministic_seeds(V)
+    pubkeys = validator_pubkeys(seeds)
+    d = DeviceDriver(I, V, advance_height=True, defer_collect=True)
+    bat = VoteBatcher(I, V, n_slots=4)
+    for h in range(args.heights + 1):
+        bat.sync_device(np.zeros(I, np.int64), np.full(I, h, np.int64))
+        for typ in (PV, PC):
+            bat.add_arrays(*full_mesh_cols(I, V, seeds, h, typ, 7))
+        phases, dense = bat.build_phases_device_dense(pubkeys)
+        if dense is None:
+            raise RuntimeError("offline dense build fell back to host")
+        d.step_seq_signed_dense([d.empty_phase()]
+                                + [p for p, _ in phases], dense)
+    d.block_until_ready()
+    if args.state_npz:
+        _dump_state(args.state_npz, d, local=False)
+    return {
+        "mode": "offline", "decisions_total": d.stats.decisions_total,
+        "rejected_signature_device": d.rejected_signature_device,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m agnes_tpu.distributed.smoke")
+    ap.add_argument("--mode", choices=("pod", "single", "offline"),
+                    required=True)
+    ap.add_argument("--pid", type=int, default=0)
+    ap.add_argument("--n-processes", type=int, default=2)
+    ap.add_argument("--coordinator", default="localhost:0")
+    ap.add_argument("--instances", type=int, default=8)
+    ap.add_argument("--validators", type=int, default=8)
+    ap.add_argument("--heights", type=int, default=2)
+    ap.add_argument("--devices-per-host", type=int, default=2)
+    ap.add_argument("--n-val", type=int, default=2)
+    ap.add_argument("--out", required=True,
+                    help="result JSON path")
+    ap.add_argument("--state-npz", default=None)
+    ap.add_argument("--heartbeat", default=None)
+    ap.add_argument("--hb-interval", type=float, default=1.0)
+    ap.add_argument("--native-admission", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.mode == "pod":
+        _setup_env(args.devices_per_host)
+        run = run_pod_worker
+    elif args.mode == "single":
+        _setup_env(args.n_processes * args.devices_per_host)
+        run = run_single_worker
+    else:
+        _setup_env(1)
+        run = run_offline_worker
+    try:
+        rec = run(args)
+    except BaseException as e:  # noqa: BLE001 — the parent must see a
+        import traceback        # record even when a worker dies
+
+        traceback.print_exc(file=sys.stderr)
+        _result(args.out, {"mode": args.mode, "host": args.pid,
+                           "error": f"{type(e).__name__}: {e}"})
+        return 1
+    _result(args.out, rec)
+    return 0
+
+
+# -- parent-side spawner ------------------------------------------------------
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _repo_root() -> str:
+    import agnes_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(agnes_tpu.__file__)))
+
+
+def _die_with_parent():
+    """Child preexec: SIGKILL on parent death (PR_SET_PDEATHSIG — the
+    bench probe-reaper discipline): a crash-safe parent that emits
+    its sentinel and os._exit()s must never leave a 2-process pod
+    spinning behind it."""
+    try:
+        import ctypes
+        import signal as _sig
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(1, _sig.SIGKILL, 0, 0, 0)   # 1 = PR_SET_PDEATHSIG
+        if os.getppid() == 1:                  # parent already gone
+            os._exit(1)
+    except Exception:  # noqa: BLE001 — non-Linux: spawner deadline
+        pass           # remains the only bound
+
+
+def spawn_pod(n_processes: int = 2, *, instances: int = 8,
+              validators: int = 8, heights: int = 2,
+              devices_per_host: int = 2, n_val: int = 2,
+              out_dir: str, timeout_s: float = 1200.0,
+              heartbeat: bool = False, hb_interval: float = 1.0,
+              dump_state: bool = False,
+              native_admission: bool = False,
+              extra_modes: Optional[List[str]] = None) -> dict:
+    """Launch the pod workers (+ optional `single`/`offline`
+    comparison workers, each its own process — composing with the
+    XLA:CPU child-interpreter discipline) under one wall-clock
+    deadline; SIGKILL everything on breach.  Returns
+    {"pod": [rec per host], "single": rec?, "offline": rec?,
+    "paths": {...}} with every record parsed from its worker's result
+    JSON."""
+    os.makedirs(out_dir, exist_ok=True)
+    port = free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _repo_root() + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env.pop("XLA_FLAGS", None)       # workers pin their own
+    env.pop("JAX_PLATFORMS", None)
+
+    def launch(mode: str, pid: int, tag: str):
+        out = os.path.join(out_dir, f"{tag}.json")
+        cmd = [sys.executable, "-m", "agnes_tpu.distributed.smoke",
+               "--mode", mode, "--pid", str(pid),
+               "--n-processes", str(n_processes),
+               "--coordinator", f"localhost:{port}",
+               "--instances", str(instances),
+               "--validators", str(validators),
+               "--heights", str(heights),
+               "--devices-per-host", str(devices_per_host),
+               "--n-val", str(n_val), "--out", out]
+        if dump_state:
+            cmd += ["--state-npz", os.path.join(out_dir, f"{tag}.npz")]
+        if heartbeat and mode == "pod":
+            cmd += ["--heartbeat",
+                    os.path.join(out_dir, f"heartbeat.{tag}.ndjson"),
+                    "--hb-interval", str(hb_interval)]
+        if native_admission and mode == "pod":
+            cmd.append("--native-admission")
+        log = open(os.path.join(out_dir, f"{tag}.log"), "w")
+        proc = subprocess.Popen(cmd, stdout=log, stderr=log, env=env,
+                                cwd=_repo_root(),
+                                preexec_fn=_die_with_parent)
+        return tag, mode, out, proc, log
+
+    jobs = [launch("pod", k, f"pod{k}") for k in range(n_processes)]
+    for mode in (extra_modes or ()):
+        jobs.append(launch(mode, 0, mode))
+
+    deadline = time.monotonic() + timeout_s
+    killed = False
+    for tag, mode, out, proc, log in jobs:
+        rem = deadline - time.monotonic()
+        try:
+            proc.wait(timeout=max(0.1, rem))
+        except subprocess.TimeoutExpired:
+            killed = True
+            break
+    if killed:
+        for _, _, _, proc, _ in jobs:
+            if proc.poll() is None:
+                proc.kill()
+        for _, _, _, proc, _ in jobs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+    results: dict = {"pod": [], "paths": {}, "killed": killed}
+    for tag, mode, out, proc, log in jobs:
+        log.close()
+        results["paths"][tag] = {
+            "json": out, "log": os.path.join(out_dir, f"{tag}.log"),
+            "npz": (os.path.join(out_dir, f"{tag}.npz")
+                    if dump_state else None),
+            "heartbeat": (os.path.join(out_dir,
+                                       f"heartbeat.{tag}.ndjson")
+                          if heartbeat and mode == "pod" else None),
+            "rc": proc.returncode,
+        }
+        try:
+            with open(out) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            rec = {"mode": mode, "error":
+                   f"no result record (rc={proc.returncode}"
+                   + (", killed on deadline" if killed else "") + ")"}
+        if mode == "pod":
+            results["pod"].append(rec)
+        else:
+            results[mode] = rec
+    return results
+
+
+if __name__ == "__main__":
+    sys.exit(main())

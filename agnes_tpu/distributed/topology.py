@@ -1,0 +1,324 @@
+"""Pod topology: instance-range sharding math, the decision-gather
+wire codec, and heartbeat-age liveness — the jax-free half of the
+multi-host serve subsystem (ISSUE 15).
+
+Three small pieces, each independently testable without a backend:
+
+* **HostPlan** — the one source of truth for which instances a host
+  owns.  The pod mesh puts hosts on the OUTER instance axis (the
+  slice axis of parallel/mesh.py — DCN, zero collectives), so every
+  host's instance range is a CONTIGUOUS block and local<->global id
+  translation is an offset.  Per-host serve fronts screen on this
+  range; the dense sharded step's data layout follows it by
+  construction (parallel/sharded.py shards the instance dimension
+  slice-major).
+* **Decision-gather codec** — per-tick decision exchange rides the
+  EXISTING 96-byte wire ABI (bridge/native_ingest.pack_wire_votes):
+  one wire record per newly latched decision (instance = GLOBAL id,
+  validator = reporting host, height/round = the decision's, value =
+  the decided value id), framed into a FIXED-size buffer so an
+  allgather can carry it (every host contributes the same shape; the
+  frame header counts the real records, the tail is zero padding).
+  Reusing the vote ABI means one parser, one byte layout, and a
+  decision frame is replayable/loggable with the exact tooling the
+  vote plane already has.
+* **StragglerMonitor** — per-host last-evidence ages (fed by
+  completed gathers, peer heartbeat files, or anything else that
+  proves a host recently made progress) with two thresholds: a
+  STRAGGLER warning age and a DEAD age.  `check()` raises
+  DeadHostError past the dead threshold — the fail-closed hook
+  HostShard.drain uses to stop waiting on pod collectives that can
+  never complete (a dead host never joins another allgather).
+
+Pure numpy + stdlib; no jax anywhere (conftest _CHEAP eligible).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from agnes_tpu.bridge.native_ingest import (
+    REC_SIZE,
+    pack_wire_votes,
+    unpack_wire_votes,
+)
+
+#: decision frame header: record count (u32) + reporting host (u32)
+FRAME_HEADER = 8
+
+
+class PodConfigError(ValueError):
+    """A pod shape the sharding math cannot satisfy."""
+
+
+class DeadHostError(RuntimeError):
+    """A host's liveness evidence is older than the dead threshold —
+    pod collectives would hang on it; drain must fail closed."""
+
+
+@dataclasses.dataclass(frozen=True)
+class HostPlan:
+    """Which contiguous instance block each of `n_hosts` hosts owns.
+
+    `n_instances` must divide evenly: the sharded step requires the
+    instance dimension to split exactly over the mesh's data axes,
+    and a ragged host would need padding instances whose state the
+    differential would then have to exclude — reject at plan time
+    instead (the deployment picks I as a multiple of the pod)."""
+
+    n_hosts: int
+    n_instances: int
+
+    def __post_init__(self):
+        if self.n_hosts <= 0:
+            raise PodConfigError(f"n_hosts must be >= 1: {self.n_hosts}")
+        if self.n_instances <= 0:
+            raise PodConfigError(
+                f"n_instances must be >= 1: {self.n_instances}")
+        if self.n_instances % self.n_hosts:
+            raise PodConfigError(
+                f"{self.n_instances} instances do not shard evenly "
+                f"over {self.n_hosts} hosts (the sharded step's data "
+                f"axes need an exact split — pad the deployment or "
+                f"change the pod size)")
+
+    @property
+    def local_instances(self) -> int:
+        return self.n_instances // self.n_hosts
+
+    def instance_range(self, host: int) -> Tuple[int, int]:
+        """[lo, hi) global instance ids host `host` owns."""
+        self._check_host(host)
+        lo = host * self.local_instances
+        return lo, lo + self.local_instances
+
+    def owner_of(self, instance: int) -> int:
+        """The host owning global instance id `instance`."""
+        if not 0 <= instance < self.n_instances:
+            raise PodConfigError(
+                f"instance {instance} outside [0, {self.n_instances})")
+        return instance // self.local_instances
+
+    def to_local(self, host: int, instance) -> np.ndarray:
+        """Global instance ids -> host-local ids (vectorized; caller
+        guarantees ownership — see `owned_mask`)."""
+        lo, _ = self.instance_range(host)
+        return np.asarray(instance, np.int64) - lo
+
+    def to_global(self, host: int, instance) -> np.ndarray:
+        """Host-local instance ids -> global ids (vectorized)."""
+        lo, _ = self.instance_range(host)
+        return np.asarray(instance, np.int64) + lo
+
+    def owned_mask(self, host: int, instance) -> np.ndarray:
+        """[N] bool: which global ids fall in `host`'s range."""
+        lo, hi = self.instance_range(host)
+        inst = np.asarray(instance, np.int64)
+        return (inst >= lo) & (inst < hi)
+
+    def _check_host(self, host: int) -> None:
+        if not 0 <= host < self.n_hosts:
+            raise PodConfigError(
+                f"host {host} outside [0, {self.n_hosts})")
+
+
+def wire_instance_ids(rec: np.ndarray) -> np.ndarray:
+    """[N] int64 instance ids of a [N, REC_SIZE] record view —
+    the one shared extraction the front door's screen and the rebase
+    both use (instance is the first little-endian u32)."""
+    n = len(rec)
+    return rec[:, 0:4].copy().view(np.uint32).reshape(n) \
+        .astype(np.int64)
+
+
+def shift_instances_inplace(rec: np.ndarray, offset: int) -> None:
+    """Shift every record's instance field by `offset` IN a writable
+    [N, REC_SIZE] record array (one pass, no re-parse)."""
+    n = len(rec)
+    if n:
+        inst = (wire_instance_ids(rec) + offset).astype(np.uint32)
+        rec[:, 0:4] = inst[:, None].view(np.uint8).reshape(n, 4)
+
+
+def rebase_wire_instances(wire_bytes, offset: int) -> bytes:
+    """Shift every whole record's instance field by `offset` IN the
+    raw 96-byte wire layout — the per-host front door rebases global
+    gossip ids onto its local VoteService slice without an
+    unpack/repack round trip.  A truncated tail is preserved
+    untouched (the admission queue counts it malformed, exactly as it
+    would have)."""
+    buf = np.frombuffer(bytes(wire_bytes), np.uint8).copy()
+    n = len(buf) // REC_SIZE
+    if n:
+        shift_instances_inplace(buf[:n * REC_SIZE].reshape(n,
+                                                           REC_SIZE),
+                                offset)
+    return buf.tobytes()
+
+
+# -- decision-gather codec ----------------------------------------------------
+
+def frame_capacity_bytes(max_decisions: int) -> int:
+    """Fixed per-host frame size for a gather carrying up to
+    `max_decisions` records (a host can latch at most its local
+    instance count of NEW first-decisions per tick)."""
+    return FRAME_HEADER + int(max_decisions) * REC_SIZE
+
+
+def pack_decision_frame(host: int, instances, values, rounds, heights,
+                        max_decisions: int) -> np.ndarray:
+    """[frame_capacity_bytes] uint8: header + one 96-byte wire record
+    per decision + zero padding.  `instances` are GLOBAL ids; `values`
+    the decided value ids (< 0 = nil — the wire codec's encoding);
+    signatures ride as zeros (a decision report is not a vote — its
+    authenticity comes from the pod transport, not a lane verify)."""
+    inst = np.asarray(instances, np.int64)
+    n = len(inst)
+    if n > max_decisions:
+        raise PodConfigError(
+            f"{n} decisions exceed the frame capacity {max_decisions}")
+    frame = np.zeros(frame_capacity_bytes(max_decisions), np.uint8)
+    frame[0:4] = np.uint32(n).reshape(1).view(np.uint8)
+    frame[4:8] = np.uint32(host).reshape(1).view(np.uint8)
+    if n:
+        wire = pack_wire_votes(
+            inst, np.full(n, host, np.int64),
+            np.asarray(heights, np.int64), np.asarray(rounds, np.int64),
+            np.zeros(n, np.int64), np.asarray(values, np.int64))
+        frame[FRAME_HEADER:FRAME_HEADER + n * REC_SIZE] = \
+            np.frombuffer(wire, np.uint8)
+    return frame
+
+
+@dataclasses.dataclass(frozen=True)
+class PodDecision:
+    """One decision as gathered pod-wide (global instance id)."""
+
+    instance: int
+    host: int
+    height: int
+    round: int
+    value_id: Optional[int]        # None = nil
+
+
+def unpack_decision_frame(frame: np.ndarray) -> List[PodDecision]:
+    """Inverse of pack_decision_frame (one host's frame)."""
+    frame = np.asarray(frame, np.uint8)
+    if len(frame) < FRAME_HEADER:
+        raise PodConfigError(f"frame shorter than the header: "
+                             f"{len(frame)} bytes")
+    n = int(frame[0:4].view(np.uint32)[0])
+    host = int(frame[4:8].view(np.uint32)[0])
+    cap = (len(frame) - FRAME_HEADER) // REC_SIZE
+    if n > cap:
+        raise PodConfigError(
+            f"frame claims {n} records but holds at most {cap}")
+    if n == 0:
+        return []
+    raw = frame[FRAME_HEADER:FRAME_HEADER + n * REC_SIZE].tobytes()
+    inst, val, hts, rnd, _typ, value, _sigs = unpack_wire_votes(raw)
+    return [PodDecision(
+        instance=int(inst[k]), host=int(val[k]), height=int(hts[k]),
+        round=int(rnd[k]),
+        value_id=(int(value[k]) if value[k] >= 0 else None))
+        for k in range(n)]
+
+
+def unpack_decision_frames(frames: np.ndarray) -> List[PodDecision]:
+    """All hosts' gathered frames ([n_hosts, frame_bytes] — the
+    allgather output) -> flat decision list, host-major order."""
+    out: List[PodDecision] = []
+    for row in np.asarray(frames, np.uint8):
+        out.extend(unpack_decision_frame(row))
+    return out
+
+
+# -- liveness -----------------------------------------------------------------
+
+class StragglerMonitor:
+    """Per-host liveness from last-evidence ages (module docstring).
+
+    Evidence is anything proving recent progress: `beat(host)` after a
+    completed gather/barrier (an allgather completing IS an all-hosts
+    liveness proof), or `observe_heartbeat_files` reading co-located
+    heartbeat NDJSON trails (utils/flightrec.last_line_age_s).  The
+    clock is injectable so the detection logic tests with stubbed
+    time (the ISSUE 15 satellite)."""
+
+    def __init__(self, n_hosts: int, host: int,
+                 dead_after_s: float = 30.0,
+                 straggler_after_s: float = 5.0,
+                 clock=time.monotonic):
+        if dead_after_s <= straggler_after_s:
+            raise PodConfigError(
+                f"dead_after_s ({dead_after_s}) must exceed "
+                f"straggler_after_s ({straggler_after_s})")
+        self.n_hosts = int(n_hosts)
+        self.host = int(host)
+        self.dead_after_s = float(dead_after_s)
+        self.straggler_after_s = float(straggler_after_s)
+        self._clock = clock
+        now = self._clock()
+        self._last: Dict[int, float] = {h: now for h in
+                                        range(self.n_hosts)}
+
+    def beat(self, host: Optional[int] = None,
+             now: Optional[float] = None) -> None:
+        """Record evidence for one host (None = ALL hosts — the
+        completed-collective case: nobody missing, everybody live)."""
+        now = self._clock() if now is None else now
+        hosts = range(self.n_hosts) if host is None else (int(host),)
+        for h in hosts:
+            self._last[h] = max(self._last[h], now)
+
+    def observe_heartbeat_files(self, paths: Sequence[Optional[str]],
+                                now: Optional[float] = None) -> None:
+        """Fold peer heartbeat trails in: paths[h] is host h's NDJSON
+        file (None/unreadable = no new evidence).  Ages come from the
+        trail's last valid line — the same number a post-mortem reads
+        (utils/flightrec.last_line_age_s, wall-clock based; mixed into
+        the monotonic ledger as now - age)."""
+        from agnes_tpu.utils.flightrec import last_line_age_s
+
+        now = self._clock() if now is None else now
+        for h, path in enumerate(paths):
+            if h >= self.n_hosts or path is None:
+                continue
+            age = last_line_age_s(path)
+            if age is not None:
+                self._last[h] = max(self._last[h], now - age)
+
+    def ages(self, now: Optional[float] = None) -> Dict[int, float]:
+        now = self._clock() if now is None else now
+        return {h: now - t for h, t in self._last.items()}
+
+    def stragglers(self, now: Optional[float] = None) -> List[int]:
+        """Hosts past the straggler age but not yet dead (self
+        excluded — a host is never its own straggler)."""
+        return [h for h, age in self.ages(now).items()
+                if h != self.host
+                and self.straggler_after_s < age <= self.dead_after_s]
+
+    def dead(self, now: Optional[float] = None) -> List[int]:
+        return [h for h, age in self.ages(now).items()
+                if h != self.host and age > self.dead_after_s]
+
+    def check(self, now: Optional[float] = None) -> List[int]:
+        """Raise DeadHostError when any peer is past the dead age;
+        returns the (possibly empty) straggler list otherwise — the
+        pre-collective gate: a dead peer means the next allgather
+        would hang forever, so the caller drains fail-closed instead
+        of joining it."""
+        gone = self.dead(now)
+        if gone:
+            ages = self.ages(now)
+            raise DeadHostError(
+                f"host(s) {gone} show no liveness evidence for "
+                + ", ".join(f"{ages[h]:.1f}s" for h in gone)
+                + f" (> dead_after_s={self.dead_after_s}); pod "
+                f"collectives would hang — drain fail-closed")
+        return self.stragglers(now)

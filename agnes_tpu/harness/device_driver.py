@@ -748,6 +748,18 @@ class DeviceDriver:
             return bool((self.stats.decision_value == value).all())
         return True
 
+    def state_copies(self):
+        """Throwaway (state, tally) copies for warmup dispatches —
+        outputs of a donated warmup must not eat the live buffers.
+        A hook (not an inline tree.map) because the pod driver
+        (distributed/driver.py) must copy through a jitted pod
+        computation: eager per-leaf copies of multi-host arrays are
+        unsupported eager ops."""
+        import jax
+
+        return (jax.tree.map(lambda x: x.copy(), self.state),
+                jax.tree.map(lambda x: x.copy(), self.tally))
+
     def collect(self) -> None:
         """Drain deferred message batches into the stats (in step
         order — decision latching is order-sensitive), and settle any

@@ -137,6 +137,30 @@ def _state_spec(da: Tuple[str, ...]):
     return DeviceState(*([P(da)] * len(DeviceState._fields)))
 
 
+def seq_in_specs(mesh: Mesh):
+    """The stacked step-sequence argument specs — (state, tally,
+    exts_st, phases_st, powers, total, proposer_flag, propose_value)
+    with the leading replicated sequence axis on exts/phases.  Public
+    because the multi-host driver (distributed/driver.py) assembles
+    GLOBAL arrays from process-local blocks against exactly these
+    specs — one source of truth with the shard_map wrappers below."""
+    da = _data_axes(mesh)
+    s = _in_specs(da)
+    return (s[0], s[1], _prepend_none(s[2]), _prepend_none(s[3]),
+            s[4], s[5], s[6], s[7])
+
+
+def dense_lane_specs(mesh: Mesh) -> DenseSignedPhases:
+    """Sharding specs of the dense signed-lane tensors (the
+    make_sharded_step_seq_signed layout), shared with the multi-host
+    lift for the same reason as seq_in_specs."""
+    da = _data_axes(mesh)
+    return DenseSignedPhases(
+        pub=P(VAL_AXIS),
+        sig=P(None, da, VAL_AXIS),
+        blocks=P(None, da, VAL_AXIS))
+
+
 def make_sharded_step(mesh: Mesh, advance_height: bool = False):
     """A jitted consensus_step sharded over `mesh` (flat data x val or
     hierarchical slice x data x val); call with arrays already placed
@@ -189,8 +213,7 @@ def make_sharded_step_seq(mesh: Mesh, advance_height: bool = False,
     def build():
         da = _data_axes(mesh)
         s = _in_specs(da)
-        in_specs = (s[0], s[1], _prepend_none(s[2]), _prepend_none(s[3]),
-                    s[4], s[5], s[6], s[7])
+        in_specs = seq_in_specs(mesh)
         out_specs = StepOutputs(state=_state_spec(da), tally=s[1],
                                 msgs=P(None, None, da))
         fn = _shard_map(
@@ -230,11 +253,9 @@ def make_sharded_step_seq_signed(mesh: Mesh, advance_height: bool = False,
     def build():
         da = _data_axes(mesh)
         s = _in_specs(da)
-        dense_spec = DenseSignedPhases(
-            pub=P(VAL_AXIS),
-            sig=P(None, da, VAL_AXIS),
-            blocks=P(None, da, VAL_AXIS))
-        in_specs = (s[0], s[1], _prepend_none(s[2]), _prepend_none(s[3]),
+        dense_spec = dense_lane_specs(mesh)
+        sq = seq_in_specs(mesh)
+        in_specs = (sq[0], sq[1], sq[2], sq[3],
                     dense_spec, s[4], s[5], s[6], s[7])
         out_specs = SignedStepOutputs(state=_state_spec(da), tally=s[1],
                                       msgs=P(None, None, da),

@@ -139,7 +139,8 @@ class ShapeLadder:
                    local_shape: Optional[Tuple[int, int]] = None,
                    n_classes: int = 2,
                    max_votes: Optional[int] = None, min_rung: int = 256,
-                   hbm_bytes: Optional[int] = None) -> "ShapeLadder":
+                   hbm_bytes: Optional[int] = None,
+                   n_hosts: int = 1) -> "ShapeLadder":
         """Ladder for the DENSE dispatch mode (mesh serving): the
         dense fused signed step's compile key is (P, I, V) — fixed by
         the deployment, NOT by the batch size — so rungs here only
@@ -150,11 +151,24 @@ class ShapeLadder:
         PER-DEVICE `local_shape` (utils/budget.mesh_local_shape) has
         to fit the per-device HBM slice at least chunked —
         plan_dense_verify raises BudgetError when it cannot, failing
-        the service at plan time rather than live at first dispatch."""
+        the service at plan time rather than live at first dispatch.
+
+        `n_hosts` (ISSUE 15): on a pod, `n_instances` may be the
+        GLOBAL deployment figure while each host's admission only
+        ever feeds its own slice — rungs sized to the global tick
+        would pace micro-batches n_hosts times too big (a per-host
+        batch can never fill them, so every close is deadline-forced
+        and fill sits at 1/n_hosts forever).  The top rung is planned
+        against the instance slice ONE host actually owns."""
+        nh = max(1, int(n_hosts))
+        if n_instances % nh:
+            raise ValueError(
+                f"{n_instances} instances do not shard evenly over "
+                f"{n_hosts} hosts")
         li, lv = (local_shape if local_shape is not None
-                  else (n_instances, n_validators))
+                  else (n_instances // nh, n_validators))
         plan_dense_verify(n_classes, li, lv, hbm_bytes=hbm_bytes)
-        top_want = 2 * n_instances * n_validators
+        top_want = 2 * (n_instances // nh) * n_validators
         if max_votes is not None:
             top_want = min(top_want, int(max_votes))
         min_rung = _ceil_pow2(min_rung)

@@ -179,6 +179,12 @@ class ServePipeline:
         # it was made, not whatever a later height interned into the
         # same slot (service.poll_decisions consumes this)
         self.first_advance_decode: dict = {}
+        # ... and the HEIGHT the instance was on before that first
+        # advance — i.e. the height its latched first decision decided
+        # (the pod decision gather stamps frames with it; reading the
+        # batcher's CURRENT height instead would mis-stamp any
+        # decision polled after later-height traffic moved the window)
+        self.first_advance_height: dict = {}
         self.dispatched_batches = 0
         self.dispatched_votes = 0
         self.noop_ticks = 0
@@ -235,6 +241,8 @@ class ServePipeline:
                 self.first_advance_decode[int(i)] = {
                     s: self.batcher.decode_slot(int(i), s)
                     for s in range(self.batcher.slots.n_slots)}
+                self.first_advance_height[int(i)] = \
+                    int(self.batcher.heights[i])
         self.batcher.sync_device(base, hts)
         return hts
 
@@ -608,8 +616,9 @@ class ServePipeline:
         zero_hts = np.zeros(d.I, np.int64)
 
         def copies():
-            return (jax.tree.map(lambda x: x.copy(), d.state),
-                    jax.tree.map(lambda x: x.copy(), d.tally))
+            # through the driver hook: the pod driver must copy via a
+            # jitted pod computation (DeviceDriver.state_copies)
+            return d.state_copies()
 
         warmed = 0
         for P in n_phases:

@@ -209,21 +209,37 @@ def plan_lane_verify(n_lanes: int, n_blocks: int = 1,
         hbm_bytes=hbm, safety=safety)
 
 
-def mesh_local_shape(mesh, n_instances: int, n_validators: int
-                     ) -> Tuple[int, int]:
+def mesh_local_shape(mesh, n_instances: int, n_validators: int,
+                     n_hosts: int = 1) -> Tuple[int, int]:
     """(instances, validators) as ONE device of `mesh` sees them — the
     shape every per-device budget plan must bound (under shard_map the
     verify and tally run on local cells).  `mesh=None` is the
     single-device identity.  One source of truth shared by
     DeviceDriver's chunk planning and the serve ShapeLadder's dense
     planning, so the two can never disagree about what "per-device
-    slice of the budget" means."""
+    slice of the budget" means.
+
+    `n_hosts` (ISSUE 15): on a POD mesh spanning several processes,
+    `mesh.shape` counts the GLOBAL device grid but a multi-host
+    driver's `n_instances` is already the PER-HOST slice (the host
+    plan divided the deployment before the driver ever saw it) —
+    dividing a host's slice by the pod-wide data extent would plan
+    verify tiles against an instance count n_hosts times too small
+    (a silent HBM under-claim that OOMs at full shape).  Pass the
+    host count the instance figure was already divided by; the data
+    extent one host actually owns is global_data / n_hosts."""
     if mesh is None:
         return int(n_instances), int(n_validators)
     from agnes_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS, VAL_AXIS
 
     shape = dict(mesh.shape)
     n_data = shape.get(DATA_AXIS, 1) * shape.get(SLICE_AXIS, 1)
+    if n_hosts > 1:
+        if n_data % n_hosts:
+            raise ValueError(
+                f"mesh data extent {n_data} does not split over "
+                f"{n_hosts} hosts")
+        n_data //= n_hosts
     return (int(n_instances) // n_data,
             int(n_validators) // shape.get(VAL_AXIS, 1))
 

@@ -39,8 +39,12 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-#: heartbeat line schema version
-SCHEMA_VERSION = 1
+#: heartbeat line schema version.  v2 (ISSUE 15): multi-host pods —
+#: every line may carry `host_id`/`process_index` so a pod-wide wedge
+#: is datable PER PROCESS (one trail per host, merged by the
+#: `agnes-metrics` multi-file postmortem).  v1 lines stay valid (the
+#: host keys are optional; a single-process run omits them).
+SCHEMA_VERSION = 2
 
 #: required heartbeat keys -> accepted types (the ci.sh gate and
 #: `agnes-metrics --check` validate every line against this)
@@ -51,6 +55,14 @@ REQUIRED_KEYS = {
     "t": (int, float),          # wall-clock epoch seconds
     "pid": int,
     "uptime_s": (int, float),
+}
+
+#: optional keys type-checked WHEN present (schema v2: the multi-host
+#: identity stamp — `agnes-metrics --check` rejects a pod trail whose
+#: host stamp is the wrong type, the same way it rejects a bad seq)
+OPTIONAL_KEYS = {
+    "host_id": int,
+    "process_index": int,
 }
 
 
@@ -132,7 +144,12 @@ class Heartbeat:
 
     def __init__(self, path: str, interval_s: float = 1.0,
                  recorder: Optional[FlightRecorder] = None,
-                 sources=None, max_bytes: int = 8_000_000):
+                 sources=None, max_bytes: int = 8_000_000,
+                 host_id: Optional[int] = None):
+        """`host_id` (schema v2, ISSUE 15): the pod process index —
+        when set, every line carries `host_id` + `process_index` so a
+        merged multi-host postmortem can attribute each trail (None =
+        single-process, keys omitted, v1-shaped lines)."""
         if interval_s <= 0:
             raise ValueError(f"interval_s must be positive: {interval_s}")
         self.path = str(path)
@@ -140,6 +157,7 @@ class Heartbeat:
         self.recorder = recorder
         self.sources = sources if sources is not None else []
         self.max_bytes = int(max_bytes)
+        self.host_id = None if host_id is None else int(host_id)
         self.seq = 0
         self.source_errors = 0
         self._t0 = time.monotonic()
@@ -160,6 +178,9 @@ class Heartbeat:
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "interval_s": self.interval_s,
         }
+        if self.host_id is not None:
+            line["host_id"] = self.host_id
+            line["process_index"] = self.host_id
         if self.recorder is not None:
             line["events"] = self.recorder.counts()
             line["events_dropped"] = self.recorder.dropped
@@ -245,6 +266,12 @@ def validate_heartbeat_line(obj) -> List[str]:
                                                            bool):
             problems.append(
                 f"key {key!r} has type {type(obj[key]).__name__}")
+    for key, types in OPTIONAL_KEYS.items():
+        if key in obj and (not isinstance(obj[key], types)
+                           or isinstance(obj[key], bool)):
+            problems.append(
+                f"optional key {key!r} has type "
+                f"{type(obj[key]).__name__}")
     if not problems and obj["v"] > SCHEMA_VERSION:
         problems.append(f"schema version {obj['v']} from the future")
     return problems
@@ -368,4 +395,55 @@ def render_postmortem(path: str,
         out.append(f"  jaxpr census drift: {int(drift)} entr"
                    + ("y" if drift == 1 else "ies")
                    + (" (clean)" if drift == 0 else " — GRAPH GREW"))
+    return "\n".join(out)
+
+
+def render_pod_postmortem(paths: Sequence[str],
+                          now: Optional[float] = None) -> str:
+    """Merged per-host wedge timeline over SEVERAL heartbeat trails
+    (ISSUE 15: one file per pod process).  The header ranks hosts by
+    last-beat age — on a wedged pod the host that stopped beating
+    FIRST is where the post-mortem starts — then each host's full
+    single-file summary follows.  A missing/empty trail is itself a
+    ranked finding (a host that never beat died before its recorder
+    armed)."""
+    now = time.time() if now is None else now
+    rows = []                  # (sort key, label line)
+    for k, path in enumerate(paths):
+        label = f"host file {k} ({path})"
+        try:
+            lines, bad = read_heartbeat(path)
+        except OSError as e:
+            rows.append((float("-inf"), f"  {label}: UNREADABLE "
+                                       f"({e.__class__.__name__}) — "
+                                       f"died before first beat?"))
+            continue
+        if not lines:
+            rows.append((float("-inf"),
+                         f"  {label}: no valid lines ({len(bad)} bad)"))
+            continue
+        last = lines[-1]
+        age = now - last["t"]
+        host = last.get("host_id")
+        who = (f"host {host}" if host is not None
+               else f"pid {last['pid']}")
+        interval = float(last.get("interval_s", 0)) or None
+        stale = interval is not None and age > 2 * interval
+        rows.append((
+            -age,
+            f"  {who}: last beat {_fmt_t(last['t'])} "
+            f"(age {age:.1f}s, {len(lines)} beats, seq "
+            f"{last['seq']})"
+            + (" — STALE: wedged/died around this time" if stale
+               else " — fresh")))
+    out = [f"pod heartbeat merge: {len(paths)} trail(s), oldest "
+           f"last-beat first (the first host to go quiet is where "
+           f"the wedge began)"]
+    out.extend(line for _, line in sorted(rows, key=lambda r: r[0]))
+    for path in paths:
+        out.append("")
+        try:
+            out.append(render_postmortem(path, now=now))
+        except OSError as e:
+            out.append(f"heartbeat {path}: unreadable ({e})")
     return "\n".join(out)

@@ -405,6 +405,16 @@ SERVE_NATIVE_REJECTS_OVERFLOW = SERVE_NATIVE_REJECTS_PREFIX + "overflow"
 SERVE_NATIVE_REJECTS_FAIRNESS = SERVE_NATIVE_REJECTS_PREFIX + "fairness"
 SERVE_NATIVE_REJECTS_MALFORMED = (SERVE_NATIVE_REJECTS_PREFIX
                                   + "malformed")
+#: ISSUE 15 (multi-host serve, agnes_tpu/distributed/): records the
+#: pod front door screened off because their GLOBAL instance id
+#: belongs to another host's block (counter, distributed/shard.py —
+#: the same name is the drain report's `pod.foreign_rejects`), and
+#: the verdict-record keys the multihost bench probe/gate carry:
+#: `multihost_hosts` / `multihost_devices_per_host` (pod topology of
+#: the measured run) beside `pipeline_serve_multihost_votes_per_sec`.
+POD_FOREIGN_REJECTS = "pod_foreign_rejects"
+MULTIHOST_HOSTS = "multihost_hosts"
+MULTIHOST_DEVICES_PER_HOST = "multihost_devices_per_host"
 #: per-entry first-dispatch wall gauges, `compile_ms_<entry>` (ISSUE 8
 #: satellite): the registry times the FIRST dispatch of every entry in
 #: the process (trace + compile dominates that call), so the next

@@ -229,7 +229,18 @@ _SERVE_BLS_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_BLS_SMOKE"))
 #: A/B for native_admission_speedup; CPU, crash-safe
 _SERVE_NATIVE_SMOKE = bool(
     os.environ.get("AGNES_BENCH_SERVE_NATIVE_SMOKE"))
-_SENTINEL_METRIC = ("pipeline_serve_mesh_votes_per_sec"
+#: multi-host-smoke mode (ci.sh gate, ISSUE 15): ONLY the pod serve
+#: probe — the PARENT spawns 2 jax.distributed worker processes (2
+#: faked CPU devices each, gloo collectives) via
+#: distributed/smoke.spawn_pod and aggregates their records; the
+#: parent itself never builds a backend mesh, so the crash-safe
+#: contract bounds the whole pod (a wedged pod is SIGKILLed at the
+#: spawner deadline and the sentinel still emits)
+_SERVE_MULTIHOST_SMOKE = bool(
+    os.environ.get("AGNES_BENCH_SERVE_MULTIHOST_SMOKE"))
+_SENTINEL_METRIC = ("pipeline_serve_multihost_votes_per_sec"
+                    if _SERVE_MULTIHOST_SMOKE
+                    else "pipeline_serve_mesh_votes_per_sec"
                     if _SERVE_MESH_SMOKE
                     else "pipeline_serve_dedup_votes_per_sec"
                     if _SERVE_DEDUP_SMOKE
@@ -239,7 +250,9 @@ _SENTINEL_METRIC = ("pipeline_serve_mesh_votes_per_sec"
                     if _SERVE_NATIVE_SMOKE
                     else "pipeline_fused_votes_per_sec" if _SERVE_SMOKE
                     else "pipeline_votes_per_sec")
-_SENTINEL_STAGE = ("bench_pipeline_serve_mesh" if _SERVE_MESH_SMOKE
+_SENTINEL_STAGE = ("bench_pipeline_serve_multihost"
+                   if _SERVE_MULTIHOST_SMOKE
+                   else "bench_pipeline_serve_mesh" if _SERVE_MESH_SMOKE
                    else "bench_pipeline_serve_dedup"
                    if _SERVE_DEDUP_SMOKE
                    else "bench_pipeline_serve_bls"
@@ -257,7 +270,7 @@ _EXTRA_RECORD: dict = {}
 #: every serve smoke is a CPU-only CI gate (no TPU claim/lease/probe)
 _ANY_SERVE_SMOKE = (_SERVE_SMOKE or _SERVE_MESH_SMOKE
                     or _SERVE_DEDUP_SMOKE or _SERVE_BLS_SMOKE
-                    or _SERVE_NATIVE_SMOKE)
+                    or _SERVE_NATIVE_SMOKE or _SERVE_MULTIHOST_SMOKE)
 
 
 def _emit_sentinel(note: str) -> None:
@@ -1406,6 +1419,67 @@ def _pipeline_serve_mesh(n_instances: int, n_validators: int,
     return 2 * n * heights / dt
 
 
+def _pipeline_serve_multihost(n_instances: int, n_validators: int,
+                              heights: int, n_hosts: int = 2,
+                              devices_per_host: int = 2,
+                              n_val: int = 2) -> float:
+    """CLOSED-LOOP through the MULTI-HOST serve plane (ISSUE 15): the
+    parent spawns `n_hosts` jax.distributed worker processes
+    (distributed/smoke.py — each with its own faked CPU devices, gloo
+    collectives, HostShard front-end over a DistributedDriver,
+    barrier-synchronized warmup, per-height pod decision gathers and
+    a host-id-stamped heartbeat), waits under a deadline derived from
+    the discovered budget, and aggregates the per-host records.  The
+    reported rate is the SLOWEST host's pod-wide votes/sec (every
+    host measures the same pod throughput; min is the conservative
+    read).  Spawner keys land in the verdict record via
+    _EXTRA_RECORD: `multihost_hosts`/`multihost_devices_per_host`
+    (the ISSUE 15 satellite), the summed retrace/reject counters the
+    gate asserts on, and every worker heartbeat path."""
+    import tempfile
+
+    from agnes_tpu.distributed.smoke import spawn_pod
+
+    out_dir = os.environ.get("AGNES_MULTIHOST_DIR") or \
+        tempfile.mkdtemp(prefix="agnes_multihost_")
+    rem = _DEADLINE.remaining()
+    timeout_s = 900.0
+    if rem != float("inf"):
+        timeout_s = max(60.0,
+                        rem - _budget.deadline_margin_s(rem) - 15.0)
+    res = spawn_pod(n_hosts, instances=n_instances,
+                    validators=n_validators, heights=heights,
+                    devices_per_host=devices_per_host, n_val=n_val,
+                    out_dir=out_dir, timeout_s=timeout_s,
+                    heartbeat=True)
+    if res["killed"]:
+        raise RuntimeError(
+            f"multihost pod breached its {timeout_s:.0f}s spawner "
+            f"deadline (logs under {out_dir})")
+    errors = [r for r in res["pod"] if "error" in r]
+    if errors:
+        raise RuntimeError(f"pod worker(s) failed: {errors} "
+                           f"(logs under {out_dir})")
+    _EXTRA_RECORD.update({
+        "multihost_hosts": n_hosts,
+        "multihost_devices_per_host": devices_per_host,
+        "multihost_retrace_unexpected": sum(
+            r["retrace_unexpected"] for r in res["pod"]),
+        "multihost_rejected_signature_device": sum(
+            r["rejected_signature_device"] for r in res["pod"]),
+        "multihost_pod_decisions": min(
+            r["pod_decisions"] for r in res["pod"]),
+        "multihost_foreign_rejects": sum(
+            r["foreign_rejects"] for r in res["pod"]),
+        "multihost_offladder_builds": sum(
+            r["offladder_builds"] for r in res["pod"]),
+        "multihost_heartbeat_paths": [
+            res["paths"][f"pod{k}"]["heartbeat"]
+            for k in range(n_hosts)],
+    })
+    return min(r["votes_per_sec"] for r in res["pod"])
+
+
 def _pipeline_serve_dedup(n_instances: int, n_validators: int,
                           heights: int, dup: Optional[int] = None
                           ) -> float:
@@ -1965,6 +2039,21 @@ def bench_pipeline_serve_mesh(n_instances: int = 1024,
     return _pipeline_serve_mesh(n_instances, n_validators, heights)
 
 
+def bench_pipeline_serve_multihost(n_instances: int = 8,
+                                   n_validators: int = 8,
+                                   heights: int = 2) -> float:
+    """End-to-end through the multi-host serve plane: 2 spawned
+    jax.distributed processes x 2 faked CPU devices, per-host
+    HostShard front-ends over ONE global-SPMD mesh (ISSUE 15).  A
+    CPU-resident probe by construction (the workers pin
+    JAX_PLATFORMS=cpu): it measures the pod PROTOCOL overhead —
+    lockstep agreement, per-host densify, decision gathers — not
+    accelerator throughput, so the default shape stays tiny even in
+    hardware rounds."""
+    return _pipeline_serve_multihost(n_instances, n_validators,
+                                     heights)
+
+
 def bench_pipeline_serve_dedup(n_instances: int = 1024,
                                n_validators: int = 128,
                                heights: int = 6) -> float:
@@ -2109,6 +2198,22 @@ def main_serve_native_smoke() -> None:
                 "Python admission")
 
 
+def main_serve_multihost_smoke() -> None:
+    """The ci.sh multi-host gate's entry (ISSUE 15): ONLY the pod
+    serve probe — 2 spawned jax.distributed worker processes under
+    the spawner deadline — with the same crash-safe contract as the
+    other serve gates.  The record carries `multihost_hosts`/
+    `multihost_devices_per_host`, the summed per-host retrace/reject
+    counters and every worker's heartbeat path via _EXTRA_RECORD."""
+    _smoke_main("bench_pipeline_serve_multihost",
+                "pipeline_serve_multihost_votes_per_sec",
+                "pipeline_serve_multihost_votes_per_sec", "votes/sec",
+                "AGNES_SERVE_MULTIHOST_SMOKE",
+                bench_pipeline_serve_multihost,
+                "multihost serve smoke: 2-process pod over "
+                "jax.distributed")
+
+
 def main_serve_mesh_smoke() -> None:
     """The ci.sh mesh-serve gate's entry (ISSUE 3): ONLY the mesh
     serve probe — ThreadedVoteService event loop + dense sharded
@@ -2158,6 +2263,9 @@ def main() -> None:
     # multichip serve: real number on >= 2-device backends, -1 (via
     # the stage guard's exception containment) on a single chip
     pipeline_serve_mesh = guarded(bench_pipeline_serve_mesh)
+    # multi-host pod serve: 2 spawned jax.distributed CPU processes
+    # (protocol-overhead probe — bench_pipeline_serve_multihost doc)
+    pipeline_serve_multihost = guarded(bench_pipeline_serve_multihost)
     # duplicated-traffic serve: dedup cache + split-rung dispatch
     pipeline_serve_dedup = guarded(bench_pipeline_serve_dedup)
     # native admission front-end: C++ submit/drain + Python replay
@@ -2191,6 +2299,8 @@ def main() -> None:
         "pipeline_fused_votes_per_sec": pipeline_fused,
         "pipeline_serve_votes_per_sec": pipeline_serve,
         "pipeline_serve_mesh_votes_per_sec": pipeline_serve_mesh,
+        "pipeline_serve_multihost_votes_per_sec":
+            pipeline_serve_multihost,
         "pipeline_serve_dedup_votes_per_sec": pipeline_serve_dedup,
         "pipeline_serve_native_votes_per_sec": pipeline_serve_native,
         "pipeline_serve_bls_votes_per_sec": pipeline_serve_bls,
@@ -2210,7 +2320,8 @@ def main() -> None:
 
 if __name__ == "__main__":
     try:
-        (main_serve_mesh_smoke() if _SERVE_MESH_SMOKE
+        (main_serve_multihost_smoke() if _SERVE_MULTIHOST_SMOKE
+         else main_serve_mesh_smoke() if _SERVE_MESH_SMOKE
          else main_serve_dedup_smoke() if _SERVE_DEDUP_SMOKE
          else main_serve_bls_smoke() if _SERVE_BLS_SMOKE
          else main_serve_native_smoke() if _SERVE_NATIVE_SMOKE

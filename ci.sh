@@ -28,6 +28,9 @@
 #       sharded dispatch on a faked 2-device CPU mesh);
 #   3f. native admission smoke gate (ISSUE 14: the C++ admission
 #       front-end vs the Python queue on the same traffic);
+#   3g. multi-host serve smoke gate (ISSUE 15: a 2-process
+#       jax.distributed pod — per-host HostShard front-ends over one
+#       global-SPMD mesh — spawned under the crash-safe deadline);
 #   4.  bench smoke (CI_BENCH=0 skips; the driver runs the real bench
 #       on TPU hardware at end of round).
 #
@@ -496,6 +499,72 @@ else:
           f"busy frac {rec['serve_submit_busy_frac_native']} native "
           f"vs {rec['serve_submit_busy_frac_python']} python)")
 PY
+
+echo "=== [3g/4] multi-host serve smoke gate (2-process pod, CPU) ==="
+# ISSUE 15: the multi-host serve plane — bench spawns 2
+# jax.distributed worker processes (2 faked CPU devices each, gloo
+# collectives), each running a HostShard front-end over ONE
+# global-SPMD mesh: barrier-synchronized warmup, lockstep dispatch
+# agreement, per-height pod decision gathers, per-host heartbeat.
+# Same crash-safe contract as the gates above: a real
+# pipeline_serve_multihost_votes_per_sec record (which must then show
+# hosts==2, zero unexpected retraces and zero device-rejected
+# signatures summed over every host) or the -1 sentinel, rc 0 either
+# way; the spawner deadline bounds a wedged pod inside the timeout.
+MH_DIR="$(mktemp -d)"
+MH_RC=0
+AGNES_BENCH_SERVE_MULTIHOST_SMOKE=1 AGNES_MULTIHOST_DIR="$MH_DIR" \
+  AGNES_TPU_LEASE_PATH="$MH_DIR/tpu.lease" \
+  timeout -k 10 900 python bench.py > "$MH_DIR/serve_multihost.json" \
+  2> "$MH_DIR/serve_multihost.err" || MH_RC=$?
+if [ "$MH_RC" -ne 0 ]; then
+  echo "multihost serve smoke gate FAILED: bench exited rc=$MH_RC"
+  tail -5 "$MH_DIR/serve_multihost.err"
+  exit 1
+fi
+python - "$MH_DIR/serve_multihost.json" <<'PY'
+import json, sys
+lines = [l for l in open(sys.argv[1]).read().strip().splitlines() if l]
+assert lines, "multihost serve smoke printed no stdout"
+rec = json.loads(lines[-1])
+assert rec["metric"] == "pipeline_serve_multihost_votes_per_sec", rec
+assert isinstance(rec["value"], (int, float)), rec
+assert rec["value"] == -1 or rec["value"] > 0, rec
+if rec["value"] == -1:
+    print("multihost serve smoke gate OK: -1 sentinel "
+          "(deadline contract)")
+else:
+    assert rec["multihost_hosts"] == 2, rec
+    assert rec["multihost_devices_per_host"] == 2, rec
+    assert rec["multihost_retrace_unexpected"] == 0, rec
+    assert rec["multihost_rejected_signature_device"] == 0, rec
+    assert rec["multihost_offladder_builds"] == 0, rec
+    assert len(rec["multihost_heartbeat_paths"]) == 2, rec
+    print(f"multihost serve smoke gate OK: {rec['value']:.0f} votes/s "
+          f"pod-wide ({rec['multihost_hosts']} hosts x "
+          f"{rec['multihost_devices_per_host']} devices, "
+          f"{rec['multihost_pod_decisions']} pod decisions gathered)")
+PY
+# one parseable host-id-stamped heartbeat per pod process (real value
+# OR sentinel: the workers arm their recorders before the first
+# dispatch, so even a deadline-killed pod leaves dated trails when it
+# got as far as spawning) + the merged per-host postmortem onto the
+# gate log — skipped only if the pod never produced trails
+if ls "$MH_DIR"/heartbeat.pod*.ndjson >/dev/null 2>&1; then
+  timeout -k 5 60 python scripts/agnes_metrics.py --check \
+    "$MH_DIR"/heartbeat.pod*.ndjson
+  timeout -k 5 60 python scripts/agnes_metrics.py \
+    "$MH_DIR"/heartbeat.pod*.ndjson || true
+else
+  python - "$MH_DIR/serve_multihost.json" <<'PY'
+import json, sys
+rec = json.loads([l for l in open(sys.argv[1]).read().strip()
+                  .splitlines() if l][-1])
+assert rec["value"] == -1, \
+    "real multihost record but no per-host heartbeat trails"
+print("multihost heartbeat check skipped (sentinel before spawn)")
+PY
+fi
 
 echo "=== GATE SUMMARY: heavy isolated files ==="
 grep -E "test_isolated_file\[.*\] " "$HEAVY_LOG" \

@@ -1,0 +1,384 @@
+"""Multi-host distributed serve: the jax-free half (ISSUE 15).
+
+Instance-range sharding math, the decision-gather wire codec,
+dead-host/straggler detection on stubbed clocks, the per-host budget/
+ladder planning fix, the schema-v2 heartbeat host stamp + merged pod
+postmortem, and the pod coordinator's single-process degenerate —
+all CPU-cheap, zero XLA compiles, no jax import (asserted)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from agnes_tpu.distributed import (
+    DeadHostError,
+    HostPlan,
+    PodConfigError,
+    StragglerMonitor,
+    frame_capacity_bytes,
+    pack_decision_frame,
+    rebase_wire_instances,
+    unpack_decision_frame,
+    unpack_decision_frames,
+)
+from agnes_tpu.distributed.pod import PodCoordinator, plan_digest
+from agnes_tpu.bridge.native_ingest import (
+    REC_SIZE,
+    pack_wire_votes,
+    unpack_wire_votes,
+)
+
+
+def test_distributed_topology_layer_is_jax_free():
+    """Fresh-interpreter proof (the suite's conftest imports jax
+    before any test runs, so the check must leave this process)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = ("import sys; import agnes_tpu.distributed; "
+            "import agnes_tpu.distributed.pod; "
+            "assert 'jax' not in sys.modules, 'pulled jax'")
+    subprocess.run([sys.executable, "-c", code], check=True, cwd=repo,
+                   env={**os.environ,
+                        "PYTHONPATH": repo + os.pathsep
+                        + os.environ.get("PYTHONPATH", "")})
+
+
+# -- HostPlan -----------------------------------------------------------------
+
+def test_host_plan_ranges_and_translation():
+    p = HostPlan(4, 12)
+    assert p.local_instances == 3
+    assert [p.instance_range(h) for h in range(4)] == [
+        (0, 3), (3, 6), (6, 9), (9, 12)]
+    assert p.owner_of(0) == 0 and p.owner_of(11) == 3
+    np.testing.assert_array_equal(p.to_local(2, [6, 8]), [0, 2])
+    np.testing.assert_array_equal(p.to_global(2, [0, 2]), [6, 8])
+    np.testing.assert_array_equal(
+        p.owned_mask(1, [2, 3, 5, 6]), [False, True, True, False])
+
+
+def test_host_plan_rejects_bad_shapes():
+    with pytest.raises(PodConfigError):
+        HostPlan(3, 10)                  # uneven split
+    with pytest.raises(PodConfigError):
+        HostPlan(0, 4)
+    p = HostPlan(2, 4)
+    with pytest.raises(PodConfigError):
+        p.instance_range(2)
+    with pytest.raises(PodConfigError):
+        p.owner_of(4)
+
+
+# -- decision-gather codec ----------------------------------------------------
+
+def test_decision_frame_roundtrip():
+    cap = 5
+    f = pack_decision_frame(3, [7, 9], [4, -1], [0, 2], [11, 11], cap)
+    assert len(f) == frame_capacity_bytes(cap)
+    decs = unpack_decision_frame(f)
+    assert len(decs) == 2
+    assert (decs[0].instance, decs[0].host, decs[0].round,
+            decs[0].height, decs[0].value_id) == (7, 3, 0, 11, 4)
+    assert decs[1].value_id is None          # nil decision
+    assert unpack_decision_frame(
+        pack_decision_frame(0, [], [], [], [], cap)) == []
+
+
+def test_decision_frames_gather_order_and_limits():
+    cap = 2
+    rows = np.stack([
+        pack_decision_frame(0, [1], [7], [0], [0], cap),
+        pack_decision_frame(1, [3, 2], [7, 7], [1, 0], [0, 0], cap),
+    ])
+    decs = unpack_decision_frames(rows)
+    assert [(d.instance, d.host) for d in decs] == [
+        (1, 0), (3, 1), (2, 1)]              # host-major order
+    with pytest.raises(PodConfigError):
+        pack_decision_frame(0, [1, 2, 3], [7] * 3, [0] * 3, [0] * 3,
+                            cap)             # over capacity
+    bad = rows[0].copy()
+    bad[0:4] = np.uint32(99).reshape(1).view(np.uint8)  # count > cap
+    with pytest.raises(PodConfigError):
+        unpack_decision_frame(bad)
+
+
+def test_decision_frame_rides_the_wire_abi():
+    """A decision frame's payload IS 96-byte wire records — the vote
+    plane's parser reads it (one codec, one byte layout)."""
+    f = pack_decision_frame(1, [5], [7], [2], [9], 1)
+    from agnes_tpu.distributed.topology import FRAME_HEADER
+
+    inst, val, hts, rnd, typ, value, _ = unpack_wire_votes(
+        bytes(f[FRAME_HEADER:FRAME_HEADER + REC_SIZE]))
+    assert (int(inst[0]), int(val[0]), int(hts[0]), int(rnd[0]),
+            int(value[0])) == (5, 1, 9, 2, 7)
+
+
+# -- wire rebase (the pod front door) -----------------------------------------
+
+def test_rebase_wire_instances():
+    w = pack_wire_votes([5, 6, 7], [0, 1, 2], [3] * 3, [0] * 3,
+                        [0, 1, 0], [7, -1, 7],
+                        np.arange(3 * 64, dtype=np.uint8).reshape(3, 64))
+    tail = b"trunc"
+    out = rebase_wire_instances(w + tail, -5)
+    assert out[-len(tail):] == tail          # truncated tail preserved
+    inst, val, hts, rnd, typ, value, sigs = unpack_wire_votes(
+        out[:-len(tail)])
+    np.testing.assert_array_equal(inst, [0, 1, 2])
+    # every other field byte-identical
+    np.testing.assert_array_equal(val, [0, 1, 2])
+    np.testing.assert_array_equal(value, [7, -1, 7])
+    np.testing.assert_array_equal(
+        sigs, np.arange(3 * 64, dtype=np.uint8).reshape(3, 64))
+
+
+# -- straggler / dead-host detection (stubbed clocks) -------------------------
+
+def _monitor(clk, **kw):
+    kw.setdefault("dead_after_s", 30.0)
+    kw.setdefault("straggler_after_s", 5.0)
+    return StragglerMonitor(3, 0, clock=lambda: clk["t"], **kw)
+
+
+def test_straggler_then_dead_progression():
+    clk = {"t": 100.0}
+    m = _monitor(clk)
+    assert m.check() == []                   # fresh at construction
+    clk["t"] = 104.0
+    assert m.stragglers() == [] and m.dead() == []
+    clk["t"] = 110.0
+    assert m.check() == [1, 2]               # past straggler age
+    m.beat(1)                                # host 1 shows evidence
+    assert m.check() == [2]
+    clk["t"] = 135.0                         # host 2: 35s, host 1: 25s
+    with pytest.raises(DeadHostError) as e:
+        m.check()
+    assert "[2]" in str(e.value)
+    assert m.dead() == [2] and m.stragglers() == [1]
+
+
+def test_monitor_never_flags_self_and_collective_beats_all():
+    clk = {"t": 0.0}
+    m = _monitor(clk)
+    clk["t"] = 1000.0
+    assert 0 not in m.dead()                 # self never flagged
+    m.beat(None)                             # completed allgather
+    assert m.check() == []
+
+
+def test_monitor_reads_heartbeat_files(tmp_path):
+    from agnes_tpu.utils.flightrec import Heartbeat
+
+    path = str(tmp_path / "hb.ndjson")
+    Heartbeat(path, host_id=1).beat()
+    clk = {"t": 1000.0}
+    m = _monitor(clk)
+    clk["t"] = 2000.0
+    # the trail was just written: its wall-clock age is ~0, so host 1
+    # gets fresh evidence while host 2 stays dead
+    m.observe_heartbeat_files([None, path, None])
+    with pytest.raises(DeadHostError) as e:
+        m.check()
+    assert "[2]" in str(e.value)
+
+
+def test_monitor_rejects_inverted_thresholds():
+    with pytest.raises(PodConfigError):
+        StragglerMonitor(2, 0, dead_after_s=1.0, straggler_after_s=5.0)
+
+
+# -- per-host budget/ladder planning (the ISSUE 15 satellite fix) -------------
+
+class _FakeMesh:
+    """Duck-typed mesh: utils/budget.mesh_local_shape only reads
+    .shape (an axis-name -> size mapping)."""
+
+    def __init__(self, **shape):
+        self.shape = shape
+
+
+def test_mesh_local_shape_per_host_division():
+    from agnes_tpu.utils.budget import mesh_local_shape
+
+    pod = _FakeMesh(slice=2, data=1, val=2)
+    # global figure over the global mesh: per-device = (I/2, V/2)
+    assert mesh_local_shape(pod, 8, 4) == (4, 2)
+    # a HOST'S slice (I already divided by hosts): divide only by the
+    # data extent one host owns — NOT by the pod-wide extent
+    assert mesh_local_shape(pod, 4, 4, n_hosts=2) == (4, 2)
+    # the pre-fix behavior under-claimed by n_hosts:
+    assert mesh_local_shape(pod, 4, 4) == (2, 2)
+    with pytest.raises(ValueError):
+        mesh_local_shape(_FakeMesh(slice=1, data=3, val=1), 6, 4,
+                         n_hosts=2)          # 3 devices over 2 hosts
+
+
+def test_plan_dense_ladder_sized_to_the_host_slice():
+    from agnes_tpu.serve.batcher import ShapeLadder
+
+    hbm = 1 << 34
+    pod = ShapeLadder.plan_dense(8, 4, local_shape=(4, 2), n_hosts=2,
+                                 min_rung=4, hbm_bytes=hbm)
+    one = ShapeLadder.plan_dense(4, 4, local_shape=(4, 2),
+                                 min_rung=4, hbm_bytes=hbm)
+    glob = ShapeLadder.plan_dense(8, 4, local_shape=(4, 2),
+                                  min_rung=4, hbm_bytes=hbm)
+    # hosts=2 over the global figure == a single host planning its
+    # own slice; the unfixed global plan paced rungs 2x too big
+    assert pod.rungs == one.rungs
+    assert glob.max_rung == 2 * pod.max_rung
+    with pytest.raises(ValueError):
+        ShapeLadder.plan_dense(9, 4, local_shape=(4, 2), n_hosts=2,
+                               hbm_bytes=hbm)
+
+
+# -- heartbeat schema v2 (host stamp) -----------------------------------------
+
+def test_heartbeat_v2_host_stamp(tmp_path):
+    from agnes_tpu.utils.flightrec import (
+        Heartbeat,
+        SCHEMA_VERSION,
+        read_heartbeat,
+        validate_heartbeat_line,
+    )
+
+    assert SCHEMA_VERSION >= 2
+    path = str(tmp_path / "hb.ndjson")
+    line = Heartbeat(path, host_id=3).beat()
+    assert line["host_id"] == 3 and line["process_index"] == 3
+    lines, bad = read_heartbeat(path)
+    assert not bad and lines[0]["host_id"] == 3
+    # single-process trails omit the stamp and stay valid (v1 shape)
+    p1 = str(tmp_path / "hb1.ndjson")
+    l1 = Heartbeat(p1).beat()
+    assert "host_id" not in l1
+    assert validate_heartbeat_line(l1) == []
+    # a mistyped stamp fails the schema the way a bad seq does
+    wrong = dict(l1, host_id="zero")
+    assert any("host_id" in p for p in validate_heartbeat_line(wrong))
+
+
+def test_pod_postmortem_ranks_the_first_silent_host(tmp_path):
+    import time
+
+    from agnes_tpu.utils.flightrec import render_pod_postmortem
+
+    now = time.time()
+    paths = []
+    for host, age in ((0, 500.0), (1, 2.0)):
+        p = str(tmp_path / f"hb{host}.ndjson")
+        rec = {"v": 2, "kind": "hb", "seq": 0, "t": now - age,
+               "pid": 1, "uptime_s": 1.0, "interval_s": 1.0,
+               "host_id": host, "process_index": host}
+        with open(p, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+        paths.append(p)
+    out = render_pod_postmortem(paths + [str(tmp_path / "gone")],
+                                now=now)
+    lines = out.splitlines()
+    order = [k for k, ln in enumerate(lines)
+             if "UNREADABLE" in ln or "host 0:" in ln
+             or "host 1:" in ln]
+    # unreadable (never beat) first, then host 0 (500s stale), then
+    # host 1 (fresh) — the wedge-order ranking
+    assert "UNREADABLE" in lines[order[0]]
+    assert "host 0:" in lines[order[1]] and "STALE" in lines[order[1]]
+    assert "host 1:" in lines[order[2]]
+
+
+def test_metrics_cli_multi_file_check_and_merge(tmp_path, capsys):
+    from agnes_tpu.utils.flightrec import Heartbeat
+    from agnes_tpu.utils.metrics_cli import main
+
+    p0 = str(tmp_path / "h0.ndjson")
+    p1 = str(tmp_path / "h1.ndjson")
+    Heartbeat(p0, host_id=0).beat()
+    Heartbeat(p1, host_id=1).beat()
+    assert main(["--check", p0, p1]) == 0
+    out = capsys.readouterr().out
+    assert "host_id 0" in out and "host_id 1" in out
+    # merged postmortem renders the pod timeline
+    assert main([p0, p1]) == 0
+    out = capsys.readouterr().out
+    assert "pod heartbeat merge: 2 trail(s)" in out
+    # a missing file fails --check pod-wide
+    assert main(["--check", p0, str(tmp_path / "nope")]) == 2
+    capsys.readouterr()                      # clear the check output
+    # single-path --json keeps its historical record shape
+    assert main(["--json", p0]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["path"] == p0 and rec["valid_lines"] == 1
+
+
+# -- pod coordinator (single-process degenerate + digests) --------------------
+
+def test_pod_coordinator_single_process_degenerates():
+    c = PodCoordinator(n_hosts=1, host=0)
+    c.agree(("entry", (1, 2), ((3,), "int32")))
+    c.barrier("warmup_enter", ("plan",))
+    out = c.allgather_bytes(np.arange(4, dtype=np.uint8))
+    np.testing.assert_array_equal(out, [[0, 1, 2, 3]])
+    assert c.agrees == 2 and c.barriers == 1
+
+
+def test_plan_digest_stability():
+    a = plan_digest(("e", (1,), ((2, 3), "int32")))
+    assert a == plan_digest(("e", (1,), ((2, 3), "int32")))
+    assert a != plan_digest(("e", (1,), ((2, 4), "int32")))
+    assert len(a) == 16
+
+
+def test_agree_divergence_fails_loudly_naming_hosts():
+    """A mismatched dispatch plan raises PodDivergenceError naming
+    the differing host(s) — the transport is stubbed so the digest-
+    compare logic tests without a jax.distributed pod."""
+    from agnes_tpu.distributed.pod import PodDivergenceError
+
+    class _Stub(PodCoordinator):
+        def allgather_bytes(self, frame):
+            other = np.frombuffer(
+                plan_digest(("other", "plan")), np.uint8)
+            return np.stack([np.asarray(frame, np.uint8), other])
+
+    c = _Stub(n_hosts=2, host=0)
+    with pytest.raises(PodDivergenceError) as e:
+        c.agree(("entry", (3,), "sig"))
+    assert "[1]" in str(e.value)
+    # matching plans pass (host 1's frame == ours)
+
+    class _Same(PodCoordinator):
+        def allgather_bytes(self, frame):
+            return np.stack([np.asarray(frame, np.uint8)] * 2)
+
+    _Same(n_hosts=2, host=0).agree(("entry", (3,), "sig"))
+
+
+def test_coordinator_beats_monitor_on_gather():
+    clk = {"t": 0.0}
+    m = StragglerMonitor(2, 0, dead_after_s=30, straggler_after_s=5,
+                         clock=lambda: clk["t"])
+    c = PodCoordinator(n_hosts=1, host=0, monitor=m)
+    clk["t"] = 100.0
+    c.allgather_bytes(np.zeros(1, np.uint8))
+    assert m.check() == []
+
+
+# -- hot-path map coverage (rot guard) ----------------------------------------
+
+def test_lint_hot_paths_cover_distributed_plane():
+    from agnes_tpu.analysis.lint import HOT_PATHS
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert "agnes_tpu/distributed/shard.py" in HOT_PATHS
+    assert "agnes_tpu/distributed/driver.py" in HOT_PATHS
+    for rel, funcs in HOT_PATHS.items():
+        path = os.path.join(repo, rel)
+        assert os.path.exists(path), f"HOT_PATHS rot: {rel}"
+        src = open(path).read()
+        for fn in funcs:
+            assert f"def {fn}(" in src, f"{rel} lost hot fn {fn}"

@@ -1,0 +1,109 @@
+"""Multi-host distributed serve: the spawned differential (ISSUE 15
+acceptance).
+
+Every plane runs in its OWN child interpreter (spawn_pod), composing
+with the XLA:CPU child-interpreter discipline (tests/conftest.py):
+two jax.distributed pod processes (2 faked CPU devices each, gloo
+collectives), one single-process mesh-serve comparison over the SAME
+(slice=2, data=1, val=2) global mesh shape, and one offline fused
+dense reference.  The parent never touches jax — it compares the
+dumped state/tally npz blocks leaf-for-leaf.
+
+Slow: each child pays its own sharded/dense compile (the persistent
+cache is deliberately off)."""
+
+import numpy as np
+import pytest
+
+I, V, HEIGHTS = 4, 4, 2
+N_HOSTS, DPH, N_VAL = 2, 2, 2
+
+
+@pytest.mark.slow
+def test_multihost_serve_bit_identical(tmp_path):
+    """2-process multi-host serve == single-process mesh serve ==
+    offline fused: state/tally leaf-for-leaf, decision stats equal,
+    zero unexpected retraces and zero unwarmed compiles on every
+    host, one parseable host-id-stamped heartbeat per process."""
+    from agnes_tpu.distributed.smoke import spawn_pod
+    from agnes_tpu.utils.metrics_cli import main as metrics_main
+
+    res = spawn_pod(N_HOSTS, instances=I, validators=V,
+                    heights=HEIGHTS, devices_per_host=DPH,
+                    n_val=N_VAL, out_dir=str(tmp_path),
+                    timeout_s=1500, heartbeat=True, dump_state=True,
+                    extra_modes=["single", "offline"])
+    assert not res["killed"], res["paths"]
+    for rec in res["pod"] + [res["single"], res["offline"]]:
+        assert "error" not in rec, (rec, res["paths"])
+
+    # per-host serve-plane invariants
+    for rec in res["pod"]:
+        assert rec["retrace_unexpected"] == 0, rec
+        assert rec["rejected_signature_device"] == 0, rec
+        assert rec["offladder_builds"] == 0, rec
+        assert rec["host_fallback_builds"] == 0, rec
+        # zero unwarmed compiles: the ONLY compiled dispatch entry is
+        # the warmed global-SPMD fused signed step
+        assert rec["compile_entries"] == ["sharded_step_seq_signed"], \
+            rec
+        assert rec["warmed_shapes"] == 1
+        # the pod front door screened the other host's share
+        assert rec["foreign_rejects"] == \
+            (HEIGHTS + 1) * 2 * (I // N_HOSTS) * V
+        assert rec["decisions_total"] == (I // N_HOSTS) * (HEIGHTS + 1)
+        # the gather gave every host the POD-wide first-decision view
+        assert rec["pod_decisions"] == I
+    # both hosts gathered the IDENTICAL decision rows, covering every
+    # global instance with the decided value
+    rows0, rows1 = (r["pod_decision_rows"] for r in res["pod"])
+    assert rows0 == rows1
+    assert sorted(r[0] for r in rows0) == list(range(I))
+    assert all(r[3] == 7 for r in rows0)
+
+    assert res["single"]["decisions_total"] == I * (HEIGHTS + 1)
+    assert res["offline"]["decisions_total"] == I * (HEIGHTS + 1)
+
+    # leaf-for-leaf: host blocks concatenate host-major == global
+    pods = [np.load(res["paths"][f"pod{k}"]["npz"])
+            for k in range(N_HOSTS)]
+    single = np.load(res["paths"]["single"]["npz"])
+    offline = np.load(res["paths"]["offline"]["npz"])
+    assert set(single.files) == set(offline.files) == set(pods[0].files)
+    for key in single.files:
+        merged = np.concatenate([p[key] for p in pods], axis=0)
+        np.testing.assert_array_equal(
+            merged, single[key], err_msg=f"{key}: pod vs single-mesh")
+        np.testing.assert_array_equal(
+            merged, offline[key], err_msg=f"{key}: pod vs offline")
+
+    # one parseable host-id-stamped heartbeat trail per process
+    hbs = [res["paths"][f"pod{k}"]["heartbeat"]
+           for k in range(N_HOSTS)]
+    assert metrics_main(["--check"] + hbs) == 0
+    from agnes_tpu.utils.flightrec import read_heartbeat
+
+    for k, path in enumerate(hbs):
+        lines, _bad = read_heartbeat(path)
+        assert lines and all(ln["host_id"] == k for ln in lines), path
+
+
+@pytest.mark.slow
+def test_multihost_native_admission_front_end(tmp_path):
+    """The PR 14 rung: one native C++ admission front-end per host
+    feeding its host-local shard — same pod, native_admission=True,
+    same invariants (the native queue is byte-compatible, so the pod
+    plane's decisions/screens are unchanged)."""
+    from agnes_tpu.distributed.smoke import spawn_pod
+
+    res = spawn_pod(N_HOSTS, instances=I, validators=V,
+                    heights=HEIGHTS, devices_per_host=DPH,
+                    n_val=N_VAL, out_dir=str(tmp_path),
+                    timeout_s=1500, native_admission=True)
+    assert not res["killed"], res["paths"]
+    for rec in res["pod"]:
+        assert "error" not in rec, (rec, res["paths"])
+        assert rec["native_admission"] is True
+        assert rec["retrace_unexpected"] == 0, rec
+        assert rec["rejected_signature_device"] == 0, rec
+        assert rec["pod_decisions"] == I
