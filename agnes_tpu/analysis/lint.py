@@ -69,6 +69,14 @@ HOT_PATHS: Dict[str, Set[str]] = {
     "agnes_tpu/distributed/shard.py": {
         "submit", "submit_local", "pump",
     },
+    # ISSUE 17: the elastic tick's host-side work — front-door
+    # routing (mine/adopted/foreign), held-gossip bookkeeping, frame
+    # pack/unpack feeding the per-tick allgather — all runs between
+    # negotiated dispatches on every host
+    "agnes_tpu/distributed/elastic.py": {
+        "submit", "tick", "_hold", "_take_reroute",
+        "_ingest_reroute", "_local_decision_frame",
+    },
     "agnes_tpu/distributed/driver.py": {
         "_lift", "_dense_dispatch_fn", "_make_sharded_seq",
         "step_async", "_agree", "_plan_sig",

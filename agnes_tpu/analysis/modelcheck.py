@@ -1260,9 +1260,13 @@ def emit_corpus(directory: str, include_mutants: bool = True) -> List[str]:
                 f.write("\n")
             written.append(path)
     from agnes_tpu.analysis import admission_mc as am
+    from agnes_tpu.analysis import membership_mc as mm
 
     written += am.emit_admission_corpus(
         os.path.join(directory, "admission"),
+        include_mutants=include_mutants)
+    written += mm.emit_membership_corpus(
+        os.path.join(directory, "membership"),
         include_mutants=include_mutants)
     return written
 
@@ -1567,9 +1571,25 @@ def _scope_worker(task: dict) -> dict:
     """One exploration shard in a spawned interpreter (the agnes_lint
     --pass all pattern): configs are independent, so they parallelize
     across cores; JSON-able dicts cross the process boundary.  `kind`
-    routes between the consensus domain and the serve-plane admission
-    domain (analysis/admission_mc.py) — same engine, same record
-    shape."""
+    routes between the consensus domain, the serve-plane admission
+    domain (analysis/admission_mc.py) and the pod-membership domain
+    (analysis/membership_mc.py) — same engine, same record shape."""
+    if task["config"].get("kind") == "membership":
+        from agnes_tpu.analysis import membership_mc as mm
+
+        cfg = mm.MembershipMCConfig.from_json(task["config"])
+        rep = mm.explore_membership(cfg,
+                                    deadline_at=task["deadline_at"],
+                                    max_states=task.get("max_states"))
+        for ce in rep.violations:
+            try:
+                ce.minimized = mm.minimize_membership(
+                    cfg, ce.schedule, ce.violation.property)
+            except AssertionError:
+                ce.minimized = None
+        out = rep.to_json()
+        out["kind"] = "membership"
+        return out
     if task["config"].get("kind") == "admission":
         from agnes_tpu.analysis import admission_mc as am
 
@@ -1605,23 +1625,27 @@ def run_scope(scope: str, workers: Optional[int] = None, por: bool = True,
               deadline_at: Optional[float] = None,
               max_states: Optional[int] = None,
               sym: bool = True) -> dict:
-    """Explore every config of `scope` — the consensus envelope AND
-    the serve-plane admission envelope (admission_mc.ADMISSION_SCOPES)
-    — frontier-sharded over spawned workers; aggregate
-    states/violations (the CLI/gate record).  Consensus shards run
-    symmetry-reduced by default (`sym`); the aggregate report carries
-    the measured orbit reduction against the PR 6 unreduced baseline
-    (`SYM_BASELINE_STATES`) and the admission-model state total."""
+    """Explore every config of `scope` — the consensus envelope, the
+    serve-plane admission envelope (admission_mc.ADMISSION_SCOPES)
+    AND the pod-membership envelope (ISSUE 17,
+    membership_mc.MEMBERSHIP_SCOPES) — frontier-sharded over spawned
+    workers; aggregate states/violations (the CLI/gate record).
+    Consensus shards run symmetry-reduced by default (`sym`); the
+    aggregate report carries the measured orbit reduction against the
+    PR 6 unreduced baseline (`SYM_BASELINE_STATES`) and the
+    admission/membership-model state totals."""
     from agnes_tpu.analysis.admission_mc import ADMISSION_SCOPES
+    from agnes_tpu.analysis.membership_mc import MEMBERSHIP_SCOPES
 
     configs = SCOPES[scope]
     adm_configs = ADMISSION_SCOPES.get(scope, ())
+    mem_configs = MEMBERSHIP_SCOPES.get(scope, ())
     tasks = [{"config": c.to_json(), "por": por, "sym": sym,
               "deadline_at": deadline_at, "max_states": max_states}
              for c in configs]
     tasks += [{"config": c.to_json(), "por": por,
                "deadline_at": deadline_at, "max_states": max_states}
-              for c in adm_configs]
+              for c in (*adm_configs, *mem_configs)]
     t0 = time.perf_counter()
     if workers is None:
         workers = min(len(tasks), max(2, os.cpu_count() or 2))
@@ -1647,6 +1671,8 @@ def run_scope(scope: str, workers: Optional[int] = None, por: bool = True,
                                 if r["kind"] == "consensus"),
         "admission_states": sum(r["states"] for r in results
                                 if r["kind"] == "admission"),
+        "membership_states": sum(r["states"] for r in results
+                                 if r["kind"] == "membership"),
         # ISSUE 9 domain splits: canonical states visited by the shards
         # carrying validator-set epochs / a sleepy-churn budget (a shard
         # can be in both; the ci.sh gate floors the COMBINED count)
@@ -1726,10 +1752,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     t0 = time.perf_counter()
     if args.self_test:
         from agnes_tpu.analysis.admission_mc import self_test_admission
+        from agnes_tpu.analysis.membership_mc import (
+            self_test_membership,
+        )
 
         mut = self_test(por=not args.no_por)
         report = {"self_test": mut,
                   "self_test_admission": self_test_admission(),
+                  "self_test_membership": self_test_membership(),
                   "ok": True,
                   "seconds": round(time.perf_counter() - t0, 1)}
         print(json.dumps(report, sort_keys=True), flush=True)
@@ -1750,6 +1780,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         MODELCHECK_CHURN_STATES,
         MODELCHECK_EPOCH_ORBIT_REDUCTION,
         MODELCHECK_EPOCH_STATES,
+        MODELCHECK_MEMBERSHIP_STATES,
         MODELCHECK_STATES_EXPLORED,
         MODELCHECK_SYM_ORBIT_REDUCTION,
         MODELCHECK_VIOLATIONS,
@@ -1760,6 +1791,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         MODELCHECK_VIOLATIONS: report["violations"],
         MODELCHECK_SYM_ORBIT_REDUCTION: report["sym_orbit_reduction"],
         MODELCHECK_ADMISSION_STATES: report["admission_states"],
+        MODELCHECK_MEMBERSHIP_STATES: report["membership_states"],
         MODELCHECK_EPOCH_STATES: report["epoch_states"],
         MODELCHECK_CHURN_STATES: report["churn_states"],
         MODELCHECK_EPOCH_ORBIT_REDUCTION:

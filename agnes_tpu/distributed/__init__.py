@@ -1,18 +1,34 @@
 """Multi-host distributed serve: pod-scale drivers over
-jax.distributed (ISSUE 15).
+jax.distributed (ISSUE 15) + the elastic membership plane (ISSUE 17).
 
 Layout:
-  topology.py  jax-free sharding math, decision codec, liveness
-  pod.py       lockstep agree/barrier + byte-frame allgather
-  driver.py    DistributedDriver (global-SPMD dispatch, local views)
-  shard.py     HostShard (per-host serve front-end)
-  smoke.py     spawnable worker + pod spawner (CI / bench / tests)
+  topology.py    jax-free sharding math, decision codec, liveness
+  membership.py  jax-free repartition/re-lift/negotiation math +
+                 the MembershipEpoch protocol
+  pod.py         lockstep agree/barrier + byte-frame allgather
+  driver.py      DistributedDriver (global-SPMD dispatch, local views)
+  shard.py       HostShard (per-host serve front-end)
+  elastic.py     ElasticShard (per-tick negotiation, join/leave)
+  smoke.py       spawnable worker + pod spawner (CI / bench / tests)
 
 Imports are LAZY for every jax-bearing member (the serve/__init__
-pattern): the topology layer, the admission path and the CLIs stay
-importable with no backend.
+pattern): the topology/membership layers, the admission path and the
+CLIs stay importable with no backend.  (elastic.py itself imports
+jax-free, but it pulls shard.py -> serve, so it stays lazy here.)
 """
 
+from agnes_tpu.distributed.membership import (  # noqa: F401 (jax-free)
+    MembershipEpoch,
+    MembershipError,
+    MembershipView,
+    Repartition,
+    TickSlot,
+    merge_tick_plans,
+    partition_ranges,
+    relift_ranges,
+    relift_tree,
+    validate_partition,
+)
 from agnes_tpu.distributed.topology import (  # noqa: F401 (jax-free)
     DeadHostError,
     HostPlan,
@@ -38,6 +54,12 @@ _LAZY = {
     "fetch_local_block": ("agnes_tpu.distributed.driver",
                           "fetch_local_block"),
     "HostShard": ("agnes_tpu.distributed.shard", "HostShard"),
+    "ElasticShard": ("agnes_tpu.distributed.elastic", "ElasticShard"),
+    "ElasticFrame": ("agnes_tpu.distributed.elastic", "ElasticFrame"),
+    "pack_elastic_frame": ("agnes_tpu.distributed.elastic",
+                           "pack_elastic_frame"),
+    "unpack_elastic_frame": ("agnes_tpu.distributed.elastic",
+                             "unpack_elastic_frame"),
     "spawn_pod": ("agnes_tpu.distributed.smoke", "spawn_pod"),
 }
 
@@ -55,5 +77,8 @@ __all__ = [
     "DeadHostError", "HostPlan", "PodConfigError", "PodDecision",
     "StragglerMonitor", "frame_capacity_bytes", "pack_decision_frame",
     "rebase_wire_instances", "unpack_decision_frame",
-    "unpack_decision_frames", *_LAZY,
+    "unpack_decision_frames",
+    "MembershipEpoch", "MembershipError", "MembershipView",
+    "Repartition", "TickSlot", "merge_tick_plans", "partition_ranges",
+    "relift_ranges", "relift_tree", "validate_partition", *_LAZY,
 ]

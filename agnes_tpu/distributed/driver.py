@@ -362,13 +362,17 @@ class DistributedDriver(DeviceDriver):
             self.rejected_signature_device += n
             self.stats.votes_ingested -= n
 
-    def _local_shape(self):
+    def _local_shape(self, n_live=None):
         from agnes_tpu.utils.budget import mesh_local_shape
 
         # self.I is already the per-HOST slice: divide only by the
-        # data extent this host owns (the ISSUE 15 satellite fix)
+        # data extent this host owns (the ISSUE 15 satellite fix).
+        # `n_live` < n_hosts re-plans against a shrunken elastic
+        # membership (ISSUE 17): pass the OWNED instance slice of the
+        # live partition as a bigger I via the caller's ladder replan
+        # — this hook only threads the live divisor through.
         return mesh_local_shape(self.mesh, self.I, self.V,
-                                n_hosts=self.n_hosts)
+                                n_hosts=self.n_hosts, n_live=n_live)
 
     def state_copies(self):
         """Warmup's throwaway state/tally copies, as a jitted pod

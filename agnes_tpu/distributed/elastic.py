@@ -1,0 +1,604 @@
+"""Elastic pod: per-tick shape negotiation + epoch-boundary host
+join/leave over the static pod plane (ISSUE 17).
+
+PR 15's pod serves only homogeneous, immortal hosts: a deadline-closed
+P=2 batch on one host against a full P=3 batch on another is a
+`PodDivergenceError`, and a dead peer fails every later drain closed.
+This module layers the membership-and-negotiation control plane from
+`membership.py` onto `HostShard` so BOTH become survivable:
+
+* **Per-tick plan negotiation** (`ElasticShard.tick`): each host
+  closes its micro-batch, stages builds WITHOUT dispatching, and
+  exchanges its staged shape plan — (kind, P, rung, BLS class rung)
+  tick slots — in the SAME fixed-size allgather frame that carries
+  its newly latched decisions, membership intents and re-routed
+  gossip.  The merged plan is the per-slot MAX
+  (membership.merge_tick_plans); every host pads up to it
+  (pipeline.pad_staged_to / stage_padding — empty phases and all-zero
+  dense rows are state-machine no-ops) and only then dispatches, so
+  `PodCoordinator.agree` sees IDENTICAL plans under honest
+  heterogeneity and keeps its full strictness for statics.  Padding
+  lands exclusively on shapes `ServePipeline.warmup` compiled —
+  `warmup_covers` is checked BEFORE dispatch and the retrace sentinel
+  would catch anything that slipped past it — so negotiation costs
+  zero new compiles.
+* **Epoch-boundary join/leave**: leave/join intents (explicit
+  `announce_leave`/`announce_join`, or verdicts from the attached
+  StragglerMonitor) latch mid-epoch and apply at boundaries
+  (`tick(boundary=True)` — callers invoke it at height boundaries, a
+  lockstep point by construction).  A departed host is sleepy churn
+  at pod granularity: its PROCESS stays in the jax.distributed
+  fabric dispatching pure padding (the global-SPMD mesh cannot
+  shrink), while its instance ranges repartition onto the survivors.
+  A survivor's front door HOLDS gossip for adopted ranges (bounded by
+  `reroute_capacity`; overflow is counted, dropped, and event-logged
+  — bounded degradation, never a wedge) and re-routes the held bytes
+  — global-id 96-byte wire records, instance fields intact — through
+  the frame once the owner's range is live again; the readmitted host
+  replays them in height order and catches up.  What still fails
+  closed: a host dead to the FABRIC (not just the membership plane)
+  still hangs jax collectives — the monitor without a membership
+  plane attached keeps raising DeadHostError for exactly that
+  reason.
+
+The frame codec and negotiator below are jax-free (numpy + the
+topology codec) so tests/test_elastic.py exercises them in-process;
+only ElasticShard's serve plumbing touches jax, via HostShard.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from agnes_tpu.bridge.native_ingest import REC_SIZE
+from agnes_tpu.distributed.membership import (
+    KIND_DENSE_SIGNED,
+    KIND_NAMES,
+    KIND_SIGNED,
+    KIND_UNSIGNED,
+    MembershipEpoch,
+    MembershipError,
+    Repartition,
+    TickSlot,
+    merge_tick_plans,
+)
+from agnes_tpu.distributed.shard import HostShard
+from agnes_tpu.distributed.topology import (
+    PodDecision,
+    frame_capacity_bytes,
+    pack_decision_frame,
+    unpack_decision_frame,
+    wire_instance_ids,
+)
+
+# -- the combined elastic frame ----------------------------------------------
+#
+# One fixed-size allgather row per host per tick (all-zero padding —
+# every host packs the identical capacity, so the collective shape is
+# static):
+#
+#   [0:28)  header, 7 LE u32: magic 'ELA1' | host | epoch |
+#           alive_mask | leave_mask | join_mask | reserved
+#   [28:..) slot section: u32 n_slots + max_slots x 16-byte slots
+#           (u32 kind | n_phases | rung | bls_class_rung)
+#   [..:..) decision section: the UNCHANGED ISSUE-15 decision frame
+#           (topology.pack_decision_frame: u32 count + u32 host +
+#           max_decisions x 96-byte wire records)
+#   [..:..) reroute section: u32 nbytes + reroute_cap raw bytes of
+#           held 96-byte wire records, GLOBAL instance ids intact
+#
+# Masks are u32 bitmaps (bit h = host h), capping the elastic pod at
+# 32 processes — well past any pod this repo drives today, and the
+# reserved word is where a wider encoding would negotiate itself in.
+
+ELASTIC_MAGIC = 0x454C4131          # 'ELA1'
+EHDR = 28
+SLOT_BYTES = 16
+MAX_POD_HOSTS = 32
+
+
+class ElasticFrame(NamedTuple):
+    """One host's unpacked negotiation frame."""
+
+    host: int
+    epoch: int
+    alive_mask: int
+    leave_mask: int
+    join_mask: int
+    slots: Tuple[TickSlot, ...]
+    decisions: List[PodDecision]
+    reroute: bytes
+
+
+def elastic_frame_capacity(max_slots: int, max_decisions: int,
+                           reroute_cap: int) -> int:
+    """Total frame bytes for the given section capacities."""
+    return (EHDR + 4 + int(max_slots) * SLOT_BYTES
+            + frame_capacity_bytes(max_decisions)
+            + 4 + int(reroute_cap))
+
+
+def pack_elastic_frame(host: int, epoch: int, alive_mask: int,
+                       leave_mask: int, join_mask: int,
+                       slots: Sequence[TickSlot],
+                       decision_frame: np.ndarray,
+                       reroute: bytes, *,
+                       max_slots: int,
+                       reroute_cap: int) -> np.ndarray:
+    """[frame_bytes] uint8 — layout above.  `decision_frame` is the
+    topology.pack_decision_frame output (embedded verbatim, so the
+    decision codec stays ONE implementation)."""
+    if len(slots) > max_slots:
+        raise MembershipError(
+            f"{len(slots)} tick slots exceed the negotiated frame "
+            f"capacity {max_slots}")
+    if len(reroute) > reroute_cap:
+        raise MembershipError(
+            f"{len(reroute)} reroute bytes exceed capacity "
+            f"{reroute_cap}")
+    if len(reroute) % REC_SIZE:
+        raise MembershipError(
+            f"reroute payload {len(reroute)}B is not whole "
+            f"{REC_SIZE}-byte records")
+    dec = np.asarray(decision_frame, np.uint8)
+    frame = np.zeros(
+        elastic_frame_capacity(max_slots, 0, reroute_cap)
+        + len(dec) - frame_capacity_bytes(0), np.uint8)
+    hdr = np.asarray([ELASTIC_MAGIC, host, epoch, alive_mask,
+                      leave_mask, join_mask, 0], np.uint32)
+    frame[:EHDR] = hdr.view(np.uint8)
+    o = EHDR
+    frame[o:o + 4] = np.asarray([len(slots)],
+                                np.uint32).view(np.uint8)
+    o += 4
+    for s in slots:
+        frame[o:o + SLOT_BYTES] = np.asarray(
+            [s.kind, s.n_phases, s.rung, s.bls_class_rung],
+            np.uint32).view(np.uint8)
+        o += SLOT_BYTES
+    o = EHDR + 4 + max_slots * SLOT_BYTES
+    frame[o:o + len(dec)] = dec
+    o += len(dec)
+    frame[o:o + 4] = np.asarray([len(reroute)],
+                                np.uint32).view(np.uint8)
+    o += 4
+    if reroute:
+        frame[o:o + len(reroute)] = np.frombuffer(reroute, np.uint8)
+    return frame
+
+
+def unpack_elastic_frame(row, max_slots: int, max_decisions: int,
+                         reroute_cap: int) -> ElasticFrame:
+    """Inverse of pack_elastic_frame for one gathered row."""
+    row = np.asarray(row, np.uint8)
+    want = elastic_frame_capacity(max_slots, max_decisions,
+                                  reroute_cap)
+    if len(row) != want:
+        raise MembershipError(
+            f"elastic frame is {len(row)}B, capacities say {want}B")
+    hdr = row[:EHDR].view(np.uint32)
+    if int(hdr[0]) != ELASTIC_MAGIC:
+        raise MembershipError(
+            f"bad elastic frame magic {int(hdr[0]):#x}")
+    o = EHDR
+    n_slots = int(row[o:o + 4].view(np.uint32)[0])
+    if n_slots > max_slots:
+        raise MembershipError(
+            f"frame claims {n_slots} slots > capacity {max_slots}")
+    o += 4
+    slots = []
+    for k in range(n_slots):
+        kind, n_phases, rung, bcr = (
+            int(x) for x in
+            row[o + k * SLOT_BYTES:
+                o + (k + 1) * SLOT_BYTES].view(np.uint32))
+        slots.append(TickSlot(kind, n_phases, rung, bcr))
+    o = EHDR + 4 + max_slots * SLOT_BYTES
+    dlen = frame_capacity_bytes(max_decisions)
+    decisions = unpack_decision_frame(row[o:o + dlen])
+    o += dlen
+    nre = int(row[o:o + 4].view(np.uint32)[0])
+    if nre > reroute_cap:
+        raise MembershipError(
+            f"frame claims {nre} reroute bytes > capacity "
+            f"{reroute_cap}")
+    o += 4
+    reroute = row[o:o + nre].tobytes()
+    return ElasticFrame(
+        host=int(hdr[1]), epoch=int(hdr[2]),
+        alive_mask=int(hdr[3]), leave_mask=int(hdr[4]),
+        join_mask=int(hdr[5]), slots=tuple(slots),
+        decisions=decisions, reroute=reroute)
+
+
+# -- the elastic shard --------------------------------------------------------
+
+class ElasticShard(HostShard):
+    """HostShard + the membership/negotiation plane (module
+    docstring).  Drop-in everywhere HostShard goes; the ONE new
+    lockstep obligation is `tick()` — every live-or-sleeping host
+    calls it at the same protocol points (the smoke drives a fixed
+    tick schedule per height), because the tick's allgather is a pod
+    collective."""
+
+    def __init__(self, driver, batcher, pubkeys=None, *,
+                 membership: Optional[MembershipEpoch] = None,
+                 rejoin_holddown_s: float = 0.0,
+                 max_slots: int = 8,
+                 reroute_capacity: Optional[int] = None,
+                 clock=time.monotonic,
+                 **service_kwargs):
+        super().__init__(driver, batcher, pubkeys, clock=clock,
+                         **service_kwargs)
+        if self.n_hosts > MAX_POD_HOSTS:
+            raise MembershipError(
+                f"elastic frame masks cap the pod at "
+                f"{MAX_POD_HOSTS} hosts ({self.n_hosts} configured)")
+        self.membership = membership if membership is not None else \
+            MembershipEpoch(self.n_hosts, driver.global_I,
+                            rejoin_holddown_s=rejoin_holddown_s,
+                            clock=clock)
+        if (self.membership.view.n_hosts != self.n_hosts
+                or self.membership.view.n_instances
+                != driver.global_I):
+            raise MembershipError(
+                f"membership plane ({self.membership.view.n_hosts} "
+                f"hosts x {self.membership.view.n_instances} "
+                f"instances) does not match the pod "
+                f"({self.n_hosts} x {driver.global_I})")
+        # dead-peer verdicts degrade to leave intents from here on;
+        # resumed evidence latches the join (topology.StragglerMonitor
+        # recovery path — the ISSUE 17 satellite this plane consumes)
+        self.monitor.attach_membership(self.membership)
+        self.max_slots = int(max_slots)
+        self.reroute_capacity = (
+            int(reroute_capacity) if reroute_capacity is not None
+            else 4 * self.plan.local_instances * driver.V * REC_SIZE)
+        self._frame_bytes = elastic_frame_capacity(
+            self.max_slots, self._frame_cap, self.reroute_capacity)
+        # held gossip for ADOPTED ranges: [REC_SIZE] uint8 record rows
+        # in GLOBAL instance ids, replayable byte-for-byte
+        self._held: List[np.ndarray] = []
+        self._clock = clock
+        self.negotiation_ticks = 0
+        self.padded_slots = 0          # slots this host padded up/into
+        self.adopted_held = 0          # records held for away owners
+        self.held_dropped = 0          # capacity overflow (degrades)
+        self.reroute_sent = 0
+        self.reroute_received = 0
+        self.boundaries = 0            # applied repartitions
+        self._mirror_membership()
+
+    # -- intents -------------------------------------------------------------
+
+    def announce_leave(self, host: Optional[int] = None) -> bool:
+        """Latch a leave intent (default: THIS host — planned
+        drain/maintenance).  Broadcast on the next tick, applied at
+        the next boundary."""
+        return self.membership.note_leave(
+            self.host if host is None else host)
+
+    def announce_join(self, host: Optional[int] = None) -> bool:
+        """Latch a rejoin intent (default: THIS host)."""
+        return self.membership.note_join(
+            self.host if host is None else host)
+
+    @property
+    def serving(self) -> bool:
+        """Does the CURRENT epoch assign this host any instances?"""
+        return self.membership.view.owned_range(self.host) is not None
+
+    # -- ingress: membership-aware front door --------------------------------
+
+    def submit(self, wire_bytes):
+        """The HostShard screen, elastically: records in this host's
+        static block feed the local service; records in ranges the
+        current epoch ADOPTED onto this host (their owner is away) are
+        HELD for re-routing instead of foreign-rejected; the rest are
+        foreign as before.  Holding is capacity-bounded: overflow
+        drops are counted and event-logged, never a wedge (module
+        docstring)."""
+        buf = np.frombuffer(bytes(wire_bytes), np.uint8)
+        n = len(buf) // REC_SIZE
+        tail = buf[n * REC_SIZE:]
+        if not n:
+            return self.service.submit(tail.tobytes())
+        rec = buf[:n * REC_SIZE].reshape(n, REC_SIZE)
+        inst = wire_instance_ids(rec)
+        mine = (inst >= self.lo) & (inst < self.hi)
+        owned = self.membership.view.owned_range(self.host)
+        adopt = np.zeros(n, bool)
+        if owned is not None:
+            vlo, vhi = owned
+            adopt = (inst >= vlo) & (inst < vhi) & ~mine
+        if adopt.any():
+            self._hold(rec[adopt])
+        foreign = int(n - mine.sum() - adopt.sum())
+        self.foreign_rejects += foreign
+        if foreign:
+            from agnes_tpu.utils.metrics import POD_FOREIGN_REJECTS
+
+            self.service.metrics.count(POD_FOREIGN_REJECTS, foreign)
+        kept = rec[mine]
+        from agnes_tpu.distributed.topology import \
+            shift_instances_inplace
+
+        shift_instances_inplace(kept, -self.lo)
+        return self.service.submit(kept.tobytes() + tail.tobytes())
+
+    def _hold(self, rows: np.ndarray) -> None:
+        free = (self.reroute_capacity // REC_SIZE
+                - len(self._held)) if self.reroute_capacity else 0
+        take = max(0, min(len(rows), free))
+        for r in rows[:take]:
+            self._held.append(r.copy())
+        self.adopted_held += take
+        dropped = len(rows) - take
+        if dropped:
+            self.held_dropped += dropped
+            if self.service.flightrec is not None:
+                self.service.flightrec.event(
+                    "membership_hold_overflow", host=self.host,
+                    dropped=dropped,
+                    epoch=self.membership.view.epoch)
+
+    # -- the negotiation tick ------------------------------------------------
+
+    def _slot_of(self, st) -> TickSlot:
+        """Negotiated shape of one staged build."""
+        n_phases = len(st.phases) + (1 if st.entry else 0)
+        if st.lanes is None:
+            return TickSlot(KIND_UNSIGNED, n_phases)
+        if self.pipeline.dense:
+            return TickSlot(KIND_DENSE_SIGNED, n_phases)
+        return TickSlot(KIND_SIGNED, n_phases,
+                        rung=int(st.lanes.pub.shape[0]))
+
+    def _local_decision_frame(self) -> np.ndarray:
+        """Newly latched LOCAL decisions as the ISSUE-15 frame (the
+        same stamping as HostShard.poll_pod_decisions — the codec and
+        the height bookkeeping stay one implementation's semantics)."""
+        local = self.service.poll_decisions()
+        inst = self.plan.to_global(
+            self.host, np.asarray([d.instance for d in local],  # lint: allow (host list -> array)
+                                  np.int64))
+        fah = self.service.pipeline.first_advance_height
+        hts = np.asarray(  # lint: allow (host list -> array)
+            [fah.get(d.instance,
+                     int(self.service.batcher.heights[d.instance]))
+             for d in local], np.int64)
+        return pack_decision_frame(
+            self.host, inst,
+            np.asarray([(d.value_id if d.value_id is not None else -1)  # lint: allow (host list -> array)
+                        for d in local], np.int64),
+            np.asarray([d.round for d in local], np.int64),  # lint: allow (host list -> array)
+            hts, self._frame_cap)
+
+    def _take_reroute(self, view) -> bytes:
+        """Pop held records whose owner under `view` is ANOTHER live
+        host — the bytes the next frame re-routes (capacity-bounded;
+        leftovers go on later ticks)."""
+        if not self._held:
+            return b""
+        send: List[np.ndarray] = []
+        keep: List[np.ndarray] = []
+        cap = self.reroute_capacity // REC_SIZE
+        for row in self._held:
+            i = int(wire_instance_ids(row[None, :])[0])
+            try:
+                owner = view.owner_of(i)
+            except MembershipError:
+                owner = self.host       # unowned: keep holding
+            if owner != self.host and len(send) < cap:
+                send.append(row)
+            else:
+                keep.append(row)
+        self._held = keep
+        self.reroute_sent += len(send)
+        return b"".join(r.tobytes() for r in send)
+
+    def _ingest_reroute(self, raw: bytes) -> None:
+        """Absorb re-routed records addressed to THIS host's static
+        block (the readmitted owner's catch-up path): global-id wire
+        bytes, screened and rebased like any gossip — but via the
+        LOCAL service directly, so they are never re-held or
+        foreign-counted (the sender already routed them)."""
+        n = len(raw) // REC_SIZE
+        if not n:
+            return
+        rec = np.frombuffer(raw, np.uint8)[:n * REC_SIZE].reshape(
+            n, REC_SIZE).copy()
+        inst = wire_instance_ids(rec)
+        mine = (inst >= self.lo) & (inst < self.hi)
+        if not mine.any():
+            return
+        kept = rec[mine]
+        from agnes_tpu.distributed.topology import \
+            shift_instances_inplace
+
+        shift_instances_inplace(kept, -self.lo)
+        self.reroute_received += int(mine.sum())
+        self.service.submit(kept.tobytes())
+
+    def tick(self, now: Optional[float] = None,
+             boundary: bool = False) -> dict:
+        """One lockstep elastic tick (module docstring): close +
+        stage, negotiate shapes + decisions + intents + reroutes in
+        ONE allgather, pad to the merged plan, dispatch.  With
+        `boundary=True` (callers pass it at height boundaries) the
+        latched membership intents apply after the exchange.  EVERY
+        pod process calls tick at the same protocol points, serving
+        or sleeping — a sleeper stages nothing and dispatches pure
+        padding, which is exactly what keeps the global-SPMD
+        collectives lockstep while its ranges are away."""
+        t0 = self._clock()
+        self.monitor.check()   # degrades to leave intents (attached)
+        # 1. close the micro-batch and stage builds — NO dispatch yet
+        batch = self.service.micro.flush()
+        if batch is not None or self.service.batcher.pending_votes:
+            self.pipeline.stage(batch)
+        staged = self.pipeline._staged
+        slots = tuple(self._slot_of(st) for st in staged)
+        # 2. decisions + intents + (boundary) prospective reroute
+        dec_frame = self._local_decision_frame()
+        prospective = (self.membership.prospective() if boundary
+                       else None)
+        reroute = self._take_reroute(
+            prospective if prospective is not None
+            else self.membership.view)
+        leave_mask, join_mask = self.membership.pending()
+        view = self.membership.view
+        frame = pack_elastic_frame(
+            self.host, view.epoch, view.alive_mask(),
+            leave_mask, join_mask, slots, dec_frame, reroute,
+            max_slots=self.max_slots,
+            reroute_cap=self.reroute_capacity)
+        # 3. ONE allgather: shapes + decisions + intents + reroutes
+        rows = self.coordinator.negotiate(frame)
+        frames = [unpack_elastic_frame(
+            rows[h], self.max_slots, self._frame_cap,
+            self.reroute_capacity) for h in range(self.n_hosts)]
+        # 4. statics stay loud: every host must be IN the same epoch
+        #    looking at the same membership — anything else is a bug
+        #    in the lockstep protocol, not honest heterogeneity
+        for f in frames:
+            if (f.epoch, f.alive_mask) != (view.epoch,
+                                           view.alive_mask()):
+                raise MembershipError(
+                    f"membership diverged: host {f.host} is at epoch "
+                    f"{f.epoch}/alive={f.alive_mask:#x}, host "
+                    f"{self.host} at {view.epoch}/"
+                    f"{view.alive_mask():#x}")
+        # 5. merge + pad + PROVE warmed + dispatch
+        merged = merge_tick_plans([f.slots for f in frames])
+        for slot in merged:
+            if not self.pipeline.warmup_covers(
+                    KIND_NAMES.get(slot.kind, "?"), slot.n_phases,
+                    slot.rung):
+                raise MembershipError(
+                    f"negotiated slot {slot} is outside the warmed "
+                    f"shape set {sorted(self.pipeline.warmed_keys)} "
+                    f"— padding must never buy a live compile")
+        padded = 0
+        for k, slot in enumerate(merged):
+            if k < len(staged):
+                padded += 1 if self.pipeline.pad_staged_to(
+                    staged[k], slot.n_phases) else 0
+            else:
+                self.pipeline.stage_padding(
+                    slot.n_phases,
+                    signed=slot.kind != KIND_UNSIGNED)
+                padded += 1
+        self.padded_slots += padded
+        dispatched = self.pipeline.dispatch_staged()
+        # 6. absorb the pod-wide decision view
+        for f in frames:
+            self.pod_decisions.extend(f.decisions)
+        # 7. fold peer intents in; apply the boundary; then ingest
+        #    reroutes (order matters: a readmitted owner's ranges are
+        #    live again BEFORE its catch-up bytes arrive at the
+        #    service)
+        for f in frames:
+            if f.host != self.host:
+                self.membership.merge_intents(f.leave_mask,
+                                              f.join_mask)
+        rep: Optional[Repartition] = None
+        if boundary:
+            rep = self.membership.boundary()
+            if rep is not None:
+                self.boundaries += 1
+                self._on_boundary(rep)
+        for f in frames:
+            if f.host != self.host and f.reroute:
+                self._ingest_reroute(f.reroute)
+        self.negotiation_ticks += 1
+        wall = self._clock() - t0
+        from agnes_tpu.utils.metrics import POD_NEGOTIATION_WALL_S
+
+        self.service.metrics.observe(POD_NEGOTIATION_WALL_S, wall)
+        return {"dispatched": dispatched, "slots": len(merged),
+                "padded": padded, "epoch": self.membership.view.epoch,
+                "boundary": rep is not None,
+                "negotiation_wall_s": wall}
+
+    def _on_boundary(self, rep: Repartition) -> None:
+        """Applied repartition bookkeeping: flight-recorder events,
+        epoch gauge, readmission counter mirror — the observability
+        satellite's live wiring."""
+        fr = self.service.flightrec
+        if fr is not None:
+            fr.event("membership_boundary",
+                     epoch=rep.new.epoch,
+                     alive=list(rep.new.alive),
+                     joined=list(rep.joined), left=list(rep.left))
+            for src, dst, lo, hi in rep.transfers:
+                fr.event("membership_relift", src=src, dst=dst,
+                         lo=lo, hi=hi, epoch=rep.new.epoch)
+        self._mirror_membership()
+
+    def _mirror_membership(self) -> None:
+        from agnes_tpu.utils.metrics import (
+            POD_HOST_READMISSIONS,
+            POD_MEMBERSHIP_EPOCH,
+        )
+
+        m = self.service.metrics
+        m.gauge(POD_MEMBERSHIP_EPOCH, self.membership.view.epoch)
+        have = m.counters.get(POD_HOST_READMISSIONS, 0)
+        want = self.membership.readmissions
+        if want > have:
+            m.count(POD_HOST_READMISSIONS, want - have)
+
+    # -- ladder replan (the budget satellite's live consumer) ----------------
+
+    def replan_ladder(self, **plan_kwargs):
+        """Re-plan the shape ladder against the CURRENT membership: a
+        shrunken pod's surviving owner serves a bigger slice, so both
+        the per-device budget check and the top rung re-derive from
+        the live count (ShapeLadder.plan_dense(n_live=...) /
+        mesh_local_shape(n_live=...)).  Returns the new ladder and
+        installs it on the pipeline; rungs only pace micro-batches in
+        dense mode (the compile key is (P, I, V)), so swapping the
+        ladder never touches the warmed shape set."""
+        from agnes_tpu.serve.batcher import ShapeLadder
+
+        live = len(self.membership.view.alive)
+        d = self.driver
+        lad = ShapeLadder.plan_dense(
+            d.global_I, d.V,
+            local_shape=d._local_shape(n_live=live),
+            n_hosts=self.n_hosts, n_live=live, **plan_kwargs)
+        old = self.pipeline.ladder
+        if old.bls_rungs or old.bls_class_rungs:
+            lad = ShapeLadder(rungs=lad.rungs,
+                              bls_rungs=old.bls_rungs,
+                              bls_class_rungs=old.bls_class_rungs)
+        self.pipeline.ladder = lad
+        return lad
+
+    # -- drain ---------------------------------------------------------------
+
+    def drain(self, gather: bool = True) -> dict:
+        """HostShard.drain + the elastic section of the pod report."""
+        rep = super().drain(gather=gather)
+        rep["pod"]["elastic"] = {
+            "epoch": self.membership.view.epoch,
+            "alive": list(self.membership.view.alive),
+            "negotiation_ticks": self.negotiation_ticks,
+            "padded_slots": self.padded_slots,
+            "pad_builds": self.pipeline.pad_builds,
+            "padded_phases": self.pipeline.padded_phases,
+            "boundaries": self.boundaries,
+            "readmissions": self.membership.readmissions,
+            "monitor_readmissions": self.monitor.readmissions,
+            "departures": self.membership.departures,
+            "adopted_held": self.adopted_held,
+            "held_dropped": self.held_dropped,
+            "held_pending": len(self._held),
+            "reroute_sent": self.reroute_sent,
+            "reroute_received": self.reroute_received,
+        }
+        return rep

@@ -1,0 +1,455 @@
+"""Pod membership + negotiation math: the jax-free core of the
+elastic pod (ISSUE 17).
+
+Three independently testable pieces, mirroring topology.py's role for
+the static pod:
+
+* **Range repartition** — `partition_ranges` assigns contiguous global
+  instance blocks to the *live* host set (sorted, even split enforced
+  exactly like HostPlan — a ragged membership is rejected at plan
+  time, not papered over); `validate_partition` is the
+  disjoint-and-covering invariant the model checker's monitors and the
+  live boundary path both call, so the proof and the pod police the
+  SAME predicate.  `relift_ranges(old, new)` is the transfer plan: the
+  minimal list of (src host, dst host, lo, hi) global ranges that
+  change owner — what the live pod uses to re-route held gossip and
+  the checker uses to move held batches.
+* **Spec-tree re-lift** — `instance_axis_of` + `relift_tree` re-lift a
+  per-host tree of numpy state/tally blocks onto a new partition,
+  driven by the SAME PartitionSpec trees the sharded dispatch uses
+  (parallel/sharded.seq_in_specs / dense_lane_specs — the caller maps
+  each spec leaf to its instance axis with `instance_axis_of`, so the
+  re-lift can never disagree with the dispatch lift about which axis
+  is the instance dimension).
+* **Per-tick plan negotiation** — `TickSlot`/`merge_tick_plans`: each
+  host's closed batch shapes for one lockstep tick, merged to the
+  per-slot MAX (P, rung, BLS class rung) so heterogeneous honest
+  traffic pads up onto an already-warmed shape instead of diverging
+  the pod.  Slot KINDS must agree (a signed slot against an unsigned
+  slot is a statics divergence, not honest heterogeneity) — that
+  still fails loudly, exactly like PodCoordinator.agree.
+
+`MembershipEpoch` is the protocol object: leave/join intents latch
+mid-epoch (a departed host is TOB-SVD sleepy churn at pod granularity
+— it stops serving, the pod does not stop ticking) and apply ONLY at
+epoch boundaries, where the partition recomputes, held gossip
+re-routes along `relift_ranges`, and a returned host is readmitted —
+after an injectable-clock holddown, so a flapping peer cannot churn
+the partition every tick.
+
+Pure numpy + stdlib; no jax anywhere (conftest _CHEAP eligible).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import (
+    Dict,
+    Iterable,
+    List,
+    Mapping,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+import numpy as np
+
+from agnes_tpu.distributed.topology import PodConfigError
+
+
+class MembershipError(PodConfigError):
+    """A membership/negotiation invariant the elastic pod cannot
+    satisfy (uneven repartition, kind-diverged tick slots, ...)."""
+
+
+# -- range repartition --------------------------------------------------------
+
+def partition_ranges(n_instances: int,
+                     hosts: Iterable[int]) -> Dict[int, Tuple[int, int]]:
+    """Contiguous [lo, hi) global instance ranges over the SORTED live
+    host set.  Even split enforced (HostPlan's rule: the sharded data
+    axes need an exact split; a deployment picks I as a multiple of
+    every pod size it intends to survive)."""
+    live = sorted(set(int(h) for h in hosts))
+    if not live:
+        raise MembershipError("cannot partition over an empty host set")
+    if n_instances <= 0:
+        raise MembershipError(
+            f"n_instances must be >= 1: {n_instances}")
+    if n_instances % len(live):
+        raise MembershipError(
+            f"{n_instances} instances do not repartition evenly over "
+            f"{len(live)} live host(s) {live} — uneven splits are "
+            f"rejected (pad the deployment or change the pod size)")
+    per = n_instances // len(live)
+    return {h: (k * per, (k + 1) * per) for k, h in enumerate(live)}
+
+
+def validate_partition(ranges: Mapping[int, Tuple[int, int]],
+                       n_instances: int) -> None:
+    """THE disjoint-and-covering invariant (module docstring): every
+    global instance id in [0, n_instances) owned by exactly one host.
+    Raises MembershipError naming the first violation."""
+    owned = np.zeros(n_instances, np.int64)
+    for h, (lo, hi) in ranges.items():
+        if not (0 <= lo <= hi <= n_instances):
+            raise MembershipError(
+                f"host {h} range [{lo}, {hi}) outside "
+                f"[0, {n_instances})")
+        owned[lo:hi] += 1
+    over = np.nonzero(owned > 1)[0]
+    if len(over):
+        raise MembershipError(
+            f"partition overlaps at instance {int(over[0])}: "
+            f"{dict(ranges)}")
+    gap = np.nonzero(owned == 0)[0]
+    if len(gap):
+        raise MembershipError(
+            f"partition leaves instance {int(gap[0])} unowned: "
+            f"{dict(ranges)}")
+
+
+def relift_ranges(old: Mapping[int, Tuple[int, int]],
+                  new: Mapping[int, Tuple[int, int]],
+                  ) -> List[Tuple[int, int, int, int]]:
+    """Transfer plan between two partitions of the same instance
+    space: [(src_host, dst_host, lo, hi)] for every maximal global
+    range whose owner changed, sorted by lo.  Ranges owned by the same
+    host in both partitions do not appear (nothing moves)."""
+    def owner_at(ranges, i):
+        for h, (lo, hi) in ranges.items():
+            if lo <= i < hi:
+                return h
+        raise MembershipError(f"instance {i} unowned in {dict(ranges)}")
+
+    n = max((hi for _, hi in old.values()), default=0)
+    out: List[Tuple[int, int, int, int]] = []
+    i = 0
+    while i < n:
+        src, dst = owner_at(old, i), owner_at(new, i)
+        j = i + 1
+        while j < n and owner_at(old, j) == src \
+                and owner_at(new, j) == dst:
+            j += 1
+        if src != dst:
+            out.append((src, dst, i, j))
+        i = j
+    return out
+
+
+# -- spec-tree re-lift --------------------------------------------------------
+
+def instance_axis_of(spec, instance_axes: Sequence[str]) -> Optional[int]:
+    """The axis index of `spec` (a PartitionSpec-like tuple of
+    mesh-axis names / tuples / Nones) sharded over any of
+    `instance_axes` — i.e. the INSTANCE dimension of the leaf this
+    spec shards.  None when the leaf carries no instance dimension
+    (replicated operands: powers, pubkey tables).  Shares the
+    normalization rule of DistributedDriver._spec_dim_axes so the
+    re-lift and the dispatch lift can never disagree."""
+    want = set(instance_axes)
+    for a, axes in enumerate(tuple(spec)):
+        axes_t = (axes,) if isinstance(axes, str) else tuple(axes or ())
+        if want & set(axes_t):
+            return a
+    return None
+
+
+def relift_tree(blocks_by_host: Mapping[int, Sequence[np.ndarray]],
+                old: Mapping[int, Tuple[int, int]],
+                new: Mapping[int, Tuple[int, int]],
+                axes: Sequence[Optional[int]],
+                ) -> Dict[int, List[np.ndarray]]:
+    """Re-lift per-host state/tally leaf blocks onto a NEW partition:
+    `blocks_by_host[h]` is host h's flat leaf list (numpy, fetched
+    addressable blocks), `axes[k]` the instance axis of leaf k
+    (`instance_axis_of` over the matching spec tree; None = replicated
+    leaf, copied from any host).  Returns the same structure keyed by
+    the new partition's hosts.  Pure data movement — assembling the
+    global leaf and re-slicing it — so old and new assemblies are
+    bit-identical by construction; `validate_partition` both sides
+    first, so a hole or overlap fails HERE, not as silent state
+    loss."""
+    if not blocks_by_host:
+        return {}
+    n = max(hi for _, hi in old.values())
+    validate_partition(old, n)
+    validate_partition(new, n)
+    n_leaves = len(next(iter(blocks_by_host.values())))
+    out: Dict[int, List[np.ndarray]] = {h: [] for h in new}
+    for k in range(n_leaves):
+        ax = axes[k]
+        if ax is None:
+            any_host = next(iter(blocks_by_host))
+            for h in new:
+                out[h].append(np.asarray(
+                    blocks_by_host[any_host][k]).copy())
+            continue
+        # assemble the global leaf from the old blocks ...
+        sample = np.asarray(next(iter(blocks_by_host.values()))[k])
+        gshape = list(sample.shape)
+        per_old = gshape[ax]
+        gshape[ax] = n
+        g = np.empty(gshape, sample.dtype)
+        for h, (lo, hi) in old.items():
+            blk = np.asarray(blocks_by_host[h][k])
+            if blk.shape[ax] != hi - lo or hi - lo != per_old:
+                raise MembershipError(
+                    f"leaf {k}: host {h} block extent "
+                    f"{blk.shape[ax]} != owned range {hi - lo}")
+            sel = [slice(None)] * g.ndim
+            sel[ax] = slice(lo, hi)
+            g[tuple(sel)] = blk
+        # ... and re-slice it along the new partition
+        for h, (lo, hi) in new.items():
+            sel = [slice(None)] * g.ndim
+            sel[ax] = slice(lo, hi)
+            out[h].append(g[tuple(sel)].copy())
+    return out
+
+
+# -- per-tick plan negotiation ------------------------------------------------
+
+#: tick-slot kinds (wire-stable small ints)
+KIND_DENSE_SIGNED = 1          # dense fused signed step (pod serve)
+KIND_SIGNED = 2                # packed-lane signed (rung-carrying)
+KIND_UNSIGNED = 3              # pre-verified / unsigned sequence
+KIND_NAMES = {KIND_DENSE_SIGNED: "dense_signed", KIND_SIGNED: "signed",
+              KIND_UNSIGNED: "unsigned"}
+
+
+class TickSlot(NamedTuple):
+    """One closed build's shape, as negotiated: total step-sequence
+    length P (entry included), the padded lane rung (0 on dense /
+    unsigned builds — their compile key carries no rung) and the BLS
+    class rung (0 = no BLS lane)."""
+
+    kind: int
+    n_phases: int
+    rung: int = 0
+    bls_class_rung: int = 0
+
+
+def merge_tick_plans(plans: Sequence[Sequence[TickSlot]],
+                     ) -> Tuple[TickSlot, ...]:
+    """The pod plan for one tick: per slot position, the MAX of every
+    contributing host's (P, rung, BLS class rung) — hosts with fewer
+    slots (or smaller shapes) pad up.  Kind mismatch at a slot is a
+    STATICS divergence (module docstring) and raises."""
+    n_slots = max((len(p) for p in plans), default=0)
+    merged: List[TickSlot] = []
+    for k in range(n_slots):
+        slots = [TickSlot(*p[k]) for p in plans if len(p) > k]
+        kinds = {s.kind for s in slots}
+        if len(kinds) != 1:
+            raise MembershipError(
+                f"tick slot {k} kind diverged across the pod: "
+                + ", ".join(sorted(KIND_NAMES.get(kd, str(kd))
+                                   for kd in kinds))
+                + " — mixed slot kinds are a statics divergence, not "
+                  "honest heterogeneity; failing loudly")
+        merged.append(TickSlot(
+            kind=kinds.pop(),
+            n_phases=max(s.n_phases for s in slots),
+            rung=max(s.rung for s in slots),
+            bls_class_rung=max(s.bls_class_rung for s in slots)))
+    return tuple(merged)
+
+
+# -- the membership protocol --------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MembershipView:
+    """One epoch's membership: the live host set and its partition.
+    Immutable — boundaries produce a NEW view, so every consumer can
+    hold a view across a tick without seeing it mutate."""
+
+    epoch: int
+    n_hosts: int                   # the pod's FULL process count
+    n_instances: int
+    alive: Tuple[int, ...]
+    ranges: Mapping[int, Tuple[int, int]]
+
+    def owner_of(self, instance: int) -> int:
+        for h, (lo, hi) in self.ranges.items():
+            if lo <= instance < hi:
+                return h
+        raise MembershipError(
+            f"instance {instance} unowned in epoch {self.epoch}")
+
+    def owned_range(self, host: int) -> Optional[Tuple[int, int]]:
+        """[lo, hi) host owns this epoch, None while departed."""
+        return self.ranges.get(int(host))
+
+    def alive_mask(self) -> int:
+        return sum(1 << h for h in self.alive)
+
+
+@dataclasses.dataclass(frozen=True)
+class Repartition:
+    """One applied epoch boundary: the view before/after and the
+    transfer plan (`relift_ranges`) between their partitions."""
+
+    old: MembershipView
+    new: MembershipView
+    transfers: Tuple[Tuple[int, int, int, int], ...]
+    joined: Tuple[int, ...]
+    left: Tuple[int, ...]
+
+
+class MembershipEpoch:
+    """Leave/join intents latch mid-epoch, apply at boundaries
+    (module docstring).  The clock is injectable so readmission
+    holddown tests with stubbed time; counters are plain ints the
+    owning shard mirrors into its metrics registry."""
+
+    def __init__(self, n_hosts: int, n_instances: int, *,
+                 rejoin_holddown_s: float = 0.0,
+                 clock=time.monotonic):
+        self.clock = clock
+        self.rejoin_holddown_s = float(rejoin_holddown_s)
+        view = MembershipView(
+            epoch=0, n_hosts=int(n_hosts),
+            n_instances=int(n_instances),
+            alive=tuple(range(int(n_hosts))),
+            ranges=partition_ranges(n_instances, range(int(n_hosts))))
+        validate_partition(view.ranges, n_instances)
+        self.view = view
+        self._pending_leave: set = set()
+        self._pending_join: set = set()
+        self._left_at: Dict[int, float] = {}
+        self.readmissions = 0          # applied rejoins (boundaries)
+        self.departures = 0
+        self.deferred_joins = 0        # holddown pushed a join back
+
+    # -- intents (latch mid-epoch, apply at boundary) ------------------------
+
+    def note_leave(self, host: int) -> bool:
+        """Latch a leave intent (idempotent).  Returns True when newly
+        latched.  The host stops being served IMMEDIATELY in the sense
+        that callers should hold its gossip; the partition itself only
+        changes at the next boundary."""
+        host = int(host)
+        if host not in self.view.alive or host in self._pending_leave:
+            return False
+        self._pending_leave.add(host)
+        self._pending_join.discard(host)
+        self._left_at[host] = self.clock()
+        return True
+
+    def note_join(self, host: int) -> bool:
+        """Latch a join intent for a departed (or departing) host.
+        A join inside the rejoin holddown window is DEFERRED (counted,
+        returns False): a flapping peer must stay quiet for
+        `rejoin_holddown_s` before the pod repartitions for it."""
+        host = int(host)
+        already = (host in self.view.alive
+                   and host not in self._pending_leave)
+        if already or host in self._pending_join:
+            return False
+        left = self._left_at.get(host)
+        if left is not None and self.rejoin_holddown_s > 0 \
+                and self.clock() - left < self.rejoin_holddown_s:
+            self.deferred_joins += 1
+            return False
+        self._pending_join.add(host)
+        self._pending_leave.discard(host)
+        return True
+
+    def merge_intents(self, leave_mask: int, join_mask: int) -> None:
+        """Fold intents gathered from peers' frames in — the union is
+        what keeps every host's pending sets (and therefore the next
+        boundary's partition) identical without a second protocol."""
+        for h in range(self.view.n_hosts):
+            if leave_mask >> h & 1:
+                self.note_leave(h)
+            if join_mask >> h & 1:
+                self.note_join(h)
+
+    def pending(self) -> Tuple[int, int]:
+        """(leave_mask, join_mask) of latched intents — what this
+        host's next negotiation frame broadcasts."""
+        return (sum(1 << h for h in self._pending_leave),
+                sum(1 << h for h in self._pending_join))
+
+    def prospective(self) -> Optional[MembershipView]:
+        """The view the NEXT boundary would produce (None = no pending
+        change) — what a survivor consults to pack re-routed gossip
+        for ranges it is about to relinquish, BEFORE the boundary
+        applies.  Pure function of latched intents: every host
+        computes the identical answer from the gathered masks."""
+        alive = set(self.view.alive) - self._pending_leave \
+            | self._pending_join
+        if tuple(sorted(alive)) == self.view.alive:
+            return None
+        if not alive:
+            return None                  # never partition to nobody
+        return MembershipView(
+            epoch=self.view.epoch + 1, n_hosts=self.view.n_hosts,
+            n_instances=self.view.n_instances,
+            alive=tuple(sorted(alive)),
+            ranges=partition_ranges(self.view.n_instances,
+                                    sorted(alive)))
+
+    # -- model-checker hooks (analysis/membership_mc.py) ---------------------
+
+    def mc_clone(self) -> "MembershipEpoch":
+        """Branchable copy for the exhaustive explorer (the
+        AdmissionQueue/VerifiedCache precedent: the protocol object
+        under check is THIS class, so the hook lives here).  Views are
+        frozen and shared; intent sets are copied."""
+        c = type(self).__new__(type(self))
+        c.clock = self.clock
+        c.rejoin_holddown_s = self.rejoin_holddown_s
+        c.view = self.view
+        c._pending_leave = set(self._pending_leave)
+        c._pending_join = set(self._pending_join)
+        c._left_at = dict(self._left_at)
+        c.readmissions = self.readmissions
+        c.departures = self.departures
+        c.deferred_joins = self.deferred_joins
+        return c
+
+    def mc_canonical(self) -> tuple:
+        """Dedup key: the live set, its partition, and the latched
+        intents.  The epoch COUNTER is deliberately excluded — two
+        states differing only in how many boundaries produced the same
+        partition are behaviorally identical, and excluding it keeps
+        the explored space finite."""
+        return (self.view.alive,
+                tuple(sorted((h, r) for h, r in self.view.ranges.items())),
+                self.pending())
+
+    # -- the boundary --------------------------------------------------------
+
+    def boundary(self) -> Optional[Repartition]:
+        """Apply latched intents at an epoch boundary: repartition,
+        compute the transfer plan, readmit joiners (counted), age out
+        leavers.  Returns None when nothing changed (no epoch is
+        burned on a no-op boundary).  All hosts call this at the SAME
+        lockstep point with the SAME merged intents, so every host
+        steps to the identical new view."""
+        new = self.prospective()
+        self._pending_leave.clear()
+        joined = tuple(sorted(self._pending_join))
+        self._pending_join.clear()
+        if new is None:
+            return None
+        validate_partition(new.ranges, new.n_instances)
+        old = self.view
+        left = tuple(sorted(set(old.alive) - set(new.alive)))
+        joined = tuple(h for h in joined if h in new.alive
+                       and h not in old.alive)
+        rep = Repartition(
+            old=old, new=new,
+            transfers=tuple(relift_ranges(old.ranges, new.ranges)),
+            joined=joined, left=left)
+        self.view = new
+        self.readmissions += len(joined)
+        self.departures += len(left)
+        for h in joined:
+            self._left_at.pop(h, None)
+        return rep

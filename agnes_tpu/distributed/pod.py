@@ -110,6 +110,7 @@ class PodCoordinator:
         self.agrees = 0
         self.barriers = 0
         self.gathered_frames = 0
+        self.negotiations = 0
 
     # -- transport -----------------------------------------------------------
 
@@ -130,6 +131,18 @@ class PodCoordinator:
         self.gathered_frames += 1
         if self.monitor is not None:
             self.monitor.beat(None)     # completed == everybody live
+        return out
+
+    def negotiate(self, frame: np.ndarray) -> np.ndarray:
+        """The elastic pod's per-tick exchange (ISSUE 17): the same
+        fixed-size allgather as `allgather_bytes`, counted separately
+        — `negotiations` tells a postmortem how many ticks this pod
+        NEGOTIATED (shape plans + decisions + membership intents ride
+        one frame, distributed/elastic.py) versus plain decision
+        gathers.  Padding to the merged plan happens in the caller;
+        this transport's only new obligation is the count."""
+        out = self.allgather_bytes(frame)
+        self.negotiations += 1
         return out
 
     # -- lockstep ------------------------------------------------------------

@@ -17,6 +17,10 @@ leaf-for-leaf:
               + HostShard height-paced serve, per-host heartbeat,
               warmup barrier, per-height decision gathers, drain.
               Dumps this host's LOCAL state/tally block.
+* ``elastic`` the same deployment through ElasticShard's negotiated
+              ticks (ISSUE 17): heterogeneous per-host traffic padded
+              to the per-tick max, plus one host leave + rejoin cycle
+              across membership epoch boundaries.
 * ``single``  the SAME deployment served by ONE process over the
               same-shaped (hierarchical) mesh — the single-host mesh
               serve plane the differential compares against.  Dumps
@@ -237,6 +241,222 @@ def run_pod_worker(args) -> dict:
     }
 
 
+def _wire_range(I: int, V: int, seeds, h: int, lo: int, hi: int,
+                typs) -> bytes:
+    """One height's honest wire for instances [lo, hi) only, per
+    class — the per-host traffic split the elastic smoke routes."""
+    from agnes_tpu.bridge.native_ingest import pack_wire_votes
+    from agnes_tpu.harness.fixtures import full_mesh_cols
+
+    parts = []
+    for typ in typs:
+        cols = full_mesh_cols(I, V, seeds, h, typ, 7)
+        keep = (cols[0] >= lo) & (cols[0] < hi)
+        parts.append(pack_wire_votes(*(c[keep] for c in cols)))
+    return b"".join(parts)
+
+
+def run_elastic_worker(args) -> dict:
+    """One ELASTIC pod process (ISSUE 17): the same deployment as
+    ``pod`` mode but served through ElasticShard's negotiated ticks —
+    deliberately HETEROGENEOUS per-host traffic (host 0 splits each
+    height's two vote classes across two ticks while host 1 submits
+    both at once, so the staged plans disagree every tick and the
+    per-tick max-merge + padding is what keeps the pod lockstep —
+    every height but the last, which both hosts serve split-class so
+    the final state snapshot comes from a quiesced pod) plus one
+    host leave + rejoin cycle across epoch boundaries:
+
+      height `leave_height - 1`, last tick: host 1 latches its leave
+      height `leave_height` boundary: repartition, host 1 sleeps —
+          its process keeps ticking (pure padding), host 0 adopts its
+          ranges and HOLDS its gossip
+      height `rejoin_height - 1`, last tick: host 1 latches rejoin
+      height `rejoin_height` boundary: readmission; host 0's held
+          bytes re-route through the SAME tick's frame; catch-up
+          ticks replay them in height order before live traffic
+          resumes
+
+    The tick schedule is a pure function of the shared args — every
+    process executes the identical collective sequence, which is the
+    lockstep contract.  Requires n_processes == 2 when the cycle is
+    enabled (the held-gossip routing sends the sleeper's traffic to
+    THE surviving host)."""
+    import numpy as np
+
+    _setup_jax()
+    from agnes_tpu.distributed.pod import initialize_pod
+
+    pid, I, V = args.pid, args.instances, args.validators
+    initialize_pod(args.coordinator, args.n_processes, pid)
+    from agnes_tpu.bridge import VoteBatcher
+    from agnes_tpu.distributed.driver import DistributedDriver
+    from agnes_tpu.distributed.elastic import ElasticShard
+    from agnes_tpu.harness.fixtures import (
+        deterministic_seeds,
+        validator_pubkeys,
+    )
+    from agnes_tpu.serve import ShapeLadder
+    from agnes_tpu.utils.flightrec import FlightRecorder, Heartbeat
+
+    leave_h, rejoin_h = args.leave_height, args.rejoin_height
+    churn = 0 <= leave_h < rejoin_h <= args.heights
+    if churn and args.n_processes != 2:
+        raise RuntimeError("the elastic leave/rejoin smoke choreographs "
+                           "a 2-process pod")
+    flightrec = FlightRecorder()
+    hb = None
+    if args.heartbeat:
+        hb = Heartbeat(args.heartbeat, interval_s=args.hb_interval,
+                       recorder=flightrec, host_id=pid).start()
+    d = DistributedDriver(I, V, advance_height=True,
+                          defer_collect=True, audit=True,
+                          n_val=args.n_val)
+    n_local = d.I * V
+    box = {"h": 0}
+    shard = ElasticShard(
+        d, VoteBatcher(d.I, V, n_slots=4),
+        validator_pubkeys(deterministic_seeds(V)),
+        capacity=4 * 2 * n_local, target_votes=2 * n_local,
+        max_delay_s=1e9,                 # ticks close every batch
+        ladder=ShapeLadder.plan_dense(
+            I, V, local_shape=d._local_shape(), n_hosts=d.n_hosts,
+            min_rung=1 << (2 * n_local - 1).bit_length()),
+        window_predictor=lambda: (np.zeros(d.I, np.int64),
+                                  np.full(d.I, box["h"], np.int64)),
+        flightrec=flightrec,
+        native_admission=args.native_admission)
+    if hb is not None:
+        hb.sources.append(lambda: shard.metrics.snapshot(
+            window=True, window_key="heartbeat"))
+    # honest heterogeneous traffic dispatches P=2 (entry + one class)
+    # AND P=3 (entry + both classes); warm BOTH, then arm — padding up
+    # to the negotiated max must never buy a live compile
+    warmed = shard.warmup(n_phases=(2, 3), arm=True)
+
+    seeds = deterministic_seeds(V)
+    sleeper = args.n_processes - 1
+    lo_s, hi_s = shard.plan.instance_range(sleeper)
+    PV_PC = (PV, PC)
+    ticks: List[dict] = []
+
+    def tick(boundary: bool = False) -> dict:
+        res = shard.tick(boundary=boundary)
+        ticks.append(res)
+        return res
+
+    t0 = time.perf_counter()
+    for h in range(args.heights + 1):
+        # A: the height edge IS the epoch boundary (lockstep point)
+        tick(boundary=True)
+        if churn and h == rejoin_h:
+            # catch-up: the boundary tick above re-routed the held
+            # wire to the readmitted owner; replay it height by
+            # height (the sleeper paces its window through the gap,
+            # the survivor ticks along staging nothing)
+            for hh in range(leave_h, rejoin_h):
+                if pid == sleeper:
+                    box["h"] = hh
+                tick()
+        asleep = churn and pid == sleeper and leave_h <= h < rejoin_h
+        if not asleep:
+            box["h"] = h
+        # the FINAL height is served homogeneously (both hosts split
+        # classes): the state snapshot must come from a quiesced pod —
+        # a padding dispatch after a host's final decide would leave
+        # its intra-height phase cursors (state_step / tally_q_*)
+        # ahead of the static planes' while changing no decision
+        hetero = h != args.heights
+        if asleep:
+            tick()                       # B: pure padding
+        elif pid == sleeper and hetero:
+            shard.submit(_wire_range(I, V, seeds, h, shard.lo,
+                                     shard.hi, PV_PC))
+            tick()                       # B: P=3 (both classes)
+        else:
+            shard.submit(_wire_range(I, V, seeds, h, shard.lo,
+                                     shard.hi, (PV,)))
+            if churn and pid == 0 and leave_h <= h < rejoin_h:
+                # route the sleeper's traffic at its OWN host: the
+                # adopted ranges hold it for the readmission re-route
+                shard.submit(_wire_range(I, V, seeds, h, lo_s, hi_s,
+                                         PV_PC))
+            tick()                       # B: P=2 (prevotes)
+        # intents latch on the LAST tick of the height before the
+        # boundary that applies them — the join one tick early, so
+        # the re-route can ride the boundary tick's frame (the
+        # survivor's prospective view must already include the
+        # rejoiner when it packs)
+        if churn and pid == sleeper:
+            if h == leave_h - 1:
+                shard.announce_leave()
+            if h == rejoin_h - 1:
+                shard.announce_join()
+        if asleep or (pid == sleeper and hetero):
+            tick()                       # C: padding (nothing staged)
+        else:
+            shard.submit(_wire_range(I, V, seeds, h, shard.lo,
+                                     shard.hi, (PC,)))
+            tick()                       # C: P=2 (precommits)
+    for _ in range(3):                   # settle + latch + gather
+        tick()
+    dt = time.perf_counter() - t0
+    rep = shard.drain()
+    if hb is not None:
+        hb.stop()
+    retrace = d.sentinel.metrics.counters.get("retrace_unexpected", 0)
+    if args.state_npz:
+        _dump_state(args.state_npz, d, local=True)
+    from agnes_tpu.device import registry as _registry
+
+    ela = rep["pod"]["elastic"]
+    rate = 2 * I * V * (args.heights + 1) / dt   # pod-wide votes/sec
+    return {
+        "mode": "elastic", "host": pid, "n_hosts": d.n_hosts,
+        "devices_per_host": args.devices_per_host,
+        "instances": I, "validators": V, "heights": args.heights,
+        "local_instances": d.I,
+        "leave_height": leave_h if churn else -1,
+        "rejoin_height": rejoin_h if churn else -1,
+        "votes_per_sec": round(rate, 1),
+        "decisions_total": d.stats.decisions_total,
+        "pod_decisions": len(shard.pod_decisions),
+        "pod_decision_rows": sorted(
+            [pd.instance, pd.height, pd.round,
+             -1 if pd.value_id is None else pd.value_id]
+            for pd in shard.pod_decisions),
+        "foreign_rejects": shard.foreign_rejects,
+        "rejected_signature_device": d.rejected_signature_device,
+        "retrace_unexpected": int(retrace),
+        "warmed_shapes": warmed,
+        "offladder_builds": rep["offladder_builds"],
+        "host_fallback_builds": rep["host_fallback_builds"],
+        "agrees": rep["pod"]["agrees"],
+        "barriers": rep["pod"]["barriers"],
+        "native_admission": bool(args.native_admission),
+        "compile_entries": sorted(_registry.compile_ms()),
+        "heartbeat_path": args.heartbeat or None,
+        # the elastic section (negotiation + membership evidence the
+        # gate/test assert on)
+        "negotiation_ticks": ela["negotiation_ticks"],
+        "ticks_dispatched": sum(t["dispatched"] for t in ticks),
+        "ticks_padded": sum(t["padded"] for t in ticks),
+        "padded_slots": ela["padded_slots"],
+        "pad_builds": ela["pad_builds"],
+        "padded_phases": ela["padded_phases"],
+        "boundaries": ela["boundaries"],
+        "membership_epoch": ela["epoch"],
+        "alive": ela["alive"],
+        "readmissions": ela["readmissions"],
+        "departures": ela["departures"],
+        "adopted_held": ela["adopted_held"],
+        "held_dropped": ela["held_dropped"],
+        "held_pending": ela["held_pending"],
+        "reroute_sent": ela["reroute_sent"],
+        "reroute_received": ela["reroute_received"],
+    }
+
+
 def run_single_worker(args) -> dict:
     """The single-process mesh serve plane over the SAME global mesh
     shape (differential plane 2)."""
@@ -330,7 +550,8 @@ def run_offline_worker(args) -> dict:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m agnes_tpu.distributed.smoke")
-    ap.add_argument("--mode", choices=("pod", "single", "offline"),
+    ap.add_argument("--mode",
+                    choices=("pod", "elastic", "single", "offline"),
                     required=True)
     ap.add_argument("--pid", type=int, default=0)
     ap.add_argument("--n-processes", type=int, default=2)
@@ -346,11 +567,19 @@ def main(argv=None) -> int:
     ap.add_argument("--heartbeat", default=None)
     ap.add_argument("--hb-interval", type=float, default=1.0)
     ap.add_argument("--native-admission", action="store_true")
+    ap.add_argument("--leave-height", type=int, default=-1,
+                    help="elastic mode: the sleeper host departs at "
+                         "this height's boundary (-1 = no churn)")
+    ap.add_argument("--rejoin-height", type=int, default=-1,
+                    help="elastic mode: readmission boundary height")
     args = ap.parse_args(argv)
 
     if args.mode == "pod":
         _setup_env(args.devices_per_host)
         run = run_pod_worker
+    elif args.mode == "elastic":
+        _setup_env(args.devices_per_host)
+        run = run_elastic_worker
     elif args.mode == "single":
         _setup_env(args.n_processes * args.devices_per_host)
         run = run_single_worker
@@ -411,14 +640,18 @@ def spawn_pod(n_processes: int = 2, *, instances: int = 8,
               heartbeat: bool = False, hb_interval: float = 1.0,
               dump_state: bool = False,
               native_admission: bool = False,
+              elastic: bool = False, leave_height: int = -1,
+              rejoin_height: int = -1,
               extra_modes: Optional[List[str]] = None) -> dict:
     """Launch the pod workers (+ optional `single`/`offline`
     comparison workers, each its own process — composing with the
     XLA:CPU child-interpreter discipline) under one wall-clock
-    deadline; SIGKILL everything on breach.  Returns
-    {"pod": [rec per host], "single": rec?, "offline": rec?,
-    "paths": {...}} with every record parsed from its worker's result
-    JSON."""
+    deadline; SIGKILL everything on breach.  `elastic=True` runs the
+    pod workers through ElasticShard's negotiated ticks (mode
+    ``elastic``) with an optional leave/rejoin cycle at the given
+    boundary heights.  Returns {"pod": [rec per host],
+    "single": rec?, "offline": rec?, "paths": {...}} with every
+    record parsed from its worker's result JSON."""
     os.makedirs(out_dir, exist_ok=True)
     port = free_port()
     env = dict(os.environ)
@@ -440,19 +673,23 @@ def spawn_pod(n_processes: int = 2, *, instances: int = 8,
                "--n-val", str(n_val), "--out", out]
         if dump_state:
             cmd += ["--state-npz", os.path.join(out_dir, f"{tag}.npz")]
-        if heartbeat and mode == "pod":
+        if heartbeat and mode in ("pod", "elastic"):
             cmd += ["--heartbeat",
                     os.path.join(out_dir, f"heartbeat.{tag}.ndjson"),
                     "--hb-interval", str(hb_interval)]
-        if native_admission and mode == "pod":
+        if native_admission and mode in ("pod", "elastic"):
             cmd.append("--native-admission")
+        if mode == "elastic":
+            cmd += ["--leave-height", str(leave_height),
+                    "--rejoin-height", str(rejoin_height)]
         log = open(os.path.join(out_dir, f"{tag}.log"), "w")
         proc = subprocess.Popen(cmd, stdout=log, stderr=log, env=env,
                                 cwd=_repo_root(),
                                 preexec_fn=_die_with_parent)
         return tag, mode, out, proc, log
 
-    jobs = [launch("pod", k, f"pod{k}") for k in range(n_processes)]
+    pod_mode = "elastic" if elastic else "pod"
+    jobs = [launch(pod_mode, k, f"pod{k}") for k in range(n_processes)]
     for mode in (extra_modes or ()):
         jobs.append(launch(mode, 0, mode))
 
@@ -483,7 +720,8 @@ def spawn_pod(n_processes: int = 2, *, instances: int = 8,
                     if dump_state else None),
             "heartbeat": (os.path.join(out_dir,
                                        f"heartbeat.{tag}.ndjson")
-                          if heartbeat and mode == "pod" else None),
+                          if heartbeat and mode in ("pod", "elastic")
+                          else None),
             "rc": proc.returncode,
         }
         try:
@@ -493,7 +731,7 @@ def spawn_pod(n_processes: int = 2, *, instances: int = 8,
             rec = {"mode": mode, "error":
                    f"no result record (rc={proc.returncode}"
                    + (", killed on deadline" if killed else "") + ")"}
-        if mode == "pod":
+        if mode in ("pod", "elastic"):
             results["pod"].append(rec)
         else:
             results[mode] = rec

@@ -247,7 +247,20 @@ class StragglerMonitor:
     liveness proof), or `observe_heartbeat_files` reading co-located
     heartbeat NDJSON trails (utils/flightrec.last_line_age_s).  The
     clock is injectable so the detection logic tests with stubbed
-    time (the ISSUE 15 satellite)."""
+    time (the ISSUE 15 satellite).
+
+    Recovery/readmission (ISSUE 17): a dead verdict is NOT permanent.
+    Fresh evidence for a host whose age had crossed `dead_after_s`
+    clears the verdict and counts a `readmissions` — the documented
+    recovery path the elastic membership plane consumes: with a
+    membership plane attached (`attach_membership`), a dead peer
+    becomes a latched LEAVE intent (applied at the next epoch
+    boundary) and `check()` degrades to the straggler report instead
+    of raising, while resumed evidence latches the matching JOIN.
+    WITHOUT a membership plane the historical fail-closed contract is
+    untouched: `check()` still raises DeadHostError, because without
+    a repartition protocol a dead peer really does hang the next
+    collective."""
 
     def __init__(self, n_hosts: int, host: int,
                  dead_after_s: float = 30.0,
@@ -265,15 +278,42 @@ class StragglerMonitor:
         now = self._clock()
         self._last: Dict[int, float] = {h: now for h in
                                         range(self.n_hosts)}
+        # ISSUE 17 recovery path (class docstring)
+        self.membership = None         # optional MembershipEpoch
+        self.readmissions = 0          # dead verdicts cleared by
+        #                                fresh evidence
+        self._reported_dead: set = set()
+
+    def attach_membership(self, membership) -> None:
+        """Attach the elastic membership plane: dead peers degrade to
+        leave intents and resumed peers to join intents, instead of
+        check() failing closed (class docstring)."""
+        self.membership = membership
 
     def beat(self, host: Optional[int] = None,
              now: Optional[float] = None) -> None:
         """Record evidence for one host (None = ALL hosts — the
-        completed-collective case: nobody missing, everybody live)."""
+        completed-collective case: nobody missing, everybody live).
+        Evidence for a host past the dead age is a RECOVERY: the
+        verdict clears, `readmissions` counts it, and an attached
+        membership plane latches the join intent."""
         now = self._clock() if now is None else now
         hosts = range(self.n_hosts) if host is None else (int(host),)
         for h in hosts:
-            self._last[h] = max(self._last[h], now)
+            self._evidence(h, now, now)
+
+    def _evidence(self, h: int, t: float, now: float) -> None:
+        """Fold one liveness observation in (evidence instant `t`,
+        judged at clock instant `now`) — the recovery detection lives
+        here so every evidence source shares it."""
+        if t <= self._last[h]:
+            return
+        if h != self.host and now - self._last[h] > self.dead_after_s:
+            self.readmissions += 1
+            self._reported_dead.discard(h)
+            if self.membership is not None:
+                self.membership.note_join(h)
+        self._last[h] = t
 
     def observe_heartbeat_files(self, paths: Sequence[Optional[str]],
                                 now: Optional[float] = None) -> None:
@@ -290,7 +330,7 @@ class StragglerMonitor:
                 continue
             age = last_line_age_s(path)
             if age is not None:
-                self._last[h] = max(self._last[h], now - age)
+                self._evidence(h, now - age, now)
 
     def ages(self, now: Optional[float] = None) -> Dict[int, float]:
         now = self._clock() if now is None else now
@@ -312,8 +352,20 @@ class StragglerMonitor:
         returns the (possibly empty) straggler list otherwise — the
         pre-collective gate: a dead peer means the next allgather
         would hang forever, so the caller drains fail-closed instead
-        of joining it."""
+        of joining it.
+
+        With a membership plane attached the verdict DEGRADES instead
+        (class docstring): each newly-dead peer latches a leave intent
+        once and the straggler list is returned — the elastic pod
+        keeps ticking, the boundary repartitions, and the peer's
+        ranges degrade boundedly rather than the whole pod wedging."""
         gone = self.dead(now)
+        if gone and self.membership is not None:
+            for h in gone:
+                if h not in self._reported_dead:
+                    self._reported_dead.add(h)
+                    self.membership.note_leave(h)
+            return self.stragglers(now)
         if gone:
             ages = self.ages(now)
             raise DeadHostError(

@@ -140,7 +140,8 @@ class ShapeLadder:
                    n_classes: int = 2,
                    max_votes: Optional[int] = None, min_rung: int = 256,
                    hbm_bytes: Optional[int] = None,
-                   n_hosts: int = 1) -> "ShapeLadder":
+                   n_hosts: int = 1,
+                   n_live: Optional[int] = None) -> "ShapeLadder":
         """Ladder for the DENSE dispatch mode (mesh serving): the
         dense fused signed step's compile key is (P, I, V) — fixed by
         the deployment, NOT by the batch size — so rungs here only
@@ -159,16 +160,32 @@ class ShapeLadder:
         would pace micro-batches n_hosts times too big (a per-host
         batch can never fill them, so every close is deadline-forced
         and fill sits at 1/n_hosts forever).  The top rung is planned
-        against the instance slice ONE host actually owns."""
+        against the instance slice ONE host actually owns.
+
+        `n_live` (ISSUE 17): an elastic pod's LIVE membership can be
+        smaller than the process count; the slice a surviving owner
+        serves is n_instances / n_live, so both the even-split check
+        and the top rung plan against the live count — re-planning at
+        an epoch boundary with the new membership size is how a
+        shrunken pod re-paces instead of under-claiming (the ladder is
+        cheap frozen data; ElasticShard rebuilds it per epoch)."""
         nh = max(1, int(n_hosts))
+        live = int(n_live) if n_live is not None else nh
+        if not 1 <= live <= nh:
+            raise ValueError(
+                f"live membership {live} outside [1, {nh}]")
         if n_instances % nh:
             raise ValueError(
                 f"{n_instances} instances do not shard evenly over "
                 f"{n_hosts} hosts")
+        if n_instances % live:
+            raise ValueError(
+                f"{n_instances} instances do not repartition evenly "
+                f"over {live} live host(s)")
         li, lv = (local_shape if local_shape is not None
-                  else (n_instances // nh, n_validators))
+                  else (n_instances // live, n_validators))
         plan_dense_verify(n_classes, li, lv, hbm_bytes=hbm_bytes)
-        top_want = 2 * (n_instances // nh) * n_validators
+        top_want = 2 * (n_instances // live) * n_validators
         if max_votes is not None:
             top_want = min(top_want, int(max_votes))
         min_rung = _ceil_pow2(min_rung)

@@ -207,6 +207,15 @@ class ServePipeline:
         # this; the counter stays as the regression alarm (tests
         # assert it is 0)
         self.offladder_builds = 0
+        # elastic-pod negotiation support (ISSUE 17): warmup() records
+        # every (kind, P[, rung]) it compiled so the negotiation layer
+        # can PROVE a padded plan lands on a warmed shape before
+        # dispatching it (`warmup_covers`); pad_staged_to /
+        # stage_padding are the padding primitives and these counters
+        # their audit trail
+        self.warmed_keys: set = set()
+        self.padded_phases = 0         # empty phases appended by pads
+        self.pad_builds = 0            # pure-padding builds staged
 
     def _span(self, name: str):
         import contextlib
@@ -442,6 +451,95 @@ class ServePipeline:
                 preverified=True, tick=tick))
         return True
 
+    # -- elastic-pod padding (ISSUE 17) --------------------------------------
+    #
+    # Per-tick plan negotiation pads every host of a pod to the tick's
+    # MAX build shape so `PodCoordinator.agree` sees identical plans
+    # under honest heterogeneity.  Both primitives reuse the warmup
+    # properties the steady state already depends on: an empty vote
+    # phase (the entry phase IS one — mask all False) is a
+    # state-machine no-op on every instance, and an all-zero dense
+    # lane row is the exact all-padding encoding warmup compiles —
+    # so padding changes neither state nor the compile-key set.
+
+    def warmup_covers(self, kind: str, n_phases: int,
+                      rung: int = 0) -> bool:
+        """True iff warmup() compiled exactly this build shape —
+        (kind, total P incl. entry[, padded lane rung]).  The
+        negotiation layer calls this BEFORE dispatching a padded plan:
+        a merged plan outside the warmed set is a deployment error
+        (fail loudly), never a silent live compile."""
+        key = (("signed", int(n_phases), int(rung)) if kind == "signed"
+               else (kind, int(n_phases)))
+        return key in self.warmed_keys
+
+    def pad_staged_to(self, st: _StagedBatch, n_phases: int) -> int:
+        """Pad one staged build UP to a total step-sequence length of
+        `n_phases` (entry included) by appending empty vote phases —
+        and, on a dense signed build, all-zero lane rows so the
+        DenseSignedPhases leading axis tracks the phase count.
+        Returns the phases appended (0 = already at least that long).
+        Dense / unsigned builds only: a packed-lane build's compile
+        key carries its rung, so the pod plane (which is dense) is the
+        only caller that ever needs phase padding."""
+        cur = len(st.phases) + (1 if st.entry else 0)
+        extra = int(n_phases) - cur
+        if extra <= 0:
+            return 0
+        if st.lanes is not None and not self.dense:
+            raise ValueError(
+                "phase padding is defined for dense/unsigned builds "
+                "only (packed-lane keys carry a rung, not a P)")
+        hts = (st.entry_heights if st.entry_heights is not None
+               else self.batcher.heights.copy())
+        st.phases = list(st.phases) + [self._entry_phase(hts)] * extra
+        if st.lanes is not None:
+            from agnes_tpu.device.step import DenseSignedPhases
+
+            lanes = st.lanes
+            st.lanes = DenseSignedPhases(
+                pub=lanes.pub,
+                sig=jnp.concatenate(
+                    [lanes.sig,
+                     jnp.zeros((extra,) + lanes.sig.shape[1:],
+                               lanes.sig.dtype)]),
+                blocks=jnp.concatenate(
+                    [lanes.blocks,
+                     jnp.zeros((extra,) + lanes.blocks.shape[1:],
+                               lanes.blocks.dtype)]))
+        self.padded_phases += extra
+        self._event("tick_pad", tick=st.tick, phases=extra,
+                    n_phases=int(n_phases))
+        return extra
+
+    def stage_padding(self, n_phases: int, signed: bool = True) -> int:
+        """Stage one PURE-padding build — entry + empty phases +
+        (signed) all-zero dense lanes: byte-for-byte the shape
+        warmup() compiled for this P, and a state-machine no-op on
+        every instance.  What a host dispatches for a negotiated tick
+        slot it has no traffic for, so the pod's collective order
+        stays lockstep.  Returns the tick id."""
+        hts = self.batcher.heights.copy()
+        Ps = max(int(n_phases) - 1, 1)
+        phases = [self._entry_phase(hts)] * Ps
+        lanes = None
+        if signed and self.pubkeys is not None and self.dense:
+            from agnes_tpu.device.step import DenseSignedPhases
+
+            d = self.driver
+            lanes = DenseSignedPhases(
+                pub=jnp.zeros((d.V, 32), jnp.int32),
+                sig=jnp.zeros((Ps, d.I, d.V, 64), jnp.int32),
+                blocks=jnp.zeros((Ps, d.I, d.V, 1, 32), jnp.uint32))
+        tick = self._next_tick()
+        self._event("tick_open", tick=tick, votes=0, rung=None,
+                    signed=lanes is not None, padding=True)
+        self._staged.append(_StagedBatch(
+            phases=phases, lanes=lanes, entry=True, entry_heights=hts,
+            n_votes=0, t_first=self._clock(), tick=tick))
+        self.pad_builds += 1
+        return tick
+
     def dispatch_staged(self) -> int:
         """Queue every staged build's fused step on the device (async;
         never fetches; back-to-back queueing — the split builds of one
@@ -637,6 +735,7 @@ class ServePipeline:
                 fn = d._dense_dispatch_fn(Ps, donate=self.donate)
                 out = fn(*copies(), exts_st, phases_st, dense)
                 jax.block_until_ready(out.state)
+                self.warmed_keys.add(("dense_signed", P))
                 warmed += 1
             else:
                 name = ("consensus_step_seq_signed_donated"
@@ -659,6 +758,7 @@ class ServePipeline:
                     out = fn(*args, advance_height=d.advance_height,
                              verify_chunk=chunk)
                     jax.block_until_ready(out.state)
+                    self.warmed_keys.add(("signed", P, r))
                     warmed += 1
             if self.cache is not None or self.bls_lane is not None:
                 # split-rung dispatch (ISSUE 5 + ISSUE 10):
@@ -686,6 +786,7 @@ class ServePipeline:
                     out = registry.timed_entry(name)(
                         *args, advance_height=d.advance_height)
                 jax.block_until_ready(out.state)
+                self.warmed_keys.add(("unsigned", P))
                 warmed += 1
         if self.bls_lane is not None and self.ladder.bls_rungs:
             # the aggregate lane's MSM entry: one compiled shape per

@@ -210,7 +210,8 @@ def plan_lane_verify(n_lanes: int, n_blocks: int = 1,
 
 
 def mesh_local_shape(mesh, n_instances: int, n_validators: int,
-                     n_hosts: int = 1) -> Tuple[int, int]:
+                     n_hosts: int = 1,
+                     n_live: Optional[int] = None) -> Tuple[int, int]:
     """(instances, validators) as ONE device of `mesh` sees them — the
     shape every per-device budget plan must bound (under shard_map the
     verify and tally run on local cells).  `mesh=None` is the
@@ -227,19 +228,34 @@ def mesh_local_shape(mesh, n_instances: int, n_validators: int,
     verify tiles against an instance count n_hosts times too small
     (a silent HBM under-claim that OOMs at full shape).  Pass the
     host count the instance figure was already divided by; the data
-    extent one host actually owns is global_data / n_hosts."""
+    extent one host actually owns is global_data / n_hosts.
+
+    `n_live` (ISSUE 17): an ELASTIC pod's live membership can be
+    smaller than the process count — ownership concentrates on the
+    survivors while every device (the sleepers' included) stays in
+    the fixed jax mesh serving padding.  A live owner's instance
+    slice is n_instances_global / n_live spread over
+    global_data / n_live device columns, so the per-device figure
+    must divide by the LIVE count, not the static one — planning a
+    shrunken pod's bigger slice against the static divisor
+    under-claims per-device instances (tiles sized for work that no
+    longer fits them).  Defaults to `n_hosts` (the static pod)."""
     if mesh is None:
         return int(n_instances), int(n_validators)
     from agnes_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS, VAL_AXIS
 
+    live = int(n_live) if n_live is not None else int(n_hosts)
+    if not 1 <= live <= max(1, int(n_hosts)):
+        raise ValueError(
+            f"live membership {live} outside [1, {n_hosts}]")
     shape = dict(mesh.shape)
     n_data = shape.get(DATA_AXIS, 1) * shape.get(SLICE_AXIS, 1)
-    if n_hosts > 1:
-        if n_data % n_hosts:
+    if live > 1:
+        if n_data % live:
             raise ValueError(
                 f"mesh data extent {n_data} does not split over "
-                f"{n_hosts} hosts")
-        n_data //= n_hosts
+                f"{live} live host(s)")
+        n_data //= live
     return (int(n_instances) // n_data,
             int(n_validators) // shape.get(VAL_AXIS, 1))
 

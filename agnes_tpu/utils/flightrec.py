@@ -395,6 +395,34 @@ def render_postmortem(path: str,
         out.append(f"  jaxpr census drift: {int(drift)} entr"
                    + ("y" if drift == 1 else "ies")
                    + (" (clean)" if drift == 0 else " — GRAPH GREW"))
+    # ISSUE 17: the elastic membership trail — current epoch,
+    # readmission count and the boundary / re-lift / hold-overflow
+    # event counts, by name (same stdlib-only contract as the BLS
+    # block: the names mirror utils/metrics.py's POD_MEMBERSHIP_EPOCH
+    # / POD_HOST_READMISSIONS and ElasticShard's event kinds).  A pod
+    # that churned hosts should say so in its post-mortem header, and
+    # a hold-overflow — dropped held gossip — is a red flag the
+    # reader must not have to dig for.
+    epoch = last.get("pod_membership_epoch")
+    readm = last.get("pod_host_readmissions")
+    memb = {}
+    if isinstance(ev, dict):
+        readm = readm or ev.get("pod_host_readmissions")
+        memb = {k: ev[k] for k in ("membership_boundary",
+                                   "membership_relift",
+                                   "membership_hold_overflow")
+                if ev.get(k)}
+    if isinstance(epoch, (int, float)) or readm or memb:
+        bits = []
+        if isinstance(epoch, (int, float)):
+            bits.append(f"epoch {int(epoch)}")
+        if readm:
+            bits.append(f"{int(readm)} readmission(s)")
+        bits.extend(f"{k}={v}" for k, v in sorted(memb.items()))
+        out.append("  elastic membership: " + ", ".join(bits)
+                   + (" — HELD GOSSIP DROPPED (reroute capacity "
+                      "overflow)" if memb.get(
+                          "membership_hold_overflow") else ""))
     return "\n".join(out)
 
 
@@ -429,11 +457,17 @@ def render_pod_postmortem(paths: Sequence[str],
                else f"pid {last['pid']}")
         interval = float(last.get("interval_s", 0)) or None
         stale = interval is not None and age > 2 * interval
+        ep = last.get("pod_membership_epoch")
         rows.append((
             -age,
             f"  {who}: last beat {_fmt_t(last['t'])} "
             f"(age {age:.1f}s, {len(lines)} beats, seq "
-            f"{last['seq']})"
+            f"{last['seq']}"
+            # per-host epoch in the ranked header: hosts wedged on
+            # DIFFERENT membership epochs is the elastic-pod failure
+            # signature (ISSUE 17)
+            + (f", epoch {int(ep)}" if isinstance(ep, (int, float))
+               else "") + ")"
             + (" — STALE: wedged/died around this time" if stale
                else " — fresh")))
     out = [f"pod heartbeat merge: {len(paths)} trail(s), oldest "

@@ -415,6 +415,21 @@ SERVE_NATIVE_REJECTS_MALFORMED = (SERVE_NATIVE_REJECTS_PREFIX
 POD_FOREIGN_REJECTS = "pod_foreign_rejects"
 MULTIHOST_HOSTS = "multihost_hosts"
 MULTIHOST_DEVICES_PER_HOST = "multihost_devices_per_host"
+#: ISSUE 17 elastic-pod membership plane (distributed/elastic.py):
+#: the CURRENT membership epoch (gauge — steps at each applied
+#: boundary, so a wedge timeline shows which partition was live), the
+#: per-tick negotiation wall (histogram: pack + allgather + merge +
+#: pad, the price of elasticity on the tick path), dead-peer verdicts
+#: cleared by resumed evidence (counter, StragglerMonitor.beat — the
+#: recovery path the membership plane consumes), and the membership
+#: model's distinct-state total (analysis/membership_mc.py, exported
+#: by ci gate [1d] like the admission/epoch totals above).  The
+#: elastic bench probe's verdict records carry
+#: `pipeline_serve_elastic_votes_per_sec` beside the multihost keys.
+POD_MEMBERSHIP_EPOCH = "pod_membership_epoch"
+POD_NEGOTIATION_WALL_S = "pod_negotiation_wall_s"
+POD_HOST_READMISSIONS = "pod_host_readmissions"
+MODELCHECK_MEMBERSHIP_STATES = "modelcheck_membership_states"
 #: per-entry first-dispatch wall gauges, `compile_ms_<entry>` (ISSUE 8
 #: satellite): the registry times the FIRST dispatch of every entry in
 #: the process (trace + compile dominates that call), so the next

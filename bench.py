@@ -238,7 +238,16 @@ _SERVE_NATIVE_SMOKE = bool(
 #: spawner deadline and the sentinel still emits)
 _SERVE_MULTIHOST_SMOKE = bool(
     os.environ.get("AGNES_BENCH_SERVE_MULTIHOST_SMOKE"))
-_SENTINEL_METRIC = ("pipeline_serve_multihost_votes_per_sec"
+#: elastic-pod-smoke mode (ci.sh gate, ISSUE 17): ONLY the elastic pod
+#: serve probe — the 2-process pod driven through ElasticShard's
+#: per-tick shape negotiation with heterogeneous per-host traffic and
+#: ONE host leave + rejoin cycle across membership epoch boundaries;
+#: same spawner-deadline crash-safe contract as the multihost gate
+_SERVE_ELASTIC_SMOKE = bool(
+    os.environ.get("AGNES_BENCH_SERVE_ELASTIC_SMOKE"))
+_SENTINEL_METRIC = ("pipeline_serve_elastic_votes_per_sec"
+                    if _SERVE_ELASTIC_SMOKE
+                    else "pipeline_serve_multihost_votes_per_sec"
                     if _SERVE_MULTIHOST_SMOKE
                     else "pipeline_serve_mesh_votes_per_sec"
                     if _SERVE_MESH_SMOKE
@@ -250,7 +259,9 @@ _SENTINEL_METRIC = ("pipeline_serve_multihost_votes_per_sec"
                     if _SERVE_NATIVE_SMOKE
                     else "pipeline_fused_votes_per_sec" if _SERVE_SMOKE
                     else "pipeline_votes_per_sec")
-_SENTINEL_STAGE = ("bench_pipeline_serve_multihost"
+_SENTINEL_STAGE = ("bench_pipeline_serve_elastic"
+                   if _SERVE_ELASTIC_SMOKE
+                   else "bench_pipeline_serve_multihost"
                    if _SERVE_MULTIHOST_SMOKE
                    else "bench_pipeline_serve_mesh" if _SERVE_MESH_SMOKE
                    else "bench_pipeline_serve_dedup"
@@ -270,7 +281,8 @@ _EXTRA_RECORD: dict = {}
 #: every serve smoke is a CPU-only CI gate (no TPU claim/lease/probe)
 _ANY_SERVE_SMOKE = (_SERVE_SMOKE or _SERVE_MESH_SMOKE
                     or _SERVE_DEDUP_SMOKE or _SERVE_BLS_SMOKE
-                    or _SERVE_NATIVE_SMOKE or _SERVE_MULTIHOST_SMOKE)
+                    or _SERVE_NATIVE_SMOKE or _SERVE_MULTIHOST_SMOKE
+                    or _SERVE_ELASTIC_SMOKE)
 
 
 def _emit_sentinel(note: str) -> None:
@@ -1480,6 +1492,89 @@ def _pipeline_serve_multihost(n_instances: int, n_validators: int,
     return min(r["votes_per_sec"] for r in res["pod"])
 
 
+def _pipeline_serve_elastic(n_instances: int, n_validators: int,
+                            heights: int, n_hosts: int = 2,
+                            devices_per_host: int = 2,
+                            n_val: int = 2) -> float:
+    """CLOSED-LOOP through the ELASTIC pod serve plane (ISSUE 17):
+    the same spawned 2-process pod as _pipeline_serve_multihost, but
+    driven through ElasticShard's negotiated ticks — heterogeneous
+    per-host traffic (the hosts deliberately close different batch
+    shapes every tick, padded to the per-tick max) plus ONE host
+    leave + rejoin cycle across membership epoch boundaries, with
+    the departed host's gossip held by the survivor and re-routed
+    through the readmission boundary's own frame.  The probe itself
+    cross-checks the hosts' height-stamped decision rows (a
+    mini-differential: elasticity must not change decisions) and
+    surfaces the membership evidence — boundaries, epoch,
+    readmissions, re-route counts, zero unexpected retraces — via
+    _EXTRA_RECORD for the ci.sh gate's asserts."""
+    import tempfile
+
+    from agnes_tpu.distributed.smoke import spawn_pod
+
+    leave_h = int(os.environ.get("AGNES_ELASTIC_LEAVE_HEIGHT", "1"))
+    rejoin_h = int(os.environ.get("AGNES_ELASTIC_REJOIN_HEIGHT", "2"))
+    out_dir = os.environ.get("AGNES_ELASTIC_DIR") or \
+        tempfile.mkdtemp(prefix="agnes_elastic_")
+    rem = _DEADLINE.remaining()
+    timeout_s = 900.0
+    if rem != float("inf"):
+        timeout_s = max(60.0,
+                        rem - _budget.deadline_margin_s(rem) - 15.0)
+    res = spawn_pod(n_hosts, instances=n_instances,
+                    validators=n_validators, heights=heights,
+                    devices_per_host=devices_per_host, n_val=n_val,
+                    out_dir=out_dir, timeout_s=timeout_s,
+                    heartbeat=True, elastic=True,
+                    leave_height=leave_h, rejoin_height=rejoin_h)
+    if res["killed"]:
+        raise RuntimeError(
+            f"elastic pod breached its {timeout_s:.0f}s spawner "
+            f"deadline (logs under {out_dir})")
+    errors = [r for r in res["pod"] if "error" in r]
+    if errors:
+        raise RuntimeError(f"elastic pod worker(s) failed: {errors} "
+                           f"(logs under {out_dir})")
+    rows = [r["pod_decision_rows"] for r in res["pod"]]
+    if any(rw != rows[0] for rw in rows[1:]):
+        raise RuntimeError(
+            f"elastic pod decision rows diverged across hosts "
+            f"(records under {out_dir})")
+    _EXTRA_RECORD.update({
+        "elastic_hosts": n_hosts,
+        "elastic_devices_per_host": devices_per_host,
+        "elastic_leave_height": leave_h,
+        "elastic_rejoin_height": rejoin_h,
+        "elastic_boundaries": min(
+            r["boundaries"] for r in res["pod"]),
+        "elastic_membership_epoch": min(
+            r["membership_epoch"] for r in res["pod"]),
+        "elastic_readmissions": max(
+            r["readmissions"] for r in res["pod"]),
+        "elastic_retrace_unexpected": sum(
+            r["retrace_unexpected"] for r in res["pod"]),
+        "elastic_foreign_rejects": sum(
+            r["foreign_rejects"] for r in res["pod"]),
+        "elastic_pod_decisions": min(
+            r["pod_decisions"] for r in res["pod"]),
+        "elastic_warmed_shapes": min(
+            r["warmed_shapes"] for r in res["pod"]),
+        "elastic_padded_slots": sum(
+            r["padded_slots"] for r in res["pod"]),
+        "elastic_reroute_sent": sum(
+            r["reroute_sent"] for r in res["pod"]),
+        "elastic_reroute_received": sum(
+            r["reroute_received"] for r in res["pod"]),
+        "elastic_held_dropped": sum(
+            r["held_dropped"] for r in res["pod"]),
+        "elastic_heartbeat_paths": [
+            res["paths"][f"pod{k}"]["heartbeat"]
+            for k in range(n_hosts)],
+    })
+    return min(r["votes_per_sec"] for r in res["pod"])
+
+
 def _pipeline_serve_dedup(n_instances: int, n_validators: int,
                           heights: int, dup: Optional[int] = None
                           ) -> float:
@@ -2054,6 +2149,20 @@ def bench_pipeline_serve_multihost(n_instances: int = 8,
                                      heights)
 
 
+def bench_pipeline_serve_elastic(n_instances: int = 8,
+                                 n_validators: int = 8,
+                                 heights: int = 2) -> float:
+    """End-to-end through the ELASTIC pod serve plane: the 2-process
+    jax.distributed pod of bench_pipeline_serve_multihost driven
+    through ElasticShard's per-tick shape negotiation, heterogeneous
+    per-host traffic and one host leave + rejoin cycle across
+    membership epoch boundaries (ISSUE 17).  Like the multihost
+    probe it measures pod PROTOCOL overhead — negotiation allgather,
+    padding, boundary re-lifts — on CPU by construction, so the
+    default shape stays tiny even in hardware rounds."""
+    return _pipeline_serve_elastic(n_instances, n_validators, heights)
+
+
 def bench_pipeline_serve_dedup(n_instances: int = 1024,
                                n_validators: int = 128,
                                heights: int = 6) -> float:
@@ -2214,6 +2323,24 @@ def main_serve_multihost_smoke() -> None:
                 "jax.distributed")
 
 
+def main_serve_elastic_smoke() -> None:
+    """The ci.sh elastic gate's entry (ISSUE 17): ONLY the elastic
+    pod serve probe — 2 spawned jax.distributed worker processes
+    through ElasticShard's negotiated ticks, heterogeneous traffic,
+    one leave + rejoin cycle — same crash-safe contract as the
+    multihost gate.  The record carries the membership evidence
+    (`elastic_boundaries`/`elastic_readmissions`/`elastic_epoch`...),
+    the summed retrace/re-route counters and every worker's heartbeat
+    path via _EXTRA_RECORD."""
+    _smoke_main("bench_pipeline_serve_elastic",
+                "pipeline_serve_elastic_votes_per_sec",
+                "pipeline_serve_elastic_votes_per_sec", "votes/sec",
+                "AGNES_SERVE_ELASTIC_SMOKE",
+                bench_pipeline_serve_elastic,
+                "elastic pod smoke: negotiated ticks + membership "
+                "epoch cycle over jax.distributed")
+
+
 def main_serve_mesh_smoke() -> None:
     """The ci.sh mesh-serve gate's entry (ISSUE 3): ONLY the mesh
     serve probe — ThreadedVoteService event loop + dense sharded
@@ -2266,6 +2393,8 @@ def main() -> None:
     # multi-host pod serve: 2 spawned jax.distributed CPU processes
     # (protocol-overhead probe — bench_pipeline_serve_multihost doc)
     pipeline_serve_multihost = guarded(bench_pipeline_serve_multihost)
+    # elastic pod serve: negotiated ticks + membership epoch cycle
+    pipeline_serve_elastic = guarded(bench_pipeline_serve_elastic)
     # duplicated-traffic serve: dedup cache + split-rung dispatch
     pipeline_serve_dedup = guarded(bench_pipeline_serve_dedup)
     # native admission front-end: C++ submit/drain + Python replay
@@ -2301,6 +2430,8 @@ def main() -> None:
         "pipeline_serve_mesh_votes_per_sec": pipeline_serve_mesh,
         "pipeline_serve_multihost_votes_per_sec":
             pipeline_serve_multihost,
+        "pipeline_serve_elastic_votes_per_sec":
+            pipeline_serve_elastic,
         "pipeline_serve_dedup_votes_per_sec": pipeline_serve_dedup,
         "pipeline_serve_native_votes_per_sec": pipeline_serve_native,
         "pipeline_serve_bls_votes_per_sec": pipeline_serve_bls,
@@ -2320,7 +2451,8 @@ def main() -> None:
 
 if __name__ == "__main__":
     try:
-        (main_serve_multihost_smoke() if _SERVE_MULTIHOST_SMOKE
+        (main_serve_elastic_smoke() if _SERVE_ELASTIC_SMOKE
+         else main_serve_multihost_smoke() if _SERVE_MULTIHOST_SMOKE
          else main_serve_mesh_smoke() if _SERVE_MESH_SMOKE
          else main_serve_dedup_smoke() if _SERVE_DEDUP_SMOKE
          else main_serve_bls_smoke() if _SERVE_BLS_SMOKE

@@ -119,9 +119,13 @@ echo "=== [1d/4] bounded model checker (exhaustive smoke scope, no XLA) ==="
 # vectors moving every +2/3 boundary), EPOCH shards (ISSUE 9:
 # validator-set changes at height boundaries, per-epoch symmetry
 # groups, epoch-indexed quorum certificates), sleepy-CHURN shards
-# (TOB-SVD sleep/wake schedules under a churn budget), and the
+# (TOB-SVD sleep/wake schedules under a churn budget), the
 # serve-plane ADMISSION model shards (AdmissionQueue/batcher/dedup-split soundness monitors,
-# analysis/admission_mc.py) — agreement/validity/quorum/monotonicity/
+# analysis/admission_mc.py), and the MEMBERSHIP shards (ISSUE 17:
+# host-level sleep/wake + epoch-boundary repartition over the real
+# MembershipEpoch — range-partition disjointness/coverage and
+# no-decision-loss monitors, analysis/membership_mc.py)
+# — agreement/validity/quorum/monotonicity/
 # evidence + conservation/starvation/pbound/purity monitors on every
 # reachable state.  Pure CPU, zero jax imports, zero compiles; the CLI
 # discovers the enclosing timeout and degrades to a complete=false
@@ -159,6 +163,11 @@ if rep["complete"]:
     # deadline-sentinel partial is exempt (slow box, not a regression).
     assert rep["consensus_states"] >= 200_000, rep["consensus_states"]
     assert rep["admission_states"] >= 150_000, rep["admission_states"]
+    # ISSUE 17 floor: the membership shards (host join/leave +
+    # epoch-boundary repartition model, analysis/membership_mc.py)
+    # must EXHAUST >= 50k canonical states (measured envelope ~226k:
+    # mem_churn2 ~205k, mem_pair_deep ~22k)
+    assert rep["membership_states"] >= 50_000, rep["membership_states"]
     # ISSUE 9 floors: the epoch + churn shards must EXHAUST >= 100k
     # combined canonical states (measured envelope ~154k: epoch ~71k,
     # churn ~83k), and the PER-EPOCH symmetry groups must bite —
@@ -173,7 +182,8 @@ kind = "EXHAUSTED" if rep["complete"] else "partial (deadline sentinel)"
 print(f"model checker OK: {rep['states_explored']} canonical states "
       f"{kind} (consensus {rep['consensus_states']}, admission "
       f"{rep['admission_states']}, epoch {rep['epoch_states']}, churn "
-      f"{rep['churn_states']}, orbit reduction "
+      f"{rep['churn_states']}, membership {rep['membership_states']}, "
+      f"orbit reduction "
       f"{rep['sym_orbit_reduction']}x overall / "
       f"{rep['epoch_orbit_reduction']}x per-epoch), 0 violations in "
       f"{rep['seconds']}s ({rep['transitions']} transitions)")
@@ -181,10 +191,11 @@ with open(sys.argv[2], "w") as f:
     f.write(f"{rep['states_explored']} {rep['violations']} "
             f"{rep['sym_orbit_reduction']} {rep['admission_states']} "
             f"{rep['epoch_states']} {rep['churn_states']} "
-            f"{rep['epoch_orbit_reduction']}\n")
+            f"{rep['epoch_orbit_reduction']} "
+            f"{rep['membership_states']}\n")
 PY
 read -r MC_STATES MC_VIOLS MC_SYMRED MC_ADM MC_EPOCH MC_CHURN MC_EPRED \
-  < "$MC_NUMS"
+  MC_MEM < "$MC_NUMS"
 export AGNES_MODELCHECK_STATES_EXPLORED="${MC_STATES:?}"
 export AGNES_MODELCHECK_VIOLATIONS="${MC_VIOLS:?}"
 export AGNES_MODELCHECK_SYM_ORBIT_REDUCTION="${MC_SYMRED:?}"
@@ -192,6 +203,7 @@ export AGNES_MODELCHECK_ADMISSION_STATES="${MC_ADM:?}"
 export AGNES_MODELCHECK_EPOCH_STATES="${MC_EPOCH:?}"
 export AGNES_MODELCHECK_CHURN_STATES="${MC_CHURN:?}"
 export AGNES_MODELCHECK_EPOCH_ORBIT_REDUCTION="${MC_EPRED:?}"
+export AGNES_MODELCHECK_MEMBERSHIP_STATES="${MC_MEM:?}"
 
 echo "=== [2/4] full test suite (virtual 8-device CPU mesh) ==="
 # step 1 already ran the native differential + fuzz files under ASan
@@ -563,6 +575,84 @@ rec = json.loads([l for l in open(sys.argv[1]).read().strip()
 assert rec["value"] == -1, \
     "real multihost record but no per-host heartbeat trails"
 print("multihost heartbeat check skipped (sentinel before spawn)")
+PY
+fi
+
+echo "=== [3h/4] elastic pod serve smoke gate (membership cycle, CPU) ==="
+# ISSUE 17: the elastic pod membership plane — the same spawned
+# 2-process pod as [3g], driven through ElasticShard's per-tick shape
+# negotiation: deliberately heterogeneous per-host traffic (hosts
+# close DIFFERENT batch shapes every tick; the per-tick max-merge +
+# padding keeps lockstep with ZERO new compiles past warmup) plus one
+# host leave + rejoin cycle across membership epoch boundaries (the
+# survivor adopts the sleeper's ranges, holds its gossip and
+# re-routes it through the readmission boundary's own frame).  Same
+# crash-safe contract: a real pipeline_serve_elastic_votes_per_sec
+# record — which must then show zero unexpected retraces (padding
+# never bought a live compile), a COMPLETED membership cycle
+# (boundaries >= 2, readmissions >= 1), matching per-host decision
+# rows (the probe raises otherwise), no dropped held gossip and zero
+# foreign rejects — or the -1 sentinel, rc 0 either way.
+ELA_DIR="$(mktemp -d)"
+ELA_RC=0
+AGNES_BENCH_SERVE_ELASTIC_SMOKE=1 AGNES_ELASTIC_DIR="$ELA_DIR" \
+  AGNES_TPU_LEASE_PATH="$ELA_DIR/tpu.lease" \
+  timeout -k 10 900 python bench.py > "$ELA_DIR/serve_elastic.json" \
+  2> "$ELA_DIR/serve_elastic.err" || ELA_RC=$?
+if [ "$ELA_RC" -ne 0 ]; then
+  echo "elastic pod serve smoke gate FAILED: bench exited rc=$ELA_RC"
+  tail -5 "$ELA_DIR/serve_elastic.err"
+  exit 1
+fi
+python - "$ELA_DIR/serve_elastic.json" <<'PY'
+import json, sys
+lines = [l for l in open(sys.argv[1]).read().strip().splitlines() if l]
+assert lines, "elastic pod serve smoke printed no stdout"
+rec = json.loads(lines[-1])
+assert rec["metric"] == "pipeline_serve_elastic_votes_per_sec", rec
+assert isinstance(rec["value"], (int, float)), rec
+assert rec["value"] == -1 or rec["value"] > 0, rec
+if rec["value"] == -1:
+    print("elastic pod serve smoke gate OK: -1 sentinel "
+          "(deadline contract)")
+else:
+    assert rec["elastic_hosts"] == 2, rec
+    assert rec["elastic_retrace_unexpected"] == 0, rec
+    # >= 1 COMPLETED membership epoch: the leave boundary AND the
+    # readmission boundary both applied on every host
+    assert rec["elastic_boundaries"] >= 2, rec
+    assert rec["elastic_readmissions"] >= 1, rec
+    assert rec["elastic_membership_epoch"] >= 2, rec
+    # heterogeneous shapes were really negotiated + padded, the held
+    # gossip really re-routed, and none of it was dropped or rejected
+    assert rec["elastic_warmed_shapes"] == 2, rec
+    assert rec["elastic_padded_slots"] > 0, rec
+    assert rec["elastic_reroute_sent"] > 0, rec
+    assert rec["elastic_reroute_received"] > 0, rec
+    assert rec["elastic_held_dropped"] == 0, rec
+    assert rec["elastic_foreign_rejects"] == 0, rec
+    assert len(rec["elastic_heartbeat_paths"]) == 2, rec
+    print(f"elastic pod serve smoke gate OK: {rec['value']:.0f} votes/s "
+          f"pod-wide ({rec['elastic_boundaries']} boundaries, "
+          f"{rec['elastic_readmissions']} readmission(s), epoch "
+          f"{rec['elastic_membership_epoch']}, "
+          f"{rec['elastic_reroute_received']} re-routed records)")
+PY
+# the merged per-host postmortem now renders the membership trail
+# (epoch per host + boundary/re-lift events) — same skip rule as [3g]
+if ls "$ELA_DIR"/heartbeat.pod*.ndjson >/dev/null 2>&1; then
+  timeout -k 5 60 python scripts/agnes_metrics.py --check \
+    "$ELA_DIR"/heartbeat.pod*.ndjson
+  timeout -k 5 60 python scripts/agnes_metrics.py \
+    "$ELA_DIR"/heartbeat.pod*.ndjson || true
+else
+  python - "$ELA_DIR/serve_elastic.json" <<'PY'
+import json, sys
+rec = json.loads([l for l in open(sys.argv[1]).read().strip()
+                  .splitlines() if l][-1])
+assert rec["value"] == -1, \
+    "real elastic record but no per-host heartbeat trails"
+print("elastic heartbeat check skipped (sentinel before spawn)")
 PY
 fi
 

@@ -84,7 +84,8 @@ _CHEAP = (          # no XLA compiles (stdlib / numpy / ctypes / refs)
     "test_bench_deadline.py", "test_bls_pairing_host.py",
     "test_budget.py", "test_capi_fuzz.py",
     "test_cli_shims.py", "test_distributed.py",
-    "test_ed25519_ref.py", "test_executor.py", "test_modelcheck.py",
+    "test_ed25519_ref.py", "test_elastic.py", "test_executor.py",
+    "test_membership_mc.py", "test_modelcheck.py",
     "test_native_admission.py",
     "test_native_core.py",
     "test_native_ingest.py", "test_observability.py",

@@ -376,6 +376,7 @@ def test_lint_hot_paths_cover_distributed_plane():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     assert "agnes_tpu/distributed/shard.py" in HOT_PATHS
     assert "agnes_tpu/distributed/driver.py" in HOT_PATHS
+    assert "agnes_tpu/distributed/elastic.py" in HOT_PATHS
     for rel, funcs in HOT_PATHS.items():
         path = os.path.join(repo, rel)
         assert os.path.exists(path), f"HOT_PATHS rot: {rel}"

@@ -1,0 +1,374 @@
+"""Elastic pod membership plane, jax-free (ISSUE 17 satellite).
+
+Everything here runs without a backend: the repartition/re-lift math
+and the MembershipEpoch protocol (distributed/membership.py), the
+combined negotiation frame codec (distributed/elastic.py — jax-free
+at module level), the StragglerMonitor recovery path, and the
+live-membership budget threading.  The spawned 2-process differential
+that exercises the SAME protocol against real devices lives in
+tests/test_elastic_serve.py (slow)."""
+
+import numpy as np
+import pytest
+
+from agnes_tpu.distributed.membership import (
+    KIND_DENSE_SIGNED,
+    KIND_UNSIGNED,
+    MembershipEpoch,
+    MembershipError,
+    TickSlot,
+    instance_axis_of,
+    merge_tick_plans,
+    partition_ranges,
+    relift_ranges,
+    relift_tree,
+    validate_partition,
+)
+from agnes_tpu.distributed.topology import StragglerMonitor
+
+# -- range repartition --------------------------------------------------------
+
+
+def test_partition_even_and_sorted():
+    assert partition_ranges(8, [1, 0]) == {0: (0, 4), 1: (4, 8)}
+    assert partition_ranges(8, [1]) == {1: (0, 8)}
+    assert partition_ranges(12, [0, 2, 3]) == {
+        0: (0, 4), 2: (4, 8), 3: (8, 12)}
+
+
+def test_partition_rejects_uneven_and_empty():
+    with pytest.raises(MembershipError):
+        partition_ranges(7, [0, 1])          # uneven split
+    with pytest.raises(MembershipError):
+        partition_ranges(8, [])              # nobody alive
+    with pytest.raises(MembershipError):
+        partition_ranges(0, [0])
+
+
+def test_validate_partition_disjoint_and_covering():
+    ok = {0: (0, 4), 1: (4, 8)}
+    validate_partition(ok, 8)
+    with pytest.raises(MembershipError, match="overlaps"):
+        validate_partition({0: (0, 5), 1: (4, 8)}, 8)
+    with pytest.raises(MembershipError, match="unowned"):
+        validate_partition({0: (0, 3), 1: (4, 8)}, 8)
+    with pytest.raises(MembershipError, match="outside"):
+        validate_partition({0: (0, 9)}, 8)
+
+
+def test_relift_ranges_transfer_plan():
+    old = {0: (0, 4), 1: (4, 8)}
+    # host 1 leaves: its whole block moves to host 0
+    assert relift_ranges(old, {0: (0, 8)}) == [(1, 0, 4, 8)]
+    # ... and comes back: the block moves home
+    assert relift_ranges({0: (0, 8)}, old) == [(0, 1, 4, 8)]
+    # no change -> no transfers
+    assert relift_ranges(old, old) == []
+    # 3 -> 2 hosts: maximal changed ranges, sorted by lo
+    assert relift_ranges(
+        {0: (0, 2), 1: (2, 4), 2: (4, 6)},
+        {0: (0, 3), 2: (3, 6)}) == [
+        (1, 0, 2, 3), (1, 2, 3, 4)]
+
+
+# -- spec-tree re-lift --------------------------------------------------------
+
+
+def test_instance_axis_of_spec_leaves():
+    # PartitionSpec-like tuples: names / tuples of names / None
+    assert instance_axis_of(("slice", "val"), ["slice", "data"]) == 0
+    assert instance_axis_of((None, ("slice", "data")),
+                            ["slice", "data"]) == 1
+    assert instance_axis_of((None, "val"), ["slice", "data"]) is None
+    assert instance_axis_of((), ["slice"]) is None
+
+
+def test_relift_tree_round_trips_leaves():
+    old = {0: (0, 2), 1: (2, 4)}
+    new = {0: (0, 4)}
+    rng = np.random.default_rng(17)
+    # two instance-sharded leaves (axis 0 and axis 1) + a replicated
+    leaf_a = rng.integers(0, 100, (4, 3))
+    leaf_b = rng.integers(0, 100, (2, 4, 5))
+    leaf_r = rng.integers(0, 100, (7,))
+    blocks = {h: [leaf_a[lo:hi], leaf_b[:, lo:hi], leaf_r]
+              for h, (lo, hi) in old.items()}
+    out = relift_tree(blocks, old, new, axes=[0, 1, None])
+    np.testing.assert_array_equal(out[0][0], leaf_a)
+    np.testing.assert_array_equal(out[0][1], leaf_b)
+    np.testing.assert_array_equal(out[0][2], leaf_r)
+    # ... and back out to the two-host partition, bit-identical
+    back = relift_tree(out, new, old, axes=[0, 1, None])
+    for h, (lo, hi) in old.items():
+        np.testing.assert_array_equal(back[h][0], leaf_a[lo:hi])
+        np.testing.assert_array_equal(back[h][1], leaf_b[:, lo:hi])
+        np.testing.assert_array_equal(back[h][2], leaf_r)
+
+
+def test_relift_tree_rejects_bad_partitions():
+    blocks = {0: [np.zeros((2, 1))], 1: [np.zeros((2, 1))]}
+    with pytest.raises(MembershipError):
+        relift_tree(blocks, {0: (0, 2), 1: (2, 4)},
+                    {0: (0, 3), 1: (2, 4)}, axes=[0])  # overlap
+    with pytest.raises(MembershipError):
+        relift_tree(blocks, {0: (0, 2), 1: (1, 4)},
+                    {0: (0, 4)}, axes=[0])             # old overlaps
+
+
+# -- per-tick plan negotiation ------------------------------------------------
+
+
+def test_merge_picks_the_per_slot_max():
+    full = (TickSlot(KIND_DENSE_SIGNED, 3),)
+    closed = (TickSlot(KIND_DENSE_SIGNED, 2),)
+    assert merge_tick_plans([full, closed]) == full
+    # rung and BLS class rung also max per slot
+    a = (TickSlot(KIND_DENSE_SIGNED, 2, rung=256, bls_class_rung=1),)
+    b = (TickSlot(KIND_DENSE_SIGNED, 3, rung=512, bls_class_rung=4),)
+    assert merge_tick_plans([a, b]) == (
+        TickSlot(KIND_DENSE_SIGNED, 3, rung=512, bls_class_rung=4),)
+
+
+def test_merge_pads_missing_slots_and_hosts():
+    two = (TickSlot(KIND_DENSE_SIGNED, 3),
+           TickSlot(KIND_UNSIGNED, 2))
+    # a host with fewer slots contributes nothing to the tail slot
+    assert merge_tick_plans([two, two[:1]]) == two
+    # an idle host (no slots) adopts the whole merged plan
+    assert merge_tick_plans([(), two]) == two
+    assert merge_tick_plans([(), ()]) == ()
+    assert merge_tick_plans([]) == ()
+
+
+def test_merge_kind_divergence_fails_loudly():
+    with pytest.raises(MembershipError, match="statics divergence"):
+        merge_tick_plans([(TickSlot(KIND_DENSE_SIGNED, 3),),
+                          (TickSlot(KIND_UNSIGNED, 3),)])
+
+
+# -- the membership protocol --------------------------------------------------
+
+
+def test_leave_applies_at_boundary_not_before():
+    ep = MembershipEpoch(2, 8)
+    assert ep.view.ranges == {0: (0, 4), 1: (4, 8)}
+    assert ep.note_leave(1) is True
+    assert ep.note_leave(1) is False          # idempotent
+    # mid-epoch: partition unchanged, intent latched + broadcastable
+    assert ep.view.ranges == {0: (0, 4), 1: (4, 8)}
+    assert ep.pending() == (0b10, 0)
+    rep = ep.boundary()
+    assert rep is not None and rep.left == (1,)
+    assert ep.view.epoch == 1 and ep.view.alive == (0,)
+    assert ep.view.ranges == {0: (0, 8)}
+    assert rep.transfers == ((1, 0, 4, 8),)
+    # no pending change -> a boundary burns no epoch
+    assert ep.boundary() is None
+    assert ep.view.epoch == 1
+
+
+def test_rejoin_readmits_and_counts():
+    ep = MembershipEpoch(2, 8)
+    ep.note_leave(1)
+    ep.boundary()
+    assert ep.note_join(1) is True
+    rep = ep.boundary()
+    assert rep is not None and rep.joined == (1,)
+    assert ep.view.epoch == 2
+    assert ep.view.ranges == {0: (0, 4), 1: (4, 8)}
+    assert rep.transfers == ((0, 1, 4, 8),)
+    assert ep.readmissions == 1 and ep.departures == 1
+
+
+def test_rejoin_holddown_with_injected_clock():
+    clk = {"t": 100.0}
+    ep = MembershipEpoch(2, 8, rejoin_holddown_s=10.0,
+                         clock=lambda: clk["t"])
+    ep.note_leave(1)
+    ep.boundary()
+    clk["t"] = 105.0                          # inside the holddown
+    assert ep.note_join(1) is False
+    assert ep.deferred_joins == 1
+    assert ep.boundary() is None              # nothing latched
+    clk["t"] = 111.0                          # holddown aged out
+    assert ep.note_join(1) is True
+    rep = ep.boundary()
+    assert rep is not None and rep.joined == (1,)
+    assert ep.readmissions == 1
+
+
+def test_merge_intents_from_peer_masks():
+    a, b = MembershipEpoch(2, 8), MembershipEpoch(2, 8)
+    a.note_leave(1)
+    b.merge_intents(*a.pending())             # what the frame carries
+    assert b.pending() == a.pending()
+    ra, rb = a.boundary(), b.boundary()
+    assert ra.new.ranges == rb.new.ranges == {0: (0, 8)}
+
+
+def test_uneven_live_set_fails_loudly_at_boundary():
+    ep = MembershipEpoch(3, 9)                # 9 over 2 can't split
+    ep.note_leave(2)
+    with pytest.raises(MembershipError, match="evenly"):
+        ep.boundary()
+
+
+# -- the combined elastic frame codec ----------------------------------------
+
+
+def test_elastic_frame_round_trip():
+    from agnes_tpu.distributed.elastic import (
+        elastic_frame_capacity,
+        pack_elastic_frame,
+        unpack_elastic_frame,
+    )
+    from agnes_tpu.distributed.topology import pack_decision_frame
+
+    slots = (TickSlot(KIND_DENSE_SIGNED, 3),
+             TickSlot(KIND_UNSIGNED, 2, rung=0, bls_class_rung=4))
+    dec = pack_decision_frame(
+        1, np.asarray([5, 6]), np.asarray([2, -1]),
+        np.asarray([7, 7]), np.asarray([0, 1]), max_decisions=4)
+    reroute = bytes(range(96)) * 2            # two fake records
+    frame = pack_elastic_frame(
+        1, 3, 0b11, 0b10, 0b01, slots, dec, reroute,
+        max_slots=4, reroute_cap=96 * 4)
+    assert len(frame) == elastic_frame_capacity(4, 4, 96 * 4)
+    f = unpack_elastic_frame(frame, 4, 4, 96 * 4)
+    assert (f.host, f.epoch) == (1, 3)
+    assert (f.alive_mask, f.leave_mask, f.join_mask) == (3, 2, 1)
+    assert f.slots == slots
+    assert [(d.instance, d.host, d.round, d.value_id)
+            for d in f.decisions] == [(5, 1, 7, 2), (6, 1, 7, None)]
+    assert f.reroute == reroute
+
+
+def test_elastic_frame_capacity_enforced():
+    from agnes_tpu.distributed.elastic import (
+        pack_elastic_frame,
+        unpack_elastic_frame,
+    )
+    from agnes_tpu.distributed.topology import pack_decision_frame
+
+    dec = pack_decision_frame(0, np.asarray([], np.int64),
+                              np.asarray([], np.int64),
+                              np.asarray([], np.int64),
+                              np.asarray([], np.int64),
+                              max_decisions=1)
+    too_many = tuple(TickSlot(KIND_DENSE_SIGNED, 3)
+                     for _ in range(5))
+    with pytest.raises(MembershipError, match="slots"):
+        pack_elastic_frame(0, 0, 1, 0, 0, too_many, dec, b"",
+                           max_slots=4, reroute_cap=96)
+    with pytest.raises(MembershipError, match="reroute"):
+        pack_elastic_frame(0, 0, 1, 0, 0, (), dec, bytes(96 * 2),
+                           max_slots=4, reroute_cap=96)
+    with pytest.raises(MembershipError, match="whole"):
+        pack_elastic_frame(0, 0, 1, 0, 0, (), dec, bytes(95),
+                           max_slots=4, reroute_cap=96)
+    ok = pack_elastic_frame(0, 0, 1, 0, 0, (), dec, b"",
+                            max_slots=4, reroute_cap=96)
+    with pytest.raises(MembershipError, match="magic"):
+        unpack_elastic_frame(np.zeros_like(ok), 4, 1, 96)
+    with pytest.raises(MembershipError, match="capacities"):
+        unpack_elastic_frame(ok[:-1], 4, 1, 96)
+
+
+# -- StragglerMonitor recovery (the readmission satellite) --------------------
+
+
+def test_monitor_dead_verdict_recovers_and_counts():
+    clk = {"t": 100.0}
+    m = StragglerMonitor(2, 0, dead_after_s=30.0,
+                         straggler_after_s=5.0,
+                         clock=lambda: clk["t"])
+    clk["t"] = 140.0
+    assert m.dead() == [1]
+    # fresh evidence CLEARS the verdict (no longer permanent) ...
+    m.beat(1)
+    assert m.dead() == [] and m.check() == []
+    # ... and is counted as a readmission
+    assert m.readmissions == 1
+    # a live beat is not a readmission
+    m.beat(1)
+    assert m.readmissions == 1
+
+
+def test_monitor_fail_closed_without_membership_plane():
+    from agnes_tpu.distributed.topology import DeadHostError
+
+    clk = {"t": 0.0}
+    m = StragglerMonitor(2, 0, dead_after_s=30.0,
+                         straggler_after_s=5.0,
+                         clock=lambda: clk["t"])
+    clk["t"] = 40.0
+    with pytest.raises(DeadHostError):
+        m.check()                             # the ISSUE-15 contract
+
+
+def test_monitor_with_membership_degrades_to_intents():
+    clk = {"t": 0.0}
+    m = StragglerMonitor(2, 0, dead_after_s=30.0,
+                         straggler_after_s=5.0,
+                         clock=lambda: clk["t"])
+    ep = MembershipEpoch(2, 8)
+    m.attach_membership(ep)
+    clk["t"] = 40.0
+    assert m.check() == []                    # degrades, no raise
+    assert ep.pending() == (0b10, 0)          # leave latched once
+    m.check()
+    assert ep.pending() == (0b10, 0)
+    ep.boundary()
+    assert ep.view.alive == (0,)
+    # resumed evidence latches the join intent through the monitor
+    m.beat(1)
+    assert m.readmissions == 1
+    assert ep.pending() == (0, 0b10)
+    rep = ep.boundary()
+    assert rep.joined == (1,) and ep.readmissions == 1
+
+
+# -- live-membership budget threading (the plan satellite) --------------------
+
+
+class _FakeMesh:
+    def __init__(self, **shape):
+        self.shape = shape
+
+
+def test_mesh_local_shape_live_membership():
+    from agnes_tpu.utils.budget import mesh_local_shape
+
+    pod = _FakeMesh(slice=2, data=1, val=2)
+    # static pod: each of 2 hosts' slice divides by its data share
+    assert mesh_local_shape(pod, 4, 4, n_hosts=2) == (4, 2)
+    # shrunk to ONE live owner: its slice is the whole deployment,
+    # spread over the WHOLE data extent (the sleeper's devices stay
+    # in the mesh) — per-device load is unchanged, and the live
+    # divisor is what keeps the plan from under-claiming
+    assert mesh_local_shape(pod, 8, 4, n_hosts=2, n_live=1) == (4, 2)
+    with pytest.raises(ValueError, match="live membership"):
+        mesh_local_shape(pod, 8, 4, n_hosts=2, n_live=3)
+    with pytest.raises(ValueError, match="live membership"):
+        mesh_local_shape(pod, 8, 4, n_hosts=2, n_live=0)
+
+
+def test_plan_dense_replans_for_live_membership():
+    from agnes_tpu.serve.batcher import ShapeLadder
+
+    hbm = 1 << 34
+    static = ShapeLadder.plan_dense(8, 4, local_shape=(4, 2),
+                                    n_hosts=2, min_rung=4,
+                                    hbm_bytes=hbm)
+    # one live owner serves the WHOLE deployment: the top rung paces
+    # a full-deployment tick, twice the static per-host figure
+    shrunk = ShapeLadder.plan_dense(8, 4, local_shape=(4, 2),
+                                    n_hosts=2, n_live=1, min_rung=4,
+                                    hbm_bytes=hbm)
+    assert shrunk.max_rung == 2 * static.max_rung
+    with pytest.raises(ValueError, match="live membership"):
+        ShapeLadder.plan_dense(8, 4, n_hosts=2, n_live=3)
+    with pytest.raises(ValueError, match="repartition evenly"):
+        # 9 shards over 3 hosts, but 2 survivors cannot split it
+        ShapeLadder.plan_dense(9, 3, local_shape=(3, 3), n_hosts=3,
+                               n_live=2, min_rung=4, hbm_bytes=hbm)

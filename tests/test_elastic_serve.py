@@ -1,0 +1,135 @@
+"""Elastic pod membership plane: the spawned differential (ISSUE 17
+acceptance).
+
+Every plane runs in its OWN child interpreter (spawn_pod), composing
+with the XLA:CPU child-interpreter discipline (tests/conftest.py):
+two jax.distributed pod processes driven through ElasticShard's
+negotiated ticks — deliberately HETEROGENEOUS per-host traffic (the
+hosts close different batch shapes every tick; the per-tick
+max-merge + padding keeps lockstep) plus ONE host leave + rejoin
+cycle across membership epoch boundaries (the survivor adopts the
+sleeper's ranges, holds its gossip and re-routes it through the
+readmission boundary's frame) — one single-process mesh-serve
+comparison over the SAME global mesh shape, and one offline fused
+dense reference.  The parent never touches jax — elasticity must
+change NO decision and NO state leaf.
+
+Slow: each child pays its own compiles (the persistent cache is
+deliberately off), and the elastic workers warm TWO phase shapes.
+"""
+
+import numpy as np
+import pytest
+
+I, V, HEIGHTS = 4, 4, 4
+N_HOSTS, DPH, N_VAL = 2, 2, 2
+LEAVE, REJOIN = 2, 3            # host 1 absent for height 2
+
+
+@pytest.mark.slow
+def test_elastic_pod_bit_identical_through_membership_cycle(tmp_path):
+    """2-process elastic pod (heterogeneous traffic + leave/rejoin
+    cycle) == single-process mesh serve == offline fused: state/tally
+    leaf-for-leaf, height-stamped decision rows identical across
+    hosts, zero unexpected retraces, zero unwarmed compiles (the two
+    warmed phase shapes are the ONLY compiled entries), a completed
+    membership cycle with the held gossip re-routed and none of it
+    dropped, and the membership trail readable off the merged pod
+    postmortem."""
+    from agnes_tpu.distributed.smoke import spawn_pod
+    from agnes_tpu.utils.metrics_cli import main as metrics_main
+
+    res = spawn_pod(N_HOSTS, instances=I, validators=V,
+                    heights=HEIGHTS, devices_per_host=DPH,
+                    n_val=N_VAL, out_dir=str(tmp_path),
+                    timeout_s=2500, heartbeat=True, dump_state=True,
+                    elastic=True, leave_height=LEAVE,
+                    rejoin_height=REJOIN,
+                    extra_modes=["single", "offline"])
+    assert not res["killed"], res["paths"]
+    for rec in res["pod"] + [res["single"], res["offline"]]:
+        assert "error" not in rec, (rec, res["paths"])
+
+    n_sleeper_local = (I // N_HOSTS) * V
+    held = 2 * n_sleeper_local * (REJOIN - LEAVE)   # both classes
+    for rec in res["pod"]:
+        # the serve-plane invariants the static pod also holds
+        assert rec["retrace_unexpected"] == 0, rec
+        assert rec["rejected_signature_device"] == 0, rec
+        assert rec["offladder_builds"] == 0, rec
+        assert rec["host_fallback_builds"] == 0, rec
+        assert rec["compile_entries"] == ["sharded_step_seq_signed"], \
+            rec
+        # negotiation pads ONLY onto warmed shapes: P=2 and P=3 both
+        # warmed, nothing else ever compiled (retrace==0 above)
+        assert rec["warmed_shapes"] == 2, rec
+        assert rec["padded_slots"] > 0, rec
+        # elastic routing: nothing was foreign (the survivor ADOPTS
+        # the sleeper's ranges instead of rejecting its gossip)
+        assert rec["foreign_rejects"] == 0, rec
+        assert rec["held_dropped"] == 0, rec
+        assert rec["held_pending"] == 0, rec
+        # the membership cycle COMPLETED on every host: leave
+        # boundary + readmission boundary, one epoch each
+        assert rec["boundaries"] == 2, rec
+        assert rec["membership_epoch"] == 2, rec
+        assert rec["readmissions"] == 1, rec
+        assert rec["departures"] == 1, rec
+        assert rec["alive"] == [0, 1], rec
+        # despite the absence, EVERY height decided on every instance
+        assert rec["decisions_total"] == \
+            (I // N_HOSTS) * (HEIGHTS + 1), rec
+        assert rec["pod_decisions"] == I, rec
+
+    # the held gossip flowed survivor -> readmitted host, all of it
+    surv, sleeper = res["pod"][0], res["pod"][1]
+    assert surv["adopted_held"] == held, surv
+    assert surv["reroute_sent"] == held, surv
+    assert sleeper["reroute_received"] == held, sleeper
+    assert sleeper["adopted_held"] == 0 and sleeper["reroute_sent"] == 0
+
+    # both hosts gathered IDENTICAL height-stamped decision rows,
+    # covering every global instance with the decided value
+    rows0, rows1 = (r["pod_decision_rows"] for r in res["pod"])
+    assert rows0 == rows1
+    assert sorted(r[0] for r in rows0) == list(range(I))
+    assert all(r[3] == 7 for r in rows0)
+
+    assert res["single"]["decisions_total"] == I * (HEIGHTS + 1)
+    assert res["offline"]["decisions_total"] == I * (HEIGHTS + 1)
+
+    # leaf-for-leaf: host blocks concatenate host-major == global —
+    # elasticity (negotiated padding, the membership cycle, the held
+    # replay) changed NOTHING
+    pods = [np.load(res["paths"][f"pod{k}"]["npz"])
+            for k in range(N_HOSTS)]
+    single = np.load(res["paths"]["single"]["npz"])
+    offline = np.load(res["paths"]["offline"]["npz"])
+    assert set(single.files) == set(offline.files) == set(pods[0].files)
+    for key in single.files:
+        merged = np.concatenate([p[key] for p in pods], axis=0)
+        np.testing.assert_array_equal(
+            merged, single[key], err_msg=f"{key}: elastic vs single")
+        np.testing.assert_array_equal(
+            merged, offline[key], err_msg=f"{key}: elastic vs offline")
+
+    # one parseable host-id-stamped heartbeat per process, and the
+    # merged postmortem renders the membership trail (the
+    # observability satellite, end to end)
+    hbs = [res["paths"][f"pod{k}"]["heartbeat"]
+           for k in range(N_HOSTS)]
+    assert metrics_main(["--check"] + hbs) == 0
+    from agnes_tpu.utils.flightrec import (
+        read_heartbeat,
+        render_pod_postmortem,
+    )
+
+    for k, path in enumerate(hbs):
+        lines, _bad = read_heartbeat(path)
+        assert lines and all(ln["host_id"] == k for ln in lines), path
+    post = render_pod_postmortem(hbs)
+    assert "elastic membership:" in post
+    assert "epoch 2" in post
+    assert "membership_boundary=2" in post
+    assert "membership_relift" in post
+    assert "HELD GOSSIP DROPPED" not in post

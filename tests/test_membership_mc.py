@@ -1,0 +1,314 @@
+"""Pod-membership model checker (analysis/membership_mc.py, ISSUE 17)
+— model soundness over the REAL MembershipEpoch, mutation detection,
+corpus determinism, and the device-plane leg: every corpus entry's
+recorded repartitions re-lift REAL `seq_in_specs`/`dense_lane_specs`-
+shaped numpy leaves with `relift_tree` and the global assembly is
+bit-identical across the boundary.
+
+The model itself is pure numpy/stdlib with ZERO jax imports (asserted
+below); the spec-tree half imports jax for the mesh + spec trees but
+performs ZERO XLA compiles (pure numpy data movement), so the file
+sits in conftest._CHEAP."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from agnes_tpu.analysis import membership_mc as mm
+from agnes_tpu.analysis import modelcheck as mc
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "corpus",
+                          "membership")
+
+
+# ---------------------------------------------------------------------------
+# zero-jax guarantee (the ci.sh gate slot depends on it)
+# ---------------------------------------------------------------------------
+
+
+def test_membership_model_is_jax_free():
+    code = (
+        "import sys\n"
+        "from agnes_tpu.analysis import membership_mc as mm\n"
+        "rep = mm.explore_membership(mm.MembershipMCConfig("
+        "name='t', depth=6))\n"
+        "assert rep.states > 10 and not rep.violations\n"
+        "assert 'jax' not in sys.modules, 'jax leaked into the model'\n"
+        "print('JAXFREE-OK')\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0 and "JAXFREE-OK" in out.stdout, (
+        out.stdout, out.stderr)
+
+
+# ---------------------------------------------------------------------------
+# honest model: exhaustive-clean, deterministic, envelope-respecting
+# ---------------------------------------------------------------------------
+
+
+def test_tiny_scope_explores_clean_and_deterministic():
+    cfg = mm.MEMBERSHIP_TINY[0]
+    a = mm.explore_membership(cfg, collect_digests=True)
+    b = mm.explore_membership(cfg, collect_digests=True)
+    assert a.complete and not a.violations
+    assert a.states > 10
+    assert (a.states, a.transitions, a.digests) == \
+        (b.states, b.transitions, b.digests)
+
+
+def test_sleep_only_enabled_on_even_splits():
+    """The honest deployment envelope: on a 3-host pod a single leave
+    keeps the split even only when 2 | n_instances — the enabled set
+    must offer exactly the even-splitting departures."""
+    cfg = mm.MembershipMCConfig(name="env", n_hosts=3, n_instances=6,
+                                host_churn=2, max_height=1, depth=4)
+    sys_ = mm.MembershipSystem(cfg)
+    sleeps = [a for a in sys_.mc_enabled() if a[0] == "s"]
+    assert len(sleeps) == 3           # 6 % 2 == 0: all three may leave
+    # after one departure the live pair {a, b} may shrink to ONE host
+    # (6 % 1 == 0) — pod-of-one is in the envelope
+    assert sys_.mc_apply(("s", 2)) and sys_.mc_apply(("b",))
+    assert [a for a in sys_.mc_enabled() if a[0] == "s"]
+    # but a 4-instance pod of 3 hosts cannot exist at all (genesis
+    # split rule), and a 6-instance pod that lost one host cannot lose
+    # another on an odd count — model with 2 hosts x 3 instances each:
+    # the only prospective live set after one leave has size 1 (even)
+    cfg2 = mm.MembershipMCConfig(name="env2", n_hosts=2,
+                                 n_instances=6, host_churn=1,
+                                 max_height=1, depth=4)
+    sys2 = mm.MembershipSystem(cfg2)
+    assert len([a for a in sys2.mc_enabled() if a[0] == "s"]) == 2
+
+
+def test_held_traffic_replays_on_readmission():
+    """The sleepy-churn cycle by hand: traffic for a departed home is
+    HELD (no height progress), then replays into heights at the
+    readmission boundary — conservation all the way."""
+    cfg = mm.MembershipMCConfig(name="cycle", n_hosts=2,
+                                n_instances=2, host_churn=1,
+                                max_height=3, depth=12)
+    sys_, viols = mm.run_membership_with_monitors(
+        cfg, [("s", 1), ("b",), ("d", 1), ("d", 1), ("d", 0)])
+    assert not viols
+    assert sys_.heights == [1, 0] and sys_.held == [0, 2]
+    sys_.run_schedule([("w", 1), ("b",)])
+    assert sys_.heights == [1, 2] and sys_.held == [0, 0]
+    assert sys_.epoch.readmissions == 1
+    assert not mm.membership_state_violations(sys_)
+
+
+# ---------------------------------------------------------------------------
+# mutation self-test: every monitor has teeth
+# ---------------------------------------------------------------------------
+
+
+def test_membership_self_test_end_to_end():
+    out = mm.self_test_membership()
+    assert set(out) == set(mm.MEMBERSHIP_MUTANTS)
+    for name, r in out.items():
+        assert r["minimized_len"] <= r["schedule_len"]
+        assert r["counterexample"]["schedule"], name
+    # 1-minimality of the overlap counterexample is cheap to prove
+    name = "overlapping_range_repartition"
+    sys_cls, prop, cfg = mm.MEMBERSHIP_MUTANTS[name]
+    ce = out[name]["counterexample"]
+    small = [mm.MembershipSystem.action_from_json(a)
+             for a in ce["schedule"]]
+    for i in range(len(small)):
+        trial = small[:i] + small[i + 1:]
+        assert not trial or not mm.membership_reproduces(
+            cfg, trial, prop, system_cls=sys_cls)
+
+
+def test_monotonic_monitor_catches_height_regression():
+    """The third monitor's teeth without a registry mutant: a re-lift
+    that rolls one height back passes conservation arithmetic only if
+    it also forges `sent` — the edge monitor catches the regression
+    directly."""
+
+    class _Rollback(mm.MembershipSystem):
+        def _relift_held(self, rep):
+            super()._relift_held(rep)
+            for i in range(self.cfg.n_instances):
+                if self.heights[i]:
+                    self.heights[i] -= 1
+                    self.sent -= 1      # forge conservation
+                    break
+
+    cfg = mm.MembershipMCConfig(name="roll", n_hosts=2,
+                                n_instances=2, host_churn=1,
+                                max_height=2, depth=8)
+    rep = mm.explore_membership(cfg, system_cls=_Rollback)
+    caught = [c for c in rep.violations
+              if c.violation.property == "monotonic"]
+    assert caught, f"missed rollback in {rep.states} states"
+    small = mm.minimize_membership(cfg, caught[0].schedule,
+                                   "monotonic", system_cls=_Rollback)
+    assert mm.membership_reproduces(cfg, small, "monotonic",
+                                    system_cls=_Rollback)
+    _, honest = mm.run_membership_with_monitors(cfg, small)
+    assert not honest
+
+
+# ---------------------------------------------------------------------------
+# scope routing (the ci.sh gate aggregates membership_states from this)
+# ---------------------------------------------------------------------------
+
+
+def test_scope_worker_routes_membership_kind():
+    cfg = mm.MEMBERSHIP_TINY[0]
+    out = mc._scope_worker({"config": cfg.to_json(), "por": False,
+                            "deadline_at": None})
+    assert out["kind"] == "membership"
+    assert out["config"] == cfg.name
+    assert out["complete"] and out["states"] > 10
+    assert not out["violations"]
+
+
+# ---------------------------------------------------------------------------
+# regression corpus (tests/corpus/membership/*.json)
+# ---------------------------------------------------------------------------
+
+
+def test_membership_corpus_exists_and_covers():
+    entries = mc.load_corpus(CORPUS_DIR)
+    names = {e["name"] for e in entries}
+    assert len(entries) >= 5, names
+    assert {n for n in names if n.startswith("mem_mut_")} == {
+        f"mem_mut_{m}" for m in mm.MEMBERSHIP_MUTANTS}
+    assert set(mm.MEMBERSHIP_MILESTONES) <= names
+    assert all(e["kind"] == "membership" for e in entries)
+    # every milestone with traffic+boundaries records its repartitions
+    by_name = {e["name"]: e for e in entries}
+    assert by_name["mem_leave_hold_rejoin_replay"][
+        "expect"]["repartitions"]
+
+
+@pytest.mark.parametrize("entry", mc.load_corpus(CORPUS_DIR),
+                         ids=lambda e: e["name"])
+def test_membership_corpus_replays_deterministically(entry):
+    sys_, _ = mm.replay_membership_entry(entry)
+    sys2, _ = mm.replay_membership_entry(entry)
+    assert sys_.mc_digest() == sys2.mc_digest()
+
+
+def test_mutant_corpus_entries_are_honest_clean():
+    for e in mc.load_corpus(CORPUS_DIR):
+        if e["name"].startswith("mem_mut_"):
+            assert e["expect"]["violations"] == [], e["name"]
+
+
+# ---------------------------------------------------------------------------
+# device-plane leg: every recorded repartition re-lifts REAL spec-tree
+# shaped leaves bit-identically (zero XLA compiles — pure numpy moves)
+# ---------------------------------------------------------------------------
+
+
+def _spec_leaves():
+    """Flatten the production seq/dense spec trees the way the
+    multi-host driver does (DistributedDriver._lift_tree), and map
+    each leaf to its instance axis with the production
+    `instance_axis_of` — one source of truth with the dispatch
+    lift."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    from agnes_tpu.distributed.membership import instance_axis_of
+    from agnes_tpu.parallel import make_mesh
+    from agnes_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS
+    from agnes_tpu.parallel.sharded import dense_lane_specs, seq_in_specs
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    mesh = make_mesh(2, 4)
+    specs = jax.tree.leaves(
+        (seq_in_specs(mesh), dense_lane_specs(mesh)),
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+    axes = [instance_axis_of(tuple(s), (SLICE_AXIS, DATA_AXIS))
+            for s in specs]
+    assert any(a is not None for a in axes)     # instance-dim leaves
+    assert any(a is None for a in axes)         # replicated leaves
+    return specs, axes
+
+
+def _ranges_of(triples):
+    return {h: (lo, hi) for h, lo, hi in triples}
+
+
+@pytest.mark.parametrize(
+    "entry",
+    [e for e in mc.load_corpus(CORPUS_DIR)
+     if e["expect"]["repartitions"]],
+    ids=lambda e: e["name"])
+def test_membership_corpus_repartitions_relift_real_spec_trees(entry):
+    """For every repartition the corpus entry's honest replay crossed,
+    slice distinctive global leaves (one per production spec leaf) into
+    per-host blocks on the OLD partition, `relift_tree` them onto the
+    NEW one, and assert the global assembly is bit-identical — the
+    no-decision-loss contract on the exact leaf layout the elastic
+    driver re-lifts at a live boundary.  The round trip back must
+    restore the original blocks."""
+    from agnes_tpu.distributed.membership import relift_tree
+
+    specs, axes = _spec_leaves()
+    n = entry["config"]["n_instances"]
+    rng = np.random.default_rng(7)
+    for rep in entry["expect"]["repartitions"]:
+        old = _ranges_of(rep["old"])
+        new = _ranges_of(rep["new"])
+        # one global leaf per spec leaf: rank = the spec's constrained
+        # rank, instance dim sized n, other dims small but distinct
+        globals_, per_leaf_shape = [], []
+        for k, (spec, ax) in enumerate(zip(specs, axes)):
+            rank = max(len(tuple(spec)), 1)
+            shape = [2 + (k + d) % 3 for d in range(rank)]
+            if ax is not None:
+                shape[ax] = n
+            g = rng.integers(0, 2**31, size=shape).astype(np.int64)
+            globals_.append(g)
+            per_leaf_shape.append(shape)
+        blocks = {
+            h: [g if ax is None
+                else np.ascontiguousarray(np.take(
+                    g, np.arange(lo, hi), axis=ax))
+                for g, ax in zip(globals_, axes)]
+            for h, (lo, hi) in old.items()}
+        out = relift_tree(blocks, old, new, axes)
+        assert set(out) == set(new)
+        for k, (g, ax) in enumerate(zip(globals_, axes)):
+            if ax is None:
+                for h in new:
+                    np.testing.assert_array_equal(out[h][k], g)
+                continue
+            assembled = np.empty_like(g)
+            for h, (lo, hi) in new.items():
+                sel = [slice(None)] * g.ndim
+                sel[ax] = slice(lo, hi)
+                assembled[tuple(sel)] = out[h][k]
+            np.testing.assert_array_equal(assembled, g)
+        back = relift_tree(out, new, old, axes)
+        for h in old:
+            for k in range(len(globals_)):
+                np.testing.assert_array_equal(back[h][k],
+                                              blocks[h][k])
+
+
+def test_emit_membership_corpus_is_deterministic(tmp_path):
+    import json
+
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    mm.emit_membership_corpus(str(d1))
+    mm.emit_membership_corpus(str(d2))
+    files1 = sorted(os.listdir(d1))
+    assert files1 == sorted(os.listdir(d2))
+    for fn in files1:
+        assert (d1 / fn).read_text() == (d2 / fn).read_text()
+    # and the committed corpus matches a fresh emission (drift gate)
+    for fn in files1:
+        committed = os.path.join(CORPUS_DIR, fn)
+        assert os.path.exists(committed), fn
+        assert json.loads((d1 / fn).read_text()) == \
+            json.load(open(committed)), f"{fn}: corpus drifted"

@@ -654,6 +654,7 @@ def _run_cli(*args, timeout=240):
 
 def test_cli_tiny_scope_json():
     from agnes_tpu.analysis.admission_mc import ADMISSION_TINY
+    from agnes_tpu.analysis.membership_mc import MEMBERSHIP_TINY
 
     rc, rep = _run_cli("--scope", "tiny", "--json", "--workers", "1")
     assert rc == 0
@@ -663,24 +664,31 @@ def test_cli_tiny_scope_json():
     assert rep["metrics"]["modelcheck_states_explored"] == \
         rep["states_explored"]
     assert rep["metrics"]["modelcheck_violations"] == 0
-    # ISSUE 7: the scope sweeps BOTH domains and reports their splits
+    # ISSUE 7 + ISSUE 17: the scope sweeps ALL THREE domains and
+    # reports their splits
     assert rep["admission_states"] > 1000
-    assert rep["consensus_states"] + rep["admission_states"] == \
-        rep["states_explored"]
+    assert rep["membership_states"] > 0
+    assert (rep["consensus_states"] + rep["admission_states"]
+            + rep["membership_states"]) == rep["states_explored"]
     assert rep["metrics"]["modelcheck_admission_states"] == \
         rep["admission_states"]
+    assert rep["metrics"]["modelcheck_membership_states"] == \
+        rep["membership_states"]
     assert "modelcheck_sym_orbit_reduction" in rep["metrics"]
     assert set(rep["configs"]) == {c.name for c in mc.TINY_SCOPE} \
-        | {c.name for c in ADMISSION_TINY}
+        | {c.name for c in ADMISSION_TINY} \
+        | {c.name for c in MEMBERSHIP_TINY}
 
 
 def test_cli_self_test():
     from agnes_tpu.analysis.admission_mc import ADMISSION_MUTANTS
+    from agnes_tpu.analysis.membership_mc import MEMBERSHIP_MUTANTS
 
     rc, rep = _run_cli("--self-test", timeout=360)
     assert rc == 0 and rep["ok"]
     assert set(rep["self_test"]) == set(mc.MUTANTS) | set(mc.DEEP_MUTANTS)
     assert set(rep["self_test_admission"]) == set(ADMISSION_MUTANTS)
+    assert set(rep["self_test_membership"]) == set(MEMBERSHIP_MUTANTS)
 
 
 def test_cli_deadline_sentinel():

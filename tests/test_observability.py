@@ -325,6 +325,50 @@ def test_heartbeat_survives_sigkill_with_fresh_last_line(tmp_path):
     assert "stage at last beat: spin" in post
 
 
+def test_pod_postmortem_renders_membership_trail(tmp_path):
+    """ISSUE 17: the elastic membership story must be readable
+    straight off the `agnes-metrics` pod post-mortem — per-host epoch
+    in the ranked header, and the boundary / re-lift / hold-overflow
+    event counts by name in each host's summary."""
+    paths = []
+    for host in (0, 1):
+        rec = fr.FlightRecorder()
+        rec.event("membership_boundary", epoch=2, alive=[0, 1],
+                  joined=[1], left=[])
+        rec.event("membership_relift", src=0, dst=1, lo=4, hi=8,
+                  epoch=2)
+        path = str(tmp_path / f"hb{host}.ndjson")
+        fr.Heartbeat(path, interval_s=1e9, recorder=rec,
+                     host_id=host,
+                     sources=[lambda: {"pod_membership_epoch": 2,
+                                       "pod_host_readmissions": 1}],
+                     ).beat()
+        paths.append(path)
+    post = fr.render_postmortem(paths[0])
+    assert "elastic membership:" in post
+    assert "epoch 2" in post
+    assert "1 readmission(s)" in post
+    assert "membership_boundary=1" in post
+    assert "membership_relift=1" in post
+    assert "HELD GOSSIP DROPPED" not in post
+    pod = fr.render_pod_postmortem(paths)
+    assert "host 0" in pod and "host 1" in pod
+    assert pod.count("epoch 2)") == 2      # both header rows carry it
+    # a hold overflow — dropped held gossip — flags loudly
+    rec2 = fr.FlightRecorder()
+    rec2.event("membership_hold_overflow", dropped=3)
+    p3 = str(tmp_path / "hb_overflow.ndjson")
+    fr.Heartbeat(p3, interval_s=1e9, recorder=rec2).beat()
+    post3 = fr.render_postmortem(p3)
+    assert "membership_hold_overflow=1" in post3
+    assert "HELD GOSSIP DROPPED" in post3
+    # a membership-free trail renders no membership section at all
+    p4 = str(tmp_path / "hb_plain.ndjson")
+    fr.Heartbeat(p4, interval_s=1e9,
+                 recorder=fr.FlightRecorder()).beat()
+    assert "elastic membership:" not in fr.render_postmortem(p4)
+
+
 # -- /metrics endpoint --------------------------------------------------------
 
 def test_metrics_endpoint_scrape_parses_and_roundtrips(tmp_path):
