@@ -125,8 +125,7 @@ class MembershipSystem:
         assert cfg.n_instances % cfg.n_hosts == 0, \
             "genesis must split evenly (MembershipEpoch's own rule)"
         self.cfg = cfg
-        self.epoch = MembershipEpoch(cfg.n_hosts, cfg.n_instances,
-                                     clock=lambda: 0.0)
+        self.epoch = MembershipEpoch(cfg.n_hosts, cfg.n_instances)
         per = cfg.n_instances // cfg.n_hosts
         #: static home host of each instance — the host whose device
         #: block serves it; while the home is departed its traffic is
@@ -223,7 +222,11 @@ class MembershipSystem:
         """Re-lift held batches across the repartition: batches held
         for a READMITTED host replay into its instances' heights (the
         catch-up replay, elastic.py `_ingest_reroute`); batches whose
-        home is still departed merely change holder — a count no-op.
+        home is still departed STAY WITH THEIR HOLDER — a count
+        no-op, and exactly what the implementation does (the holder's
+        process keeps ticking even asleep, so it re-routes once the
+        home returns; `_take_reroute` targets the static home, never
+        the epoch owner, so no holder hand-off exists to lose them).
         The dropping mutant doctors exactly this stage."""
         for h in rep.joined:
             for i in range(self.cfg.n_instances):

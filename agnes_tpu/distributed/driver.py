@@ -365,14 +365,25 @@ class DistributedDriver(DeviceDriver):
     def _local_shape(self, n_live=None):
         from agnes_tpu.utils.budget import mesh_local_shape
 
-        # self.I is already the per-HOST slice: divide only by the
-        # data extent this host owns (the ISSUE 15 satellite fix).
-        # `n_live` < n_hosts re-plans against a shrunken elastic
-        # membership (ISSUE 17): pass the OWNED instance slice of the
-        # live partition as a bigger I via the caller's ladder replan
-        # — this hook only threads the live divisor through.
-        return mesh_local_shape(self.mesh, self.I, self.V,
-                                n_hosts=self.n_hosts, n_live=n_live)
+        # self.I is the STATIC per-host slice (the host plan divided
+        # the deployment before this driver saw it — ISSUE 15).  With
+        # a shrunken LIVE membership (ISSUE 17) a surviving owner
+        # serves the bigger slice I * n_hosts / live, spread over the
+        # mesh's data extent / live columns — so scale I up HERE and
+        # let mesh_local_shape's live divisor cancel it: the
+        # per-device figure stays invariant under membership changes
+        # (the global SPMD mesh never shrinks).  Passing the static
+        # slice with a live divisor would under-claim per-device
+        # instances by live/n_hosts — the HBM bound would pass on a
+        # shape the full deployment OOMs at.
+        live = self.n_hosts if n_live is None else int(n_live)
+        if live < 1 or (self.I * self.n_hosts) % live:
+            raise ValueError(
+                f"{self.I * self.n_hosts} instances do not "
+                f"repartition evenly over {live} live host(s)")
+        return mesh_local_shape(self.mesh, self.I * self.n_hosts // live,
+                                self.V, n_hosts=self.n_hosts,
+                                n_live=live)
 
     def state_copies(self):
         """Warmup's throwaway state/tally copies, as a jitted pod

@@ -30,16 +30,27 @@ This module layers the membership-and-negotiation control plane from
   at pod granularity: its PROCESS stays in the jax.distributed
   fabric dispatching pure padding (the global-SPMD mesh cannot
   shrink), while its instance ranges repartition onto the survivors.
-  A survivor's front door HOLDS gossip for adopted ranges (bounded by
-  `reroute_capacity`; overflow is counted, dropped, and event-logged
-  — bounded degradation, never a wedge) and re-routes the held bytes
-  — global-id 96-byte wire records, instance fields intact — through
-  the frame once the owner's range is live again; the readmitted host
-  replays them in height order and catches up.  What still fails
-  closed: a host dead to the FABRIC (not just the membership plane)
-  still hangs jax collectives — the monitor without a membership
-  plane attached keeps raising DeadHostError for exactly that
-  reason.
+  Held gossip routes by STATIC HOME — the host whose device block
+  serves an instance — exactly the model checker's `_home_serving`
+  predicate (analysis/membership_mc.py), so the implementation walks
+  the proven path: the current epoch OWNER of a range holds records
+  whose static home is departed (bounded by `reroute_capacity`;
+  overflow is counted, dropped, and event-logged — bounded
+  degradation, never a wedge); records whose home is alive are never
+  held — the home's own front door serves them, and holding them
+  here would only manufacture duplicates while burning reroute
+  capacity.  Once the home is live again (its rejoin rides the
+  prospective view of the readmission boundary's own frame) the
+  holder re-routes the held bytes — global-id 96-byte wire records,
+  instance fields intact — and the home, the ONE peer whose static
+  screen absorbs them, replays them in height order and catches up.
+  While the home stays away the holder simply keeps the records —
+  even across its own departure, since a sleeping process still
+  ticks — which is the lossless holder bookkeeping the checker's
+  `_relift_held` models.  What still fails closed: a host dead to
+  the FABRIC (not just the membership plane) still hangs jax
+  collectives — the monitor without a membership plane attached
+  keeps raising DeadHostError for exactly that reason.
 
 The frame codec and negotiator below are jax-free (numpy + the
 topology codec) so tests/test_elastic.py exercises them in-process;
@@ -226,7 +237,7 @@ class ElasticShard(HostShard):
 
     def __init__(self, driver, batcher, pubkeys=None, *,
                  membership: Optional[MembershipEpoch] = None,
-                 rejoin_holddown_s: float = 0.0,
+                 rejoin_holddown_ticks: int = 0,
                  max_slots: int = 8,
                  reroute_capacity: Optional[int] = None,
                  clock=time.monotonic,
@@ -239,8 +250,7 @@ class ElasticShard(HostShard):
                 f"{MAX_POD_HOSTS} hosts ({self.n_hosts} configured)")
         self.membership = membership if membership is not None else \
             MembershipEpoch(self.n_hosts, driver.global_I,
-                            rejoin_holddown_s=rejoin_holddown_s,
-                            clock=clock)
+                            rejoin_holddown_ticks=rejoin_holddown_ticks)
         if (self.membership.view.n_hosts != self.n_hosts
                 or self.membership.view.n_instances
                 != driver.global_I):
@@ -265,10 +275,11 @@ class ElasticShard(HostShard):
         self._clock = clock
         self.negotiation_ticks = 0
         self.padded_slots = 0          # slots this host padded up/into
-        self.adopted_held = 0          # records held for away owners
+        self.adopted_held = 0          # records held for away homes
         self.held_dropped = 0          # capacity overflow (degrades)
         self.reroute_sent = 0
         self.reroute_received = 0
+        self.reroute_reheld = 0        # stray reroutes re-held (bug net)
         self.boundaries = 0            # applied repartitions
         self._mirror_membership()
 
@@ -293,14 +304,32 @@ class ElasticShard(HostShard):
 
     # -- ingress: membership-aware front door --------------------------------
 
+    def _alive_lut(self, view) -> np.ndarray:
+        """[n_hosts] bool: is host h alive under `view`?"""
+        lut = np.zeros(self.n_hosts, bool)
+        lut[list(view.alive)] = True
+        return lut
+
+    def _home_of(self, inst: np.ndarray) -> np.ndarray:
+        """STATIC home host of each global instance id — the host
+        whose device block serves it (HostPlan.host_of, vectorized).
+        Clipped so an out-of-range id indexes safely (such a record
+        never passes the owned-range screen anyway)."""
+        return np.minimum(inst // self.plan.local_instances,
+                          self.n_hosts - 1)
+
     def submit(self, wire_bytes):
         """The HostShard screen, elastically: records in this host's
-        static block feed the local service; records in ranges the
-        current epoch ADOPTED onto this host (their owner is away) are
-        HELD for re-routing instead of foreign-rejected; the rest are
-        foreign as before.  Holding is capacity-bounded: overflow
-        drops are counted and event-logged, never a wedge (module
-        docstring)."""
+        static block feed the local service; records this host
+        epoch-OWNS whose STATIC home host is departed are HELD for
+        re-routing instead of foreign-rejected (the model checker's
+        `_home_serving` predicate — module docstring); the rest are
+        foreign as before.  In particular a record in this host's
+        owned range whose home is another LIVE host is foreign, not
+        adopted: the home's own front door serves it, and holding it
+        here would replay it as a duplicate while consuming reroute
+        capacity.  Holding is capacity-bounded: overflow drops are
+        counted and event-logged, never a wedge (module docstring)."""
         buf = np.frombuffer(bytes(wire_bytes), np.uint8)
         n = len(buf) // REC_SIZE
         tail = buf[n * REC_SIZE:]
@@ -313,7 +342,9 @@ class ElasticShard(HostShard):
         adopt = np.zeros(n, bool)
         if owned is not None:
             vlo, vhi = owned
-            adopt = (inst >= vlo) & (inst < vhi) & ~mine
+            home_away = ~self._alive_lut(
+                self.membership.view)[self._home_of(inst)]
+            adopt = (inst >= vlo) & (inst < vhi) & ~mine & home_away
         if adopt.any():
             self._hold(rec[adopt])
         foreign = int(n - mine.sum() - adopt.sum())
@@ -378,21 +409,28 @@ class ElasticShard(HostShard):
             hts, self._frame_cap)
 
     def _take_reroute(self, view) -> bytes:
-        """Pop held records whose owner under `view` is ANOTHER live
-        host — the bytes the next frame re-routes (capacity-bounded;
-        leftovers go on later ticks)."""
+        """Pop held records whose STATIC home host is alive under
+        `view` — the bytes the next frame re-routes, so the home's
+        own front door (the ONE peer whose `_ingest_reroute` absorbs
+        them) replays them.  Capacity-bounded; leftovers go on later
+        ticks.  Records whose home is still departed stay held HERE,
+        across any intervening repartition and even across this
+        holder's own departure (a sleeping process keeps ticking):
+        targeting the EPOCH owner instead would hand records to a
+        host whose static screen discards them — silent decision
+        loss the checker's lossless holder bookkeeping never
+        modeled."""
         if not self._held:
             return b""
         send: List[np.ndarray] = []
         keep: List[np.ndarray] = []
         cap = self.reroute_capacity // REC_SIZE
+        alive = self._alive_lut(view)
+        per = self.plan.local_instances
         for row in self._held:
             i = int(wire_instance_ids(row[None, :])[0])
-            try:
-                owner = view.owner_of(i)
-            except MembershipError:
-                owner = self.host       # unowned: keep holding
-            if owner != self.host and len(send) < cap:
+            home = min(i // per, self.n_hosts - 1)
+            if alive[home] and len(send) < cap:
                 send.append(row)
             else:
                 keep.append(row)
@@ -404,8 +442,15 @@ class ElasticShard(HostShard):
         """Absorb re-routed records addressed to THIS host's static
         block (the readmitted owner's catch-up path): global-id wire
         bytes, screened and rebased like any gossip — but via the
-        LOCAL service directly, so they are never re-held or
-        foreign-counted (the sender already routed them)."""
+        LOCAL service directly, so they are never foreign-counted
+        (the sender already routed them).  The reroute section rides
+        the allgathered frame, so every host sees every sender's
+        bytes: records for OTHER hosts' static blocks are theirs to
+        absorb and are ignored here — EXCEPT a record whose home is
+        still departed (a sender bug: honest reroutes only ever
+        target live homes), which the current epoch owner RE-HOLDS,
+        counted and event-logged, instead of letting it silently
+        fall out of the protocol."""
         n = len(raw) // REC_SIZE
         if not n:
             return
@@ -413,15 +458,29 @@ class ElasticShard(HostShard):
             n, REC_SIZE).copy()
         inst = wire_instance_ids(rec)
         mine = (inst >= self.lo) & (inst < self.hi)
-        if not mine.any():
-            return
-        kept = rec[mine]
-        from agnes_tpu.distributed.topology import \
-            shift_instances_inplace
+        if mine.any():
+            kept = rec[mine]
+            from agnes_tpu.distributed.topology import \
+                shift_instances_inplace
 
-        shift_instances_inplace(kept, -self.lo)
-        self.reroute_received += int(mine.sum())
-        self.service.submit(kept.tobytes())
+            shift_instances_inplace(kept, -self.lo)
+            self.reroute_received += int(mine.sum())
+            self.service.submit(kept.tobytes())
+        if mine.all():
+            return
+        view = self.membership.view
+        owned = view.owned_range(self.host)
+        if owned is None:
+            return
+        stray = (~mine & (inst >= owned[0]) & (inst < owned[1])
+                 & ~self._alive_lut(view)[self._home_of(inst)])
+        if stray.any():
+            self.reroute_reheld += int(stray.sum())
+            self._hold(rec[stray])
+            if self.service.flightrec is not None:
+                self.service.flightrec.event(
+                    "membership_reroute_rehold", host=self.host,
+                    records=int(stray.sum()), epoch=view.epoch)
 
     def tick(self, now: Optional[float] = None,
              boundary: bool = False) -> dict:
@@ -435,6 +494,10 @@ class ElasticShard(HostShard):
         padding, which is exactly what keeps the global-SPMD
         collectives lockstep while its ranges are away."""
         t0 = self._clock()
+        # advance the lockstep logical clock FIRST: intents latched
+        # anywhere in this tick (monitor verdicts, merged peer masks)
+        # stamp against the same pod-identical counter
+        self.membership.note_tick()
         self.monitor.check()   # degrades to leave intents (attached)
         # 1. close the micro-batch and stage builds — NO dispatch yet
         batch = self.service.micro.flush()
@@ -600,5 +663,6 @@ class ElasticShard(HostShard):
             "held_pending": len(self._held),
             "reroute_sent": self.reroute_sent,
             "reroute_received": self.reroute_received,
+            "reroute_reheld": self.reroute_reheld,
         }
         return rep

@@ -34,8 +34,14 @@ mid-epoch (a departed host is TOB-SVD sleepy churn at pod granularity
 — it stops serving, the pod does not stop ticking) and apply ONLY at
 epoch boundaries, where the partition recomputes, held gossip
 re-routes along `relift_ranges`, and a returned host is readmitted —
-after an injectable-clock holddown, so a flapping peer cannot churn
-the partition every tick.
+after a LOGICAL-TICK holddown, so a flapping peer cannot churn the
+partition every tick.  The holddown clock is `note_tick` (every host
+advances it at the same lockstep protocol point) and departures are
+stamped at the boundary that applied them, so every holddown verdict
+is a pure function of pod-shared state — per-process wall clocks are
+deliberately NOT consulted: hosts near a wall-clock threshold would
+disagree on deferring a merged join, diverge their pending sets, and
+wedge the pod on the next epoch/alive statics check.
 
 Pure numpy + stdlib; no jax anywhere (conftest _CHEAP eligible).
 """
@@ -43,7 +49,6 @@ Pure numpy + stdlib; no jax anywhere (conftest _CHEAP eligible).
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import (
     Dict,
     Iterable,
@@ -302,15 +307,15 @@ class Repartition:
 
 class MembershipEpoch:
     """Leave/join intents latch mid-epoch, apply at boundaries
-    (module docstring).  The clock is injectable so readmission
-    holddown tests with stubbed time; counters are plain ints the
-    owning shard mirrors into its metrics registry."""
+    (module docstring).  The rejoin holddown counts LOGICAL ticks
+    (`note_tick` — injectable progression for tests, lockstep in
+    production); counters are plain ints the owning shard mirrors
+    into its metrics registry."""
 
     def __init__(self, n_hosts: int, n_instances: int, *,
-                 rejoin_holddown_s: float = 0.0,
-                 clock=time.monotonic):
-        self.clock = clock
-        self.rejoin_holddown_s = float(rejoin_holddown_s)
+                 rejoin_holddown_ticks: int = 0):
+        self.rejoin_holddown_ticks = int(rejoin_holddown_ticks)
+        self.tick = 0                  # the lockstep logical clock
         view = MembershipView(
             epoch=0, n_hosts=int(n_hosts),
             n_instances=int(n_instances),
@@ -320,12 +325,29 @@ class MembershipEpoch:
         self.view = view
         self._pending_leave: set = set()
         self._pending_join: set = set()
-        self._left_at: Dict[int, float] = {}
+        #: tick of the BOUNDARY that applied each departure — a
+        #: lockstep point where every host holds the identical merged
+        #: intents and tick counter, so the stamp (and every holddown
+        #: verdict derived from it) is identical pod-wide
+        self._left_at: Dict[int, int] = {}
         self.readmissions = 0          # applied rejoins (boundaries)
         self.departures = 0
         self.deferred_joins = 0        # holddown pushed a join back
 
     # -- intents (latch mid-epoch, apply at boundary) ------------------------
+
+    def note_tick(self) -> int:
+        """Advance the pod-lockstep logical clock one elastic tick.
+        Every host calls this at the same protocol point
+        (ElasticShard.tick, before intents merge), so the counter is
+        identical pod-wide — which is what makes the rejoin-holddown
+        verdict deterministic: an originator that latches a join at
+        tick T broadcasts it on the NEXT frame, so every peer
+        evaluates the (monotone) holddown predicate at tick >= T and
+        latches too.  Wall clocks cannot give that guarantee (module
+        docstring)."""
+        self.tick += 1
+        return self.tick
 
     def note_leave(self, host: int) -> bool:
         """Latch a leave intent (idempotent).  Returns True when newly
@@ -337,22 +359,26 @@ class MembershipEpoch:
             return False
         self._pending_leave.add(host)
         self._pending_join.discard(host)
-        self._left_at[host] = self.clock()
         return True
 
     def note_join(self, host: int) -> bool:
         """Latch a join intent for a departed (or departing) host.
-        A join inside the rejoin holddown window is DEFERRED (counted,
-        returns False): a flapping peer must stay quiet for
-        `rejoin_holddown_s` before the pod repartitions for it."""
+        A join within `rejoin_holddown_ticks` of the boundary that
+        APPLIED the departure is DEFERRED (counted, returns False): a
+        flapping peer must stay quiet before the pod repartitions for
+        it.  A leave still latched but not yet applied carries no
+        holddown — cancelling it intra-epoch is free (no partition
+        ever moved).  The verdict is deterministic pod-wide: both
+        operands are lockstep state (`_left_at` stamps at boundaries,
+        `tick` advances via note_tick)."""
         host = int(host)
         already = (host in self.view.alive
                    and host not in self._pending_leave)
         if already or host in self._pending_join:
             return False
         left = self._left_at.get(host)
-        if left is not None and self.rejoin_holddown_s > 0 \
-                and self.clock() - left < self.rejoin_holddown_s:
+        if left is not None and self.rejoin_holddown_ticks > 0 \
+                and self.tick - left < self.rejoin_holddown_ticks:
             self.deferred_joins += 1
             return False
         self._pending_join.add(host)
@@ -402,8 +428,8 @@ class MembershipEpoch:
         under check is THIS class, so the hook lives here).  Views are
         frozen and shared; intent sets are copied."""
         c = type(self).__new__(type(self))
-        c.clock = self.clock
-        c.rejoin_holddown_s = self.rejoin_holddown_s
+        c.rejoin_holddown_ticks = self.rejoin_holddown_ticks
+        c.tick = self.tick
         c.view = self.view
         c._pending_leave = set(self._pending_leave)
         c._pending_join = set(self._pending_join)
@@ -418,7 +444,11 @@ class MembershipEpoch:
         intents.  The epoch COUNTER is deliberately excluded — two
         states differing only in how many boundaries produced the same
         partition are behaviorally identical, and excluding it keeps
-        the explored space finite."""
+        the explored space finite.  `tick`/`_left_at` are excluded for
+        the same reason: with the checker's holddown of 0 (the
+        membership_mc configs) they are behaviorally inert, and an
+        exploration of a nonzero holddown would have to add them to
+        the key alongside a tick bound."""
         return (self.view.alive,
                 tuple(sorted((h, r) for h, r in self.view.ranges.items())),
                 self.pending())
@@ -450,6 +480,13 @@ class MembershipEpoch:
         self.view = new
         self.readmissions += len(joined)
         self.departures += len(left)
+        for h in left:
+            # the holddown clock starts HERE, not at note_leave: the
+            # boundary is a lockstep point (same merged intents, same
+            # tick on every host), so the stamp is pod-identical —
+            # and a leave cancelled before any boundary never aged a
+            # partition, so it owes no holddown
+            self._left_at[h] = self.tick
         for h in joined:
             self._left_at.pop(h, None)
         return rep

@@ -518,12 +518,23 @@ class ServePipeline:
         warmup() compiled for this P, and a state-machine no-op on
         every instance.  What a host dispatches for a negotiated tick
         slot it has no traffic for, so the pod's collective order
-        stays lockstep.  Returns the tick id."""
+        stays lockstep.  Returns the tick id.
+
+        `n_phases` is honored EXACTLY (total P at dispatch, entry
+        included): a negotiated slot is the per-tick max of the pod's
+        staged builds, and padding to any OTHER P would hand
+        PodCoordinator.agree differing plans on an honest-
+        heterogeneity tick — a spurious pod abort.  n_phases=1 stages
+        a pure-entry build (no vote phases, no lanes: the entry
+        carries none, warmup's own convention)."""
+        if int(n_phases) < 1:
+            raise ValueError(
+                f"a padding build needs n_phases >= 1: {n_phases}")
         hts = self.batcher.heights.copy()
-        Ps = max(int(n_phases) - 1, 1)
+        Ps = int(n_phases) - 1
         phases = [self._entry_phase(hts)] * Ps
         lanes = None
-        if signed and self.pubkeys is not None and self.dense:
+        if Ps and signed and self.pubkeys is not None and self.dense:
             from agnes_tpu.device.step import DenseSignedPhases
 
             d = self.driver

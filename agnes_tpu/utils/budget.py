@@ -236,10 +236,15 @@ def mesh_local_shape(mesh, n_instances: int, n_validators: int,
     the fixed jax mesh serving padding.  A live owner's instance
     slice is n_instances_global / n_live spread over
     global_data / n_live device columns, so the per-device figure
-    must divide by the LIVE count, not the static one — planning a
-    shrunken pod's bigger slice against the static divisor
-    under-claims per-device instances (tiles sized for work that no
-    longer fits them).  Defaults to `n_hosts` (the static pod)."""
+    must divide by the LIVE count, not the static one.  CALLER
+    CONTRACT: with `n_live` set, `n_instances` must be the slice the
+    live owner actually SERVES (static per-host slice scaled by
+    n_hosts / n_live — DistributedDriver._local_shape does this), so
+    the live divisors cancel and the per-device figure is INVARIANT
+    under membership changes, as the fixed SPMD mesh dictates.
+    Passing the static per-host slice instead shrinks the figure by
+    live/n_hosts — an HBM under-claim that OOMs at full shape.
+    Defaults to `n_hosts` (the static pod)."""
     if mesh is None:
         return int(n_instances), int(n_validators)
     from agnes_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS, VAL_AXIS

@@ -180,21 +180,61 @@ def test_rejoin_readmits_and_counts():
     assert ep.readmissions == 1 and ep.departures == 1
 
 
-def test_rejoin_holddown_with_injected_clock():
-    clk = {"t": 100.0}
-    ep = MembershipEpoch(2, 8, rejoin_holddown_s=10.0,
-                         clock=lambda: clk["t"])
+def test_rejoin_holddown_with_injected_ticks():
+    # the holddown clock is the lockstep LOGICAL tick (note_tick) and
+    # the departure stamps at the boundary that applied it — both
+    # pod-shared state, so the deferral verdict cannot diverge across
+    # hosts the way per-process wall clocks near a threshold would
+    ep = MembershipEpoch(2, 8, rejoin_holddown_ticks=3)
+    ep.note_tick()
     ep.note_leave(1)
-    ep.boundary()
-    clk["t"] = 105.0                          # inside the holddown
+    ep.boundary()                             # departure stamps tick 1
+    ep.note_tick()                            # tick 2: 1 tick elapsed
     assert ep.note_join(1) is False
     assert ep.deferred_joins == 1
     assert ep.boundary() is None              # nothing latched
-    clk["t"] = 111.0                          # holddown aged out
+    ep.note_tick()
+    ep.note_tick()                            # tick 4: holddown aged out
     assert ep.note_join(1) is True
     rep = ep.boundary()
     assert rep is not None and rep.joined == (1,)
     assert ep.readmissions == 1
+
+
+def test_rejoin_holddown_verdict_identical_across_hosts():
+    # an originator that latches a join broadcasts it on the NEXT
+    # frame: peers evaluate the (monotone-in-tick) holddown predicate
+    # at the same or a later tick, so a join latched anywhere latches
+    # everywhere — the pending sets never diverge
+    a, b = (MembershipEpoch(2, 8, rejoin_holddown_ticks=2)
+            for _ in range(2))
+    for ep in (a, b):
+        ep.note_tick()
+        ep.note_leave(1)
+        ep.boundary()                         # both stamp tick 1
+        ep.note_tick()
+        ep.note_tick()                        # tick 3
+    assert a.note_join(1) is True             # 3 - 1 >= 2: latches
+    for ep in (a, b):
+        ep.note_tick()                        # the broadcast tick
+    b.merge_intents(*a.pending())
+    assert b.pending() == a.pending()
+    assert b.deferred_joins == 0
+    ra, rb = a.boundary(), b.boundary()
+    assert ra.new.ranges == rb.new.ranges
+
+
+def test_rejoin_holddown_skips_unapplied_leaves():
+    # a leave cancelled before any boundary never moved the partition
+    # — the intra-epoch flap owes no holddown (the mem_flap corpus
+    # milestone's semantics)
+    ep = MembershipEpoch(2, 8, rejoin_holddown_ticks=5)
+    ep.note_tick()
+    ep.note_leave(1)
+    assert ep.note_join(1) is True
+    assert ep.deferred_joins == 0
+    assert ep.boundary() is None              # net no-op, no epoch burned
+    assert ep.view.epoch == 0
 
 
 def test_merge_intents_from_peer_masks():
@@ -326,6 +366,142 @@ def test_monitor_with_membership_degrades_to_intents():
     assert ep.pending() == (0, 0b10)
     rep = ep.boundary()
     assert rep.joined == (1,) and ep.readmissions == 1
+
+
+# -- static-home gossip routing (jax-free ElasticShard surface) ---------------
+
+
+class _SinkService:
+    """The slice of VoteService the front-door screen touches."""
+
+    def __init__(self):
+        self.got = []
+        self.flightrec = None
+
+        class _M:
+            @staticmethod
+            def count(*a, **k):
+                pass
+
+        self.metrics = _M()
+
+    def submit(self, b):
+        self.got.append(bytes(b))
+
+
+def _rec(inst):
+    from agnes_tpu.bridge.native_ingest import REC_SIZE
+
+    r = np.zeros(REC_SIZE, np.uint8)
+    r[0:4] = np.asarray([inst], np.uint32).view(np.uint8)
+    return r
+
+
+def _routing_shard(host, membership, per=3):
+    """An ElasticShard reduced to its routing surface: the screen
+    methods only touch plan/lo/hi/membership/service, so the jax-free
+    predicate is testable without a driver or a backend."""
+    from agnes_tpu.bridge.native_ingest import REC_SIZE
+    from agnes_tpu.distributed.elastic import ElasticShard
+    from agnes_tpu.distributed.topology import HostPlan
+
+    sh = ElasticShard.__new__(ElasticShard)
+    sh.n_hosts = membership.view.n_hosts
+    sh.host = host
+    sh.plan = HostPlan(sh.n_hosts, sh.n_hosts * per)
+    sh.lo, sh.hi = host * per, (host + 1) * per
+    sh.membership = membership
+    sh.service = _SinkService()
+    sh.reroute_capacity = 64 * REC_SIZE
+    sh._held = []
+    sh.foreign_rejects = sh.adopted_held = sh.held_dropped = 0
+    sh.reroute_sent = sh.reroute_received = sh.reroute_reheld = 0
+    return sh
+
+
+def _departed(n_hosts, per, *left):
+    ep = MembershipEpoch(n_hosts, n_hosts * per)
+    for h in left:
+        ep.note_leave(h)
+    ep.boundary()
+    return ep
+
+
+def test_submit_holds_departed_homes_only():
+    # 4 hosts x 3: host 2 away -> ranges {0:(0,4), 1:(4,8), 3:(8,12)}.
+    # Host 1 (static 3..6, owns 4..8): inst 6,7 have home 2 (away) ->
+    # HELD; inst 3 is static-mine even though epoch-owned by host 0;
+    # inst 8 is epoch-foreign.
+    ep = _departed(4, 3, 2)
+    assert ep.view.ranges == {0: (0, 4), 1: (4, 8), 3: (8, 12)}
+    sh = _routing_shard(1, ep)
+    sh.submit(b"".join(_rec(i).tobytes() for i in (3, 6, 7, 8)))
+    assert sh.adopted_held == 2 and len(sh._held) == 2
+    assert sh.foreign_rejects == 1
+    # the static-mine record reached the local service, rebased
+    from agnes_tpu.distributed.topology import wire_instance_ids
+
+    kept = np.frombuffer(sh.service.got[0], np.uint8)
+    assert list(wire_instance_ids(kept.reshape(1, -1))) == [0]
+
+
+def test_submit_rejects_live_homes_in_owned_range():
+    # hosts 2 AND 3 away -> ranges {0:(0,6), 1:(6,12)}.  Host 0 owns
+    # 0..6 but inst 3,4,5 belong to host 1's static block and host 1
+    # is ALIVE: its own front door serves them, so adopting here would
+    # replay duplicates — they must be foreign, never held.
+    ep = _departed(4, 3, 2, 3)
+    assert ep.view.ranges == {0: (0, 6), 1: (6, 12)}
+    sh = _routing_shard(0, ep)
+    sh.submit(b"".join(_rec(i).tobytes() for i in (3, 4, 5)))
+    assert sh.adopted_held == 0 and sh._held == []
+    assert sh.foreign_rejects == 3
+    # host 1 holds for BOTH departed static blocks it now owns
+    sh1 = _routing_shard(1, ep)
+    sh1.submit(b"".join(_rec(i).tobytes() for i in (6, 8, 9, 11)))
+    assert sh1.adopted_held == 4 and sh1.foreign_rejects == 0
+
+
+def test_take_reroute_targets_static_home_not_epoch_owner():
+    # host 1 holds inst 6 (home 2) and inst 10 (home 3) while both
+    # are away; host 2 rejoins.  Only inst 6 may travel: inst 10's
+    # epoch owner is a live host whose static screen would discard it
+    # (the silent-loss path) — it stays with its holder.
+    ep = _departed(4, 3, 2, 3)
+    sh = _routing_shard(1, ep)
+    sh._hold(np.stack([_rec(6), _rec(10)]))
+    ep.note_join(2)
+    ep.boundary()
+    out = sh._take_reroute(ep.view)
+    from agnes_tpu.bridge.native_ingest import REC_SIZE
+    from agnes_tpu.distributed.topology import wire_instance_ids
+
+    sent = np.frombuffer(out, np.uint8).reshape(-1, REC_SIZE)
+    assert list(wire_instance_ids(sent)) == [6]
+    assert sh.reroute_sent == 1 and len(sh._held) == 1
+
+
+def test_ingest_reroute_absorbs_static_block_and_reholds_strays():
+    ep = _departed(4, 3, 3)
+    raw = b"".join(_rec(i).tobytes() for i in (6, 7, 0))
+    # the readmitted home (host 2, static 6..9) absorbs its records
+    # rebased; host 0's record is another screen's business
+    sh2 = _routing_shard(2, ep)
+    sh2._ingest_reroute(raw)
+    assert sh2.reroute_received == 2 and sh2.reroute_reheld == 0
+    from agnes_tpu.distributed.topology import wire_instance_ids
+
+    kept = np.frombuffer(sh2.service.got[0], np.uint8)
+    assert list(wire_instance_ids(kept.reshape(2, -1))) == [0, 1]
+    # a stray addressed to a STILL-DEPARTED home (sender bug) is
+    # re-held by the current epoch owner, not dropped: host 2 owns
+    # 8..12 after host 3 left, so inst 10 (home 3) re-holds there
+    ep3 = _departed(4, 3, 3)
+    assert ep3.view.ranges[2] == (8, 12)
+    sh = _routing_shard(2, ep3)
+    sh._ingest_reroute(_rec(10).tobytes())
+    assert sh.reroute_received == 0
+    assert sh.reroute_reheld == 1 and len(sh._held) == 1
 
 
 # -- live-membership budget threading (the plan satellite) --------------------
