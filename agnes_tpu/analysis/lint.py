@@ -87,6 +87,7 @@ HOT_PATHS: Dict[str, Set[str]] = {
 #: call sites passing unhashable literals to these are LINT003
 STATIC_KWARGS = frozenset({
     "axis_name", "advance_height", "verify_chunk", "heights", "donate",
+    "pallas_field",
 })
 
 #: modules sanctioned to DEFINE import-time jits; everything they

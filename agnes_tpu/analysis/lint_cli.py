@@ -14,6 +14,14 @@ compiles (abstract tracing only) — and exits non-zero on any finding:
            .acquire()/.release() anywhere in serve//utils.metrics
   lint     serve hot-path host syncs, unregistered import-time jits,
            unhashable static-argnum candidates
+  pallas   per-backend lowering-support audit (ISSUE 18): every
+           registered Pallas-bearing entry (a `pallas_call` in its
+           defining module, or the `pallas_field` kernel-lane static)
+           must record which backends it lowers on
+           (EntrySpec.pallas_backends), and claims must stay inside
+           registry.PALLAS_BACKENDS — the GPU lane inherits a
+           known-good kernel set instead of discovering lowering
+           failures at dispatch
   census   hot-entry traced-op-count regression gate (ISSUE 13):
            totals at the audit shape vs tests/baselines/
            jaxpr_census.json, ±10%; `--update-baseline` rewrites the
@@ -42,7 +50,7 @@ import os
 import sys
 import time
 
-PASSES = ("jaxpr", "retrace", "locks", "lint", "census")
+PASSES = ("jaxpr", "retrace", "locks", "lint", "pallas", "census")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -106,6 +114,11 @@ _JAXPR_SHARDS = (
      "consensus_step_seq_signed_dense_donated"],
     ["bls_aggregate"],
     ["bls_pairing_product"],
+    # the kernel-lane aliases (pallas_field pinned on) trace far
+    # fewer eqns than their rolled rows but still carry the full
+    # Miller/MSM structure — one shard for the light MSM alias plus
+    # the pairing alias keeps the pool balanced
+    ["bls_aggregate_pallas", "bls_pairing_product_pallas"],
     ["consensus_step", "consensus_step_seq",
      "consensus_step_seq_donated", "honest_heights", "sharded_step",
      "sharded_step_seq", "sharded_honest_heights"],
@@ -187,6 +200,13 @@ def run_lint(quick: bool, metrics):
     from agnes_tpu.analysis import lint
 
     return lint.check_repo(_REPO), {}
+
+
+def run_pallas(quick: bool, metrics):
+    from agnes_tpu.analysis import pallas_support
+
+    findings = pallas_support.check()
+    return findings, {"records": pallas_support.support_table()}
 
 
 #: set by main() from --update-baseline
@@ -281,7 +301,7 @@ def run_census(quick: bool, metrics):
 
 RUNNERS = {"jaxpr": run_jaxpr, "retrace": run_retrace,
            "locks": run_locks, "lint": run_lint,
-           "census": run_census}
+           "pallas": run_pallas, "census": run_census}
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,94 @@
+"""Per-backend Pallas lowering-support audit (ISSUE 18).
+
+A Pallas kernel that lowers on TPU may not lower on Triton-GPU (and
+vice versa): memory spaces, iota rank rules and scatter support all
+differ per backend, and the first place a bad assumption surfaces by
+default is a LIVE dispatch on the new backend.  This pass makes the
+support set an audited REGISTRY RECORD instead of tribal knowledge:
+
+* every registered entry that is Pallas-bearing — a `pallas_call` in
+  its defining module, or the `pallas_field` kernel-lane static on
+  its signature (the BLS serve entries, whose traced graph contains
+  the field kernels when the lane is on) — must carry a non-empty
+  `EntrySpec.pallas_backends` tuple;
+* every claim must be a known backend name
+  (`registry.PALLAS_BACKENDS`); and
+* a record on a NON-Pallas entry is itself a finding — a stale claim
+  is as misleading as a missing one.
+
+The GPU bench lane (ROADMAP) consumes this table: kernels claiming
+"triton" are its known-good starting set, and the claim may only be
+added together with a real lowering (test or hardware run), never
+speculatively.
+
+Codes: PAL001 missing record, PAL002 record on a non-Pallas entry,
+PAL003 unknown backend name.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Tuple
+
+from agnes_tpu.analysis.jaxpr_audit import Finding
+
+PASS = "pallas"
+
+_PALLAS_MODULES = ("jax.experimental.pallas",
+                   "jax.experimental.pallas.tpu")
+
+
+def _is_pallas_bearing(spec) -> bool:
+    """The defining module imports `jax.experimental.pallas` (the
+    registration-next-to-kernels idiom of `pallas_verify.py`), or the
+    kernel-lane static rides the signature (the BLS serve entries,
+    whose traced graph holds the field kernels when the lane is on).
+    Checked against the module NAMESPACE, not its source text — a
+    docstring merely mentioning pallas must not create a claim
+    obligation — and never by tracing (the audit stays cheap)."""
+    if "pallas_field" in spec.statics:
+        return True
+    fn = spec.factory if spec.sharded else spec.fn
+    mod = sys.modules.get(getattr(fn, "__module__", "") or "")
+    return mod is not None and any(
+        getattr(v, "__name__", None) in _PALLAS_MODULES
+        for v in vars(mod).values())
+
+
+def check() -> List[Finding]:
+    from agnes_tpu.device import registry
+
+    findings: List[Finding] = []
+    for spec in registry.entries():
+        bearing = _is_pallas_bearing(spec)
+        rec = spec.pallas_backends
+        if bearing and not rec:
+            findings.append(Finding(
+                PASS, "PAL001", spec.name,
+                "Pallas-bearing entry registered without a "
+                "per-backend lowering-support record (add "
+                "pallas_backends=(...) to its EntrySpec)"))
+        elif rec and not bearing:
+            findings.append(Finding(
+                PASS, "PAL002", spec.name,
+                "pallas_backends recorded on an entry with no "
+                "Pallas kernel in reach — stale claim, drop it"))
+        if rec:
+            bad = sorted(set(rec) - set(registry.PALLAS_BACKENDS))
+            if bad:
+                findings.append(Finding(
+                    PASS, "PAL003", spec.name,
+                    f"unknown pallas backend claim(s) {bad}; known: "
+                    f"{list(registry.PALLAS_BACKENDS)}"))
+    return findings
+
+
+def support_table() -> Dict[str, Tuple[str, ...]]:
+    """{entry -> recorded backends} for every entry carrying a
+    record — the report detail the GPU lane (and README's support
+    table) reads."""
+    from agnes_tpu.device import registry
+
+    return {s.name: tuple(s.pallas_backends)
+            for s in registry.entries()
+            if s.pallas_backends is not None}

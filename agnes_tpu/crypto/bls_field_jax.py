@@ -40,6 +40,7 @@ Oracle: `bls_ref` (plain python ints); see tests/test_bls.py.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Dict, List, Tuple
 
@@ -173,6 +174,42 @@ _P_MAT = _banded(_const_limbs(P), NLIMBS, _N65)
 # multiplies above stay banded matmuls, their bands are dense)
 
 
+# --- backend selection (ISSUE 18) -------------------------------------------
+#
+# The Pallas kernel lane (crypto/pallas_field.py) is a TRACE-TIME
+# swap: inside a `field_backend(...)` scope the two heavy bodies —
+# the fused multiply+reduce of `fv_mul`/`fv_mul_pairs` and the
+# `reduce_cols` carry chain — route to one `pallas_call` each instead
+# of the rolled op soup.  The flag is a python global read while
+# TRACING, so the choice bakes into the jitted graph: the registered
+# BLS entries expose it as the `pallas_field=` static and the serve
+# lane carries it in the retrace statics tuple (a warm/dispatch lane
+# mismatch trips the armed sentinel, never a live mid-serve compile).
+# Values: False = rolled JAX (the default, and the only lane off-TPU
+# in production), True = compiled Pallas (TPU), "interpret" = the
+# Pallas interpreter (CPU differentials and smoke benches).
+
+_BACKEND = False
+
+
+def current_backend():
+    """The active field backend (False | True | "interpret")."""
+    return _BACKEND
+
+
+@contextlib.contextmanager
+def field_backend(mode):
+    """Scope the field-body backend for everything traced inside."""
+    assert mode in (False, True, "interpret"), mode
+    global _BACKEND
+    prev = _BACKEND
+    _BACKEND = mode
+    try:
+        yield
+    finally:
+        _BACKEND = prev
+
+
 # --- Barrett reduction ------------------------------------------------------
 
 def reduce_cols(cols: jnp.ndarray, col_bound: int) -> jnp.ndarray:
@@ -185,6 +222,15 @@ def reduce_cols(cols: jnp.ndarray, col_bound: int) -> jnp.ndarray:
     The one sequential chain at the tail makes the output limbs
     strict, which is what keeps every downstream bound (and the
     subtraction spreads) small."""
+    if _BACKEND is not False and cols.shape[-1] == NLIMBS:
+        # the kernel lane fuses the whole loosen -> quotient ->
+        # subtract -> chain into one pallas_call; only the
+        # element-width stacks route (the 65-wide product columns of
+        # fv_mul/fv_mul_pairs go through their own fused kernel)
+        from agnes_tpu.crypto import pallas_field as _PF
+
+        return _PF.reduce_rows(cols, col_bound,
+                               interpret=_BACKEND == "interpret")
     x = loosen(cols, col_bound)
     n = x.shape[-1]
     if n < _N65:
@@ -292,6 +338,20 @@ def _outer_cols(x: FV, y: FV) -> jnp.ndarray:
     return _mul_cols(x.a, y.a)
 
 
+def _mul_reduce(xa: jnp.ndarray, ya: jnp.ndarray) -> jnp.ndarray:
+    """Product limbs -> strict < 4p limbs: the ONE multiply+reduce
+    body both `fv_mul` and `fv_mul_pairs` instantiate — rolled by
+    default, one fused `pallas_call` on the kernel lane.  Both lanes
+    return identical limb values (the interpret differential's
+    contract)."""
+    if _BACKEND is not False:
+        from agnes_tpu.crypto import pallas_field as _PF
+
+        return _PF.mul_rows(xa, ya, interpret=_BACKEND == "interpret")
+    return reduce_cols(_mul_cols(xa, ya),
+                       NLIMBS * _ELEM_LIMB * _ELEM_LIMB)
+
+
 def fv_reduce(x: FV) -> FV:
     """Re-reduce a grown value below 4p."""
     assert x.bound < REDUCE_CAP
@@ -310,9 +370,7 @@ def fv_mul(x: FV, y: FV) -> FV:
             x = fv_reduce(x)
         else:
             y = fv_reduce(y)
-    cols = _outer_cols(x, y)
-    return FV(reduce_cols(cols, NLIMBS * _ELEM_LIMB * _ELEM_LIMB),
-              RED_BOUND)
+    return FV(_mul_reduce(x.a, y.a), RED_BOUND)
 
 
 def fv_mul_small(x: FV, k: int) -> FV:
@@ -367,8 +425,7 @@ def fv_mul_pairs(pairs) -> List[FV]:
         assert x.bound * y.bound < REDUCE_CAP
     xa = jnp.stack([x.a for x, _ in fixed], axis=-2)
     ya = jnp.stack([y.a for _, y in fixed], axis=-2)
-    out = reduce_cols(_mul_cols(xa, ya),
-                      NLIMBS * _ELEM_LIMB * _ELEM_LIMB)
+    out = _mul_reduce(xa, ya)
     return [FV(out[..., k, :], RED_BOUND) for k in range(len(fixed))]
 
 

@@ -172,7 +172,8 @@ def n_windows_for(w_bits: int) -> int:
 
 def bls_aggregate(pk: jnp.ndarray, sig: jnp.ndarray,
                   w: jnp.ndarray,
-                  n_windows: int = N_WINDOWS) -> Tuple[G1P, G2P]:
+                  n_windows: int = N_WINDOWS,
+                  pallas_field=False) -> Tuple[G1P, G2P]:
     """One vote class's O(N) aggregation in one dispatch.
 
     pk  [N, 2, NLIMBS] int32 — signer pubkeys, affine G1 limb coords
@@ -190,31 +191,52 @@ def bls_aggregate(pk: jnp.ndarray, sig: jnp.ndarray,
     Returns (agg_pk, agg_sig) PROJECTIVE: agg_pk = Σ [wᵢ] pkᵢ over G1,
     agg_sig = Σ [wᵢ] sigᵢ over G2 — the two MSMs whose outputs feed
     `bls_ref.aggregate_verify_weighted`'s single pairing-product
-    check.  Shapes (+ n_windows) are the compile key: the lane pads
-    every class onto a ladder rung, so the jit cache holds one
-    executable per rung."""
-    g1pts = G1P(x=pk[:, 0], y=pk[:, 1],
-                z=_one_limbs((pk.shape[0],)))
-    g2x = jnp.stack([sig[:, 0], sig[:, 1]], axis=-2)
-    g2y = jnp.stack([sig[:, 2], sig[:, 3]], axis=-2)
-    g2pts = G2P(x=g2x, y=g2y, z=g2_identity((sig.shape[0],)).y)
-    agg_pk = M.msm_generic(
-        g1pts, w, n_windows, point_add=g1_add, identity=g1_identity,
-        window_c=WINDOW_C, bits=BF.BITS)
-    agg_sig = M.msm_generic(
-        g2pts, w, n_windows, point_add=g2_add, identity=g2_identity,
-        window_c=WINDOW_C, bits=BF.BITS)
-    return agg_pk, agg_sig
+    check.  Shapes (+ n_windows, + pallas_field) are the compile key:
+    the lane pads every class onto a ladder rung, so the jit cache
+    holds one executable per rung.
+
+    `pallas_field` is the STATIC kernel-lane knob (ISSUE 18): False
+    traces the rolled-JAX field bodies, True the fused Pallas kernels
+    (TPU), "interpret" the Pallas interpreter (CPU differentials).
+    The serve lane resolves it ONCE (BlsLane.uses_pallas_field) and
+    carries it in the retrace statics, so warming one lane and
+    dispatching the other fails loudly at the sentinel, never as a
+    live mid-serve compile."""
+    with BF.field_backend(pallas_field):
+        g1pts = G1P(x=pk[:, 0], y=pk[:, 1],
+                    z=_one_limbs((pk.shape[0],)))
+        g2x = jnp.stack([sig[:, 0], sig[:, 1]], axis=-2)
+        g2y = jnp.stack([sig[:, 2], sig[:, 3]], axis=-2)
+        g2pts = G2P(x=g2x, y=g2y, z=g2_identity((sig.shape[0],)).y)
+        agg_pk = M.msm_generic(
+            g1pts, w, n_windows, point_add=g1_add,
+            identity=g1_identity, window_c=WINDOW_C, bits=BF.BITS)
+        agg_sig = M.msm_generic(
+            g2pts, w, n_windows, point_add=g2_add,
+            identity=g2_identity, window_c=WINDOW_C, bits=BF.BITS)
+        return agg_pk, agg_sig
 
 
 bls_aggregate_jit = jax.jit(bls_aggregate,
-                            static_argnames=("n_windows",))
+                            static_argnames=("n_windows",
+                                             "pallas_field"))
 
 from agnes_tpu.device import registry as _registry  # noqa: E402
 
 _registry.register(_registry.EntrySpec(
     name="bls_aggregate", fn=bls_aggregate, jit=bls_aggregate_jit,
-    statics=("n_windows",), hot=True))
+    statics=("n_windows", "pallas_field"), hot=True,
+    pallas_backends=("tpu", "interpret")))
+
+# the kernel-lane census alias: SAME jit, `pallas_field` pinned on by
+# the audit plan (jaxpr_audit.ENTRY_STATICS) so the fused-kernel graph
+# gets its own traced-op baseline row next to the rolled one — the op
+# budget the kernel lane must beat, policed like any other entry
+_registry.register(_registry.EntrySpec(
+    name="bls_aggregate_pallas", fn=bls_aggregate,
+    jit=bls_aggregate_jit,
+    statics=("n_windows", "pallas_field"), hot=False,
+    pallas_backends=("tpu", "interpret")))
 
 
 # --- host-side packing / unpacking ------------------------------------------

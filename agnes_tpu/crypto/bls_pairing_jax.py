@@ -318,7 +318,8 @@ def _z_is_zero_g2(q_pts: jnp.ndarray) -> jnp.ndarray:
 
 
 def bls_pairing_product(p_pts: jnp.ndarray,
-                        q_pts: jnp.ndarray) -> jnp.ndarray:
+                        q_pts: jnp.ndarray,
+                        pallas_field=False) -> jnp.ndarray:
     """ALL closed classes' pairing checks in one dispatch.
 
     p_pts [C, 2, 3, NLIMBS]    — per (class, pair) projective G1
@@ -329,27 +330,37 @@ def bls_pairing_product(p_pts: jnp.ndarray,
     prod_k e(p_k, q_k) == 1, with a pair whose EITHER point is the
     identity skipped (`bls_ref.pairing_product_is_one` semantics —
     an all-identity padding class returns True and is ignored by the
-    caller).  Shapes are the compile key; the lane pads the class
-    count onto `ShapeLadder.bls_class_rungs`, so the jit cache holds
-    one executable per class rung."""
-    f = miller_loop(q_pts, p_pts)                 # batch [C, 2]
-    skip = _z_is_zero_g1(p_pts) | _z_is_zero_g2(q_pts)   # [C, 2]
-    f_arr = T.fv12_out(_red12(f))
-    one = T.fv12_out(T.fv12_one(f_arr.shape[:-3]))
-    f_arr = jnp.where(skip[..., None, None, None], one, f_arr)
-    f0 = T.fv12_in(f_arr[..., 0, :, :, :], RED_BOUND)
-    f1 = T.fv12_in(f_arr[..., 1, :, :, :], RED_BOUND)
-    out = final_exponentiate(T.fv12_mul(f0, f1))
-    return T.fv12_eq_one(out)
+    caller).  Shapes (+ the STATIC `pallas_field` kernel-lane knob,
+    see `bls_jax.bls_aggregate`) are the compile key; the lane pads
+    the class count onto `ShapeLadder.bls_class_rungs`, so the jit
+    cache holds one executable per class rung."""
+    with BF.field_backend(pallas_field):
+        f = miller_loop(q_pts, p_pts)             # batch [C, 2]
+        skip = _z_is_zero_g1(p_pts) | _z_is_zero_g2(q_pts)  # [C, 2]
+        f_arr = T.fv12_out(_red12(f))
+        one = T.fv12_out(T.fv12_one(f_arr.shape[:-3]))
+        f_arr = jnp.where(skip[..., None, None, None], one, f_arr)
+        f0 = T.fv12_in(f_arr[..., 0, :, :, :], RED_BOUND)
+        f1 = T.fv12_in(f_arr[..., 1, :, :, :], RED_BOUND)
+        out = final_exponentiate(T.fv12_mul(f0, f1))
+        return T.fv12_eq_one(out)
 
 
-bls_pairing_product_jit = jax.jit(bls_pairing_product)
+bls_pairing_product_jit = jax.jit(bls_pairing_product,
+                                  static_argnames=("pallas_field",))
 
 from agnes_tpu.device import registry as _registry  # noqa: E402
 
 _registry.register(_registry.EntrySpec(
     name="bls_pairing_product", fn=bls_pairing_product,
-    jit=bls_pairing_product_jit, hot=True))
+    jit=bls_pairing_product_jit, statics=("pallas_field",), hot=True,
+    pallas_backends=("tpu", "interpret")))
+
+# kernel-lane census alias (see bls_jax.bls_aggregate_pallas)
+_registry.register(_registry.EntrySpec(
+    name="bls_pairing_product_pallas", fn=bls_pairing_product,
+    jit=bls_pairing_product_jit, statics=("pallas_field",), hot=False,
+    pallas_backends=("tpu", "interpret")))
 
 
 # --- host-side packing -------------------------------------------------------

@@ -35,6 +35,13 @@ differential surface and the final exponentiation's easy part): Fp12
 so a degenerate pairing input collapses to a rejecting verdict, never
 a crash.
 
+Kernel lane (ISSUE 18): every stacked `fv_mul_pairs` body and
+`fv_reduce_stack` carry chain this tower funnels through is exactly
+what `crypto/pallas_field.py` fuses into single Pallas kernels — the
+routing is the `bls_field_jax.field_backend` trace-time static set by
+the registered BLS entries' `pallas_field=` knob, so this module is
+backend-agnostic: same formulas, same FV bounds, either lane.
+
 Oracle: `bls_ref` FQ2/FQ12 (tests/test_bls_tower.py)."""
 
 from __future__ import annotations

@@ -220,7 +220,14 @@ def bucket_sums_seq(points, digits: jnp.ndarray, *, point_add,
     practical compile budgets, while the per-class lane counts
     (N <= 1024) make N sequential adds cheap at runtime.  Ed25519's
     `_bucket_sums` keeps the log-depth formulation (its field is ~10x
-    cheaper to instantiate and its batch sizes 100x larger)."""
+    cheaper to instantiate and its batch sizes 100x larger).
+
+    Kernel lane (ISSUE 18): the BLS `point_add` closure bottoms out
+    in `bls_field_jax.fv_mul_pairs`/`reduce_cols`, so under an active
+    `field_backend` (the `pallas_field=` knob on `bls_aggregate`) the
+    ONE point-add body this scan instantiates is the fused
+    `crypto/pallas_field.py` kernel — the sequential-scan trade above
+    gets cheaper still (one fused kernel, not one 5-15k-op soup)."""
     order = jnp.argsort(digits)                  # stable
     ds = digits[order]
     pts = jax.tree.map(lambda c: c[order], points)

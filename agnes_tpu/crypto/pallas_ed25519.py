@@ -279,4 +279,5 @@ from agnes_tpu.device import registry as _registry  # noqa: E402
 
 _registry.register(_registry.EntrySpec(
     name="pallas_pow_p", fn=_pow_pallas_impl, jit=_pow_pallas_impl,
-    statics=("e", "interpret", "b_tile"), hot=False))
+    statics=("e", "interpret", "b_tile"), hot=False,
+    pallas_backends=("tpu", "interpret")))

@@ -1,0 +1,321 @@
+"""Fused Pallas kernels for the 12-bit/33-limb Barrett field (ISSUE 18).
+
+The BLS pairing and MSM entries bottom out in two `bls_field_jax`
+bodies: `fv_mul_pairs` (stacked limb convolution + Barrett reduce) and
+the `reduce_cols` carry chain (`fv_reduce_stack` / `fv_mul_small` /
+`fv_strict`).  Rolled JAX schedules those as generic elementwise soup
+— ~100k traced primitives for the pairing entry and limb values that
+round-trip HBM between every carry pass.  This module is the
+hand-tiled answer in the `pallas_verify.py` mold:
+
+  - **one `pallas_call` per body**: the whole multiply -> loosen ->
+    Barrett quotient -> subtract -> sequential carry chain runs inside
+    a single kernel, limbs VMEM-resident throughout instead of one XLA
+    op per carry pass;
+  - **vreg-plane layout**: elements are [33, BH, 128] int32 blocks
+    with the flattened batch on the (sublane, lane) axes — every limb
+    is a whole 8x128 vreg, so a shifted multiply-add step is one vreg
+    multiply-add (the verify-v2 layout lesson);
+  - **static bound discipline preserved**: the kernels are
+    parametrized by the STATIC carry-pass count derived from the
+    caller's `FV` column bound (`_passes_needed`), so the trace-time
+    bound proofs of `bls_field_jax` hold bit-for-bit at the kernel
+    boundary — the interpret-mode differential asserts leaf-for-leaf
+    limb equality against the rolled path, not just mod-p equality.
+
+Backend selection lives in `bls_field_jax.field_backend` (trace-time
+static; see its docstring): `False` keeps the rolled path, `True`
+compiles the kernels (TPU), `"interpret"` runs them through the
+Pallas interpreter (CPU differentials).  The registered entries carry
+`pallas_backends=("tpu", "interpret")` — the per-backend lowering
+record `agnes-lint --pass pallas` audits; "triton" stays unclaimed
+until the GPU bench lane actually lowers these bodies (the kernel
+bodies are plain jnp ops, but the claim must follow a real lowering,
+not precede it).
+
+Oracle: the rolled `bls_field_jax` path itself (exact limb equality);
+see tests/test_pallas_field.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from agnes_tpu.crypto.bls_field_jax import (
+    BITS,
+    I32,
+    LMASK,
+    LOOSE,
+    MU,
+    MU_SHIFT_LIMBS,
+    NLIMBS,
+    _const_limbs,
+    _ELEM_LIMB,
+    _N65,
+    _passes_needed,
+)
+from agnes_tpu.crypto.bls_ref import P
+
+BH = 8                      # sublane rows per batch tile
+TILE = BH * 128             # field elements per grid step
+
+_MU_LIMBS = tuple(_const_limbs(MU))
+_P_LIMBS = tuple(_const_limbs(P))
+
+#: static carry-pass counts — the same `_passes_needed` arithmetic the
+#: rolled `reduce_cols` runs, frozen here so the kernel bodies match
+#: it limb-for-limb (the differential's exactness depends on it)
+_MUL_PASSES = _passes_needed(NLIMBS * _ELEM_LIMB * _ELEM_LIMB)
+_MU_PASSES = _passes_needed(len(_MU_LIMBS) * LOOSE * LMASK)
+_P_PASSES = _passes_needed(len(_P_LIMBS) * LOOSE * LMASK)
+_R_PASSES = _passes_needed(2 * LOOSE * LMASK)
+
+
+# --- kernel-side limb ops (leading limb axis, [n, BH, 128] blocks) ----------
+
+
+def _vp(r: jnp.ndarray) -> jnp.ndarray:
+    """One exact vectorized carry pass along the leading limb axis —
+    `bls_field_jax._vpass` transposed to the vreg-plane layout (top
+    limb keeps its full value, signed carries via arithmetic shift)."""
+    lo = r & LMASK
+    hi = r >> BITS
+    shift = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    lo = jnp.concatenate([lo[:-1], r[-1:]], axis=0)
+    return lo + shift
+
+
+def _conv_const(a: jnp.ndarray, const: Tuple[int, ...],
+                n_out: int) -> jnp.ndarray:
+    """Limb convolution by a constant — the banded `a @ _MU_MAT` /
+    `a @ _P_MAT` contractions as statically-shifted multiply-adds
+    (constants INLINE: Pallas kernels must not capture arrays).
+    out[k] = sum_i a[i] * const[k-i], rows beyond n_out dropped —
+    exactly `_banded`'s i + j < n_out clipping."""
+    n_in = a.shape[0]
+    cols = None
+    for j, cj in enumerate(const):
+        if not cj:
+            continue
+        term = cj * a
+        if j + n_in > n_out:
+            term = term[:n_out - j]
+        t = jnp.pad(term, [(j, n_out - j - term.shape[0])]
+                    + [(0, 0)] * (term.ndim - 1))
+        cols = t if cols is None else cols + t
+    return cols
+
+
+def _chain_strict_rows(r: jnp.ndarray) -> jnp.ndarray:
+    """`bls_field_jax._chain_strict` on the leading limb axis:
+    sequential signed carry chain over 24-bit limb PAIRS, emitting the
+    interleaved lo/hi strict limbs row by row (no scatter — Mosaic has
+    none; stacking rows is the `_freeze` precedent)."""
+    n = r.shape[0]
+    if n % 2:
+        r = jnp.pad(r, [(0, 1)] + [(0, 0)] * (r.ndim - 1))
+        n += 1
+    s = [r[2 * k] + (r[2 * k + 1] << BITS) for k in range(n // 2)]
+    c = jnp.zeros_like(s[0])
+    mask24 = (1 << (2 * BITS)) - 1
+    outs = []
+    for k in range(n // 2):
+        t = s[k] + c
+        v = t & mask24
+        outs.append(v & LMASK)
+        outs.append(v >> BITS)
+        c = t >> (2 * BITS)
+    return jnp.stack(outs, axis=0)
+
+
+def _reduce_body(x: jnp.ndarray, passes: int) -> jnp.ndarray:
+    """Barrett reduction, fused: `reduce_cols` with every carry pass,
+    both constant convolutions and the tail chain VMEM-resident.
+    `passes` is the static `_passes_needed(col_bound)` of the caller's
+    column bound — the FV bound contract at the kernel boundary."""
+    for _ in range(passes):
+        x = _vp(x)
+    n = x.shape[0]
+    if n < _N65:
+        x = jnp.pad(x, [(0, _N65 - n)] + [(0, 0)] * (x.ndim - 1))
+    t = _conv_const(x, _MU_LIMBS, _N65 + len(_MU_LIMBS))
+    for _ in range(_MU_PASSES):
+        t = _vp(t)
+    q = t[MU_SHIFT_LIMBS:MU_SHIFT_LIMBS + NLIMBS]
+    ql = _conv_const(q, _P_LIMBS, _N65)
+    for _ in range(_P_PASSES):
+        ql = _vp(ql)
+    r = x - ql
+    for _ in range(_R_PASSES):
+        r = _vp(r)
+    return _chain_strict_rows(r)[:NLIMBS]
+
+
+def _mul_kernel(xa_ref, ya_ref, out_ref):
+    """Fused `fv_mul_pairs` body: schoolbook limb convolution (33
+    shifted multiply-adds, `_mul_cols` transposed) straight into the
+    Barrett reduce — one kernel, zero HBM round-trips between them."""
+    xa = xa_ref[:]
+    ya = ya_ref[:]
+    cols = None
+    for i in range(NLIMBS):
+        term = xa[i:i + 1] * ya
+        t = jnp.pad(term, [(i, NLIMBS - 1 - i)]
+                    + [(0, 0)] * (term.ndim - 1))
+        cols = t if cols is None else cols + t
+    out_ref[...] = _reduce_body(cols, _MUL_PASSES)
+
+
+def _reduce_kernel(x_ref, out_ref, *, passes: int):
+    """Fused `fv_reduce_stack` / carry-chain body."""
+    out_ref[...] = _reduce_body(x_ref[:], passes)
+
+
+# --- host/XLA wrappers ------------------------------------------------------
+
+
+def _tile_rows(a: jnp.ndarray, r_pad: int) -> jnp.ndarray:
+    """[R, NLIMBS] -> [NLIMBS, r_pad//128, 128] (zero-padded rows;
+    zero elements reduce to zero, so padding is value-safe)."""
+    a = jnp.pad(a, ((0, r_pad - a.shape[0]), (0, 0)))
+    return jnp.moveaxis(a, -1, 0).reshape(NLIMBS, r_pad // 128, 128)
+
+
+def _untile_rows(a: jnp.ndarray, r: int, lead) -> jnp.ndarray:
+    return jnp.moveaxis(a.reshape(NLIMBS, -1), 0, -1)[:r].reshape(
+        tuple(lead) + (NLIMBS,))
+
+
+def _flatten(a: jnp.ndarray):
+    lead = a.shape[:-1]
+    r = int(np.prod(lead)) if lead else 1
+    return a.reshape(r, a.shape[-1]), lead, r
+
+
+def _specs(n_rows: int):
+    spec = pl.BlockSpec((n_rows, BH, 128), lambda g: (0, g, 0),
+                        memory_space=pltpu.VMEM)
+    return spec
+
+
+def mul_rows(xa: jnp.ndarray, ya: jnp.ndarray,
+             interpret: bool = False) -> jnp.ndarray:
+    """Fused multiply+reduce over [..., NLIMBS] limb arrays (matching
+    leading shapes) -> [..., NLIMBS] strict limbs of a < 4p
+    representative — limb-for-limb what the rolled
+    `reduce_cols(_mul_cols(xa, ya), NLIMBS * _ELEM_LIMB**2)` returns.
+    The caller (`bls_field_jax.fv_mul_pairs`) has already enforced the
+    Barrett precondition via the static FV bounds."""
+    xr, lead, r = _flatten(xa)
+    yr, _, _ = _flatten(ya)
+    if r == 0:
+        return jnp.zeros(tuple(lead) + (NLIMBS,), I32)
+    r_pad = -(-r // TILE) * TILE
+    spec = _specs(NLIMBS)
+    out = pl.pallas_call(
+        _mul_kernel,
+        grid=(r_pad // TILE,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((NLIMBS, r_pad // 128, 128), I32),
+        interpret=interpret,
+    )(_tile_rows(xr, r_pad), _tile_rows(yr, r_pad))
+    return _untile_rows(out, r, lead)
+
+
+def reduce_rows(cols: jnp.ndarray, col_bound: int,
+                interpret: bool = False) -> jnp.ndarray:
+    """Fused Barrett reduce + carry chain over [..., NLIMBS]
+    NON-NEGATIVE columns (value < REDUCE_CAP) -> strict < 4p limbs —
+    limb-for-limb `reduce_cols(cols, col_bound)`.  The static
+    col_bound picks the carry-pass count at trace time, same as the
+    rolled path."""
+    xr, lead, r = _flatten(cols)
+    if r == 0:
+        return jnp.zeros(tuple(lead) + (NLIMBS,), I32)
+    passes = _passes_needed(col_bound)
+    r_pad = -(-r // TILE) * TILE
+    spec = _specs(NLIMBS)
+    out = pl.pallas_call(
+        functools.partial(_reduce_kernel, passes=passes),
+        grid=(r_pad // TILE,),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((NLIMBS, r_pad // 128, 128), I32),
+        interpret=interpret,
+    )(_tile_rows(xr, r_pad))
+    return _untile_rows(out, r, lead)
+
+
+# --- registered standalone entries ------------------------------------------
+#
+# The serve lane reaches these kernels INSIDE the registered BLS
+# entries (bls_aggregate / bls_pairing_product, via the
+# `field_backend` static); the standalone jits below are the
+# direct-dispatch seam for the kernel differentials, the bench micro
+# A/B and the lowering-support audit.
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _mul_pairs_jit(xa, ya, interpret: bool = False):
+    return mul_rows(xa, ya, interpret)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _reduce_jit(cols, col_bound: int, interpret: bool = False):
+    return reduce_rows(cols, col_bound, interpret)
+
+
+def mul_pairs_call(xa, ya, interpret: bool = False):
+    """Dispatch the standalone fused-mul entry.  Interpret-mode
+    executables NEVER touch the persistent compile cache (the
+    pallas_verify r4 post-mortem: XLA's cache writer segfaults
+    intermittently serializing interpreter graphs)."""
+    if interpret:
+        from jax._src import compilation_cache as _cc
+
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        _cc.reset_cache()
+        try:
+            return _mul_pairs_jit(xa, ya, True)
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
+            _cc.reset_cache()
+    return _mul_pairs_jit(xa, ya, False)
+
+
+def reduce_call(cols, col_bound: int, interpret: bool = False):
+    """Dispatch the standalone reduce entry (cache dance as above)."""
+    if interpret:
+        from jax._src import compilation_cache as _cc
+
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        _cc.reset_cache()
+        try:
+            return _reduce_jit(cols, col_bound, True)
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
+            _cc.reset_cache()
+    return _reduce_jit(cols, col_bound, False)
+
+
+from agnes_tpu.device import registry as _registry  # noqa: E402
+
+_registry.register(_registry.EntrySpec(
+    name="pallas_fv_mul_pairs", fn=_mul_pairs_jit, jit=_mul_pairs_jit,
+    statics=("interpret",), hot=False,
+    pallas_backends=("tpu", "interpret")))
+_registry.register(_registry.EntrySpec(
+    name="pallas_fv_reduce", fn=_reduce_jit, jit=_reduce_jit,
+    statics=("col_bound", "interpret"), hot=False,
+    pallas_backends=("tpu", "interpret")))

@@ -679,4 +679,5 @@ from agnes_tpu.device import registry as _registry  # noqa: E402
 
 _registry.register(_registry.EntrySpec(
     name="pallas_verify", fn=_verify_jit, jit=_verify_jit,
-    statics=("interpret", "window"), hot=False))
+    statics=("interpret", "window"), hot=False,
+    pallas_backends=("tpu", "interpret")))

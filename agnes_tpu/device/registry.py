@@ -51,7 +51,13 @@ CANONICAL_MODULES = (
     "agnes_tpu.crypto.bls_pairing_jax",
     "agnes_tpu.crypto.pallas_verify",
     "agnes_tpu.crypto.pallas_ed25519",
+    "agnes_tpu.crypto.pallas_field",
 )
+
+#: the backend names a Pallas entry may claim lowering support for
+#: (analysis/pallas_support.py polices the record; "triton" stays
+#: unclaimed until the GPU lane actually lowers a kernel there)
+PALLAS_BACKENDS = ("tpu", "triton", "interpret")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,7 +71,14 @@ class EntrySpec:
     `hot` marks serve/offline hot-path entries: the auditor requires
     abstract-args coverage for them and the lint treats their call
     sites as host-sync-sensitive.  `sharded` entries register the
-    FACTORY (mesh, **statics) -> jitted fn instead of a jit object."""
+    FACTORY (mesh, **statics) -> jitted fn instead of a jit object.
+
+    `pallas_backends` is the per-backend LOWERING-SUPPORT record every
+    Pallas-bearing entry must carry (ISSUE 18): the subset of
+    `PALLAS_BACKENDS` the kernel is known to lower on, audited by the
+    `agnes-lint --pass pallas` rule so the GPU lane inherits a
+    known-good kernel set instead of discovering lowering failures at
+    dispatch.  None for plain XLA entries."""
 
     name: str
     fn: Callable                       # the traceable python function
@@ -75,12 +88,17 @@ class EntrySpec:
     sharded: bool = False
     factory: Optional[Callable] = None  # sharded: (mesh, **statics)
     hot: bool = True                    # audited hot-path entry
+    pallas_backends: Optional[Tuple[str, ...]] = None
 
     def __post_init__(self):
         if self.sharded:
             assert self.factory is not None, self.name
         else:
             assert self.jit is not None, self.name
+        if self.pallas_backends is not None:
+            bad = set(self.pallas_backends) - set(PALLAS_BACKENDS)
+            assert self.pallas_backends and not bad, \
+                f"{self.name}: bad pallas_backends {bad or '()'}"
 
 
 _REGISTRY: Dict[str, EntrySpec] = {}

@@ -475,6 +475,7 @@ class BlsLane:
                  max_delay_s: float = 0.005,
                  quarantine_after: int = 3,
                  device_pairing: Optional[bool] = None,
+                 pallas_field=None,
                  clock=time.monotonic):
         self.registry = registry
         self.table = BlsClassTable(registry, n_instances,
@@ -490,6 +491,14 @@ class BlsLane:
         #: pairing entry must not trip a live compile); True/False
         #: forces it (the bench's device-vs-host comparison)
         self.device_pairing = device_pairing
+        #: ISSUE 18: None = auto (field kernels iff the default JAX
+        #: backend is a TPU — the only backend with a real Mosaic
+        #: lowering); False/True/"interpret" forces the lane.  The
+        #: resolved value (`uses_pallas_field`) is a STATIC: it rides
+        #: the retrace statics of every BLS observe/dispatch, so
+        #: warming one lane and serving the other trips the armed
+        #: sentinel instead of a live mid-serve compile.
+        self.pallas_field = pallas_field
         self._clock = clock
         self.driver = None
         self.metrics = None
@@ -580,6 +589,20 @@ class BlsLane:
         return (self.ladder is not None
                 and bool(self.ladder.bls_class_rungs))
 
+    @property
+    def uses_pallas_field(self):
+        """Resolved field-kernel lane (constructor docstring): forced
+        (False/True/"interpret"), or auto = kernels iff serving on a
+        TPU.  One resolution, used by warmup AND every dispatch — the
+        value is part of each BLS entry's retrace statics, so the two
+        can never silently disagree (a mismatch raises RetraceError at
+        the first observe, not a live compile mid-serve)."""
+        if self.pallas_field is not None:
+            return self.pallas_field
+        import jax
+
+        return jax.default_backend() == "tpu"
+
     def _prune_epoch_memos(self) -> None:
         """Epoch advance (set_powers / the service's set_validators
         path) -> drop every memoized pairing/share verdict of the old
@@ -620,10 +643,12 @@ class BlsLane:
         args = (jnp.asarray(pk_rows), jnp.asarray(sig_rows),
                 jnp.asarray(BJ.pack_weights(w)))
         nw = self.registry.n_windows
+        pf = self.uses_pallas_field
         if self.driver is not None:
-            self.driver._observe("bls_aggregate", args, statics=(nw,))
+            self.driver._observe("bls_aggregate", args,
+                                 statics=(nw, pf))
         return _registry.timed_entry("bls_aggregate")(
-            *args, n_windows=nw)
+            *args, n_windows=nw, pallas_field=pf)
 
     def _aggregate_device(self, cls: AggregateClass, signers):
         """Host-pairing mode's aggregation: MSM dispatch + the ONE
@@ -684,6 +709,7 @@ class BlsLane:
                 "rungs or construct the lane with "
                 "device_pairing=False")
         cap = self.ladder.bls_class_rungs[-1]
+        pf = self.uses_pallas_field
         out: Dict[tuple, bool] = {}
         neg_g1 = jnp.asarray(BP.NEG_G1_LIMBS)
         for k0 in range(0, len(pending), cap):
@@ -703,14 +729,15 @@ class BlsLane:
             p = jnp.stack(p_rows + [jnp.zeros_like(p_rows[0])] * pad)
             q = jnp.stack(q_rows + [jnp.zeros_like(q_rows[0])] * pad)
             if self.driver is not None:
-                self.driver._observe("bls_pairing_product", (p, q))
+                self.driver._observe("bls_pairing_product", (p, q),
+                                     statics=(pf,))
             # force the queued MSMs first so the histogram times the
             # pairing dispatch itself, comparable to the host mode's
             # pairing-product wall (the bench's speedup ratio)
             jax.block_until_ready((p, q))  # lint: allow (class-close boundary; timing fence)
             t0 = self._clock()
             ok = np.asarray(_registry.timed_entry(
-                "bls_pairing_product")(p, q))  # lint: allow (class-close boundary fetch: the [C] bool verdicts)
+                "bls_pairing_product")(p, q, pallas_field=pf))  # lint: allow (class-close boundary fetch: the [C] bool verdicts)
             wall = self._clock() - t0
             if self._h_pairing is not None:
                 self._h_pairing.record(wall / C, n=C)

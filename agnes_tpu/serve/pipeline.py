@@ -807,12 +807,17 @@ class ServePipeline:
 
             fn = registry.timed_entry("bls_aggregate")
             nw = self.bls_lane.registry.n_windows
+            # ISSUE 18: the field-kernel lane is resolved ONCE here
+            # and rides the retrace statics — serving with a different
+            # lane than was warmed raises at the armed sentinel, never
+            # as a live mid-serve compile of the other lane.
+            pf = self.bls_lane.uses_pallas_field
             for r in self.ladder.bls_rungs:
                 args = (jnp.zeros((r, 2, _bj.NLIMBS), jnp.int32),
                         jnp.zeros((r, 4, _bj.NLIMBS), jnp.int32),
                         jnp.zeros((r, _bj.W_LIMBS), jnp.int32))
-                d._observe("bls_aggregate", args, statics=(nw,))
-                out = fn(*args, n_windows=nw)
+                d._observe("bls_aggregate", args, statics=(nw, pf))
+                out = fn(*args, n_windows=nw, pallas_field=pf)
                 jax.block_until_ready(out[0].x)
                 warmed += 1
         if (self.bls_lane is not None and self.ladder.bls_class_rungs
@@ -825,12 +830,13 @@ class ServePipeline:
             #                      ^ import = entry registration
 
             fn = registry.timed_entry("bls_pairing_product")
+            pf = self.bls_lane.uses_pallas_field
             for r in self.ladder.bls_class_rungs:
                 args = (jnp.zeros((r, 2, 3, _bj.NLIMBS), jnp.int32),
                         jnp.zeros((r, 2, 3, 2, _bj.NLIMBS),
                                   jnp.int32))
-                d._observe("bls_pairing_product", args)
-                jax.block_until_ready(fn(*args))
+                d._observe("bls_pairing_product", args, statics=(pf,))
+                jax.block_until_ready(fn(*args, pallas_field=pf))
                 warmed += 1
         if arm and getattr(d, "sentinel", None) is not None:
             d.sentinel.arm()

@@ -1882,7 +1882,9 @@ def _pipeline_serve_bls(n_instances: int, n_validators: int,
 
     Bench keys (via _EXTRA_RECORD): `bls_agg_speedup`,
     `pipeline_serve_bls_ed25519_votes_per_sec`, `bls_class_size`,
-    `serve_bls_agg_classes`, `serve_bls_fallback_votes`.
+    `serve_bls_agg_classes`, `serve_bls_fallback_votes`, and the
+    ISSUE 18 kernel-lane A/B `bls_pallas_speedup` /
+    `bls_pallas_compile_ms` (-1 sentinels if the A/B could not run).
 
     Fixture keys are THROWAWAY benchmark keys (sk_v = v + 1): shares
     and pubkeys build incrementally (one G2/G1 add per validator), so
@@ -2062,9 +2064,61 @@ def _pipeline_serve_bls(n_instances: int, n_validators: int,
     host_snap = m_h.snapshot()
     host_p50 = host_snap.get(f"{BLS_PAIRING_WALL_S}_p50", 0)
 
+    # -- ISSUE 18: Pallas field-kernel lane vs rolled A/B --------------------
+    # Times the fused multiply+reduce KERNEL body against the rolled
+    # `reduce_cols(_mul_cols(...))` path on one representative operand
+    # batch (1024 field elements — a pairing-product's working set per
+    # fori step), and asserts exact limb equality while at it.  On a
+    # TPU box the kernel is the compiled Mosaic lowering (the lane the
+    # serve plane auto-selects); on this CPU gate it runs under the
+    # Pallas INTERPRETER, so the recorded speedup is a plumbing +
+    # exactness proof, not a throughput claim — interpret overhead
+    # makes < 1x expected and honest there.  -1 sentinels if the A/B
+    # dies: the record must survive under the crash-safe contract.
+    bls_pallas_speedup = bls_pallas_compile_ms = -1.0
+    try:
+        from agnes_tpu.crypto import bls_field_jax as _BF
+        from agnes_tpu.crypto import pallas_field as _PF
+
+        interp = jax.default_backend() != "tpu"
+        rng_ab = np.random.default_rng(5)
+        xa, ya = (jnp.asarray(rng_ab.integers(
+            0, _BF.LMASK + 1, size=(1024, _BF.NLIMBS),
+            dtype=np.int64).astype(np.int32)) for _ in range(2))
+        t0 = time.perf_counter()
+        kern_out = _PF.mul_pairs_call(xa, ya, interpret=interp)
+        jax.block_until_ready(kern_out)
+        bls_pallas_compile_ms = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        rolled_fn = jax.jit(lambda a, b: _BF.reduce_cols(
+            _BF._mul_cols(a, b),
+            _BF.NLIMBS * _BF._ELEM_LIMB * _BF._ELEM_LIMB))
+        rolled_out = rolled_fn(xa, ya)
+        np.testing.assert_array_equal(np.asarray(kern_out),
+                                      np.asarray(rolled_out))
+
+        def _best_wall(fn, reps=5):
+            best = float("inf")
+            for _ in range(reps):
+                t = time.perf_counter()
+                jax.block_until_ready(fn())
+                best = min(best, time.perf_counter() - t)
+            return best
+
+        t_kern = _best_wall(
+            lambda: _PF.mul_pairs_call(xa, ya, interpret=interp))
+        t_roll = _best_wall(lambda: rolled_fn(xa, ya))
+        if t_kern > 0:
+            bls_pallas_speedup = round(t_roll / t_kern, 3)
+    except Exception as e:  # noqa: BLE001 — sentinel, not a crash
+        print(f"[bench] pallas field A/B failed: {e!r}",
+              file=sys.stderr, flush=True)
+
     snap = rep["metrics"]
     dev_p50 = snap.get("bls_pairing_wall_s_p50", 0)
     _EXTRA_RECORD.update({
+        "bls_pallas_speedup": bls_pallas_speedup,
+        "bls_pallas_compile_ms": bls_pallas_compile_ms,
         "bls_class_size": V,
         "pipeline_serve_bls_ed25519_votes_per_sec": round(rate_ed),
         "bls_agg_speedup": (round(rate_bls / rate_ed, 2)
@@ -2271,7 +2325,8 @@ def main_serve_bls_smoke() -> None:
     plus the per-vote Ed25519 comparison and the host-pairing replay
     — tiny-I/full-V shape, CPU, same crash-safe contract.  The record
     carries `bls_agg_speedup` + `bls_pairing_device_speedup` + the
-    lane counters via _EXTRA_RECORD.  Default shape I=1, V=128: the
+    lane counters + the ISSUE 18 `bls_pallas_speedup` /
+    `bls_pallas_compile_ms` kernel A/B via _EXTRA_RECORD.  Default shape I=1, V=128: the
     aggregation win is per-CLASS (2302.00418's trade is asymptotic in
     committee size), and a 64-validator class sits at the measured
     CPU crossover — one fused 128-vote Ed25519 dispatch costs about

@@ -455,6 +455,17 @@ else:
     # actually be device-paired (dispatch counter > 0)
     assert rec["bls_pairing_device_speedup"] > 1, rec
     assert rec["bls_device_pairing_dispatches"] > 0, rec
+    # ISSUE 18: the Pallas field-kernel A/B keys must exist as real
+    # measurements or honest -1 sentinels (never absent), and the run
+    # that dispatched the kernel entries kept a clean retrace slate
+    # (the kernel lane is a retrace STATIC — any lane mismatch would
+    # have bumped retrace_unexpected above).  No > 1 floor on the
+    # speedup HERE: this CPU gate runs the kernels under the Pallas
+    # interpreter, so the number proves plumbing + exactness; the
+    # throughput claim belongs to the TPU lane.
+    for k in ("bls_pallas_speedup", "bls_pallas_compile_ms"):
+        assert isinstance(rec.get(k), (int, float)), (k, rec.get(k))
+        assert rec[k] == -1 or rec[k] > 0, (k, rec[k])
     print(f"BLS serve smoke gate OK: {rec['value']:.0f} votes/s at a "
           f"{rec['bls_class_size']}-validator class "
           f"({rec['bls_agg_speedup']}x vs per-vote Ed25519 "

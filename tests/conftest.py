@@ -89,6 +89,8 @@ _CHEAP = (          # no XLA compiles (stdlib / numpy / ctypes / refs)
     "test_native_admission.py",
     "test_native_core.py",
     "test_native_ingest.py", "test_observability.py",
+    "test_pallas_field.py",       # kernel differentials: small
+    #                               interpret compiles, seconds total
     "test_round_votes.py",
     "test_serve.py", "test_serve_cache.py", "test_serve_threaded.py",
     "test_state_machine.py",

@@ -519,11 +519,12 @@ def test_serve_bls_differential_and_forged_fallback():
     # -- BLS aggregate-lane serves: DEVICE pairing and HOST pairing ----------
     bls_pts, bls_pk = _incremental_keys(V)
 
-    def bls_serve(device_pairing):
+    def bls_serve(device_pairing, pallas_field=False):
         reg = BlsKeyRegistry(bls_pk)
         reg.mark_trusted(np.arange(V))
         lane = BlsLane(reg, I, target_signers=V, max_delay_s=1e9,
-                       device_pairing=device_pairing)
+                       device_pairing=device_pairing,
+                       pallas_field=pallas_field)
         dX = DeviceDriver(I, V, advance_height=True,
                           defer_collect=True, audit=True)
         svcX = VoteService(
@@ -578,10 +579,16 @@ def test_serve_bls_differential_and_forged_fallback():
 
     dC = bls_serve(device_pairing=True)
     dD = bls_serve(device_pairing=False)
+    # ISSUE 18: the same serve, MSM + pairing on the Pallas field-
+    # kernel lane (CPU interpret) — warmup compiles the kernel-lane
+    # variants, the armed sentinel proves zero unwarmed dispatches,
+    # and the decisions must stay leaf-for-leaf identical
+    dE = bls_serve(device_pairing=True, pallas_field="interpret")
 
-    # -- leaf-for-leaf equality across all four planes ----------------------
+    # -- leaf-for-leaf equality across all planes ---------------------------
     for name, dX in (("ed_serve", dB), ("bls_serve_device", dC),
-                     ("bls_serve_host", dD)):
+                     ("bls_serve_host", dD),
+                     ("bls_serve_pallas", dE)):
         for a, b in zip(dA.state, dX.state):
             np.testing.assert_array_equal(np.asarray(a),
                                           np.asarray(b), err_msg=name)

@@ -1,0 +1,182 @@
+"""ISSUE 18 — Pallas Barrett-field kernel lane.
+
+Interpret-mode differentials: the fused multiply+reduce and
+reduce/carry-chain kernels must match the rolled `bls_field_jax` path
+LEAF-FOR-LEAF (exact limbs, not just mod-p values) over random and
+boundary operands — the kernels transliterate the rolled integer
+operation order, so any drift is a bug, not rounding.  Plus the
+satellite-5 discipline check: the serve lane's kernel/rolled selection
+is a retrace STATIC, so warming one lane and dispatching the other
+fails loudly at the armed sentinel, never as a live mid-serve compile
+(driven through registry stubs — zero XLA compiles).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from agnes_tpu.crypto import bls_field_jax as BF
+from agnes_tpu.crypto import bls_ref as ref
+from agnes_tpu.crypto import pallas_field as PF
+
+P = ref.P
+
+#: boundary VALUES the ISSUE names: zero, one, p-1, and the top of the
+#: <4p pre-reduce representative range every strict limb array may hold
+_BOUNDARY = (0, 1, P - 1, P, 4 * P - 1)
+
+
+def _operand_rows(rng, n_random):
+    """[R, NLIMBS] int32 operand rows: the boundary values strict, the
+    random tail as loose sums a+b (a, b < 2p) — limbs <= 2*LMASK and
+    value < 4p, the exact operand envelope `fv_mul_pairs` feeds the
+    reduce (products stay under the Barrett cap)."""
+    rows = [BF.to_limbs(v) for v in _BOUNDARY]
+    for _ in range(n_random):
+        a = int(rng.integers(0, 2**62)) * int(rng.integers(0, 2**62)) \
+            % (2 * P)
+        b = int(rng.integers(0, 2**62)) ** 2 % (2 * P)
+        rows.append(BF.to_limbs(a) + BF.to_limbs(b))
+    return jnp.asarray(np.stack(rows).astype(np.int32))
+
+
+def test_mul_kernel_matches_rolled_leaf_for_leaf():
+    rng = np.random.default_rng(7)
+    xa = _operand_rows(rng, 11)
+    ya = jnp.flip(_operand_rows(rng, 11), axis=0)
+    want = BF.reduce_cols(BF._mul_cols(xa, ya),
+                          BF.NLIMBS * BF._ELEM_LIMB * BF._ELEM_LIMB)
+    got = PF.mul_pairs_call(xa, ya, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # and the limbs really are the product mod p (strict < 4p rep)
+    for i in range(xa.shape[0]):
+        x = BF.from_limbs(np.asarray(xa[i]))
+        y = BF.from_limbs(np.asarray(ya[i]))
+        g = BF.from_limbs(np.asarray(got[i]))
+        assert g < 4 * P and g % P == (x * y) % P, i
+
+
+def _col_rows(rng, n, k):
+    """[n, NLIMBS] columns as k-fold sums of strict encodings of
+    values < 4p/k — limbs <= k*LMASK with total value < 4p, the shape
+    `fv_reduce_stack` columns actually take (a synthetic huge TOP limb
+    would put the value outside the reduce's envelope)."""
+    rows = []
+    for _ in range(n):
+        acc = None
+        for _ in range(k):
+            v = (int(rng.integers(0, 2**62)) ** 2) % (4 * P // k)
+            lv = BF.to_limbs(v)
+            acc = lv if acc is None else acc + lv
+        rows.append(acc)
+    return jnp.asarray(np.stack(rows).astype(np.int32))
+
+
+def test_reduce_kernel_matches_rolled_leaf_for_leaf():
+    rng = np.random.default_rng(11)
+    # the `_z_is_zero_g2` bound: one loosen pass
+    b_small = BF._ELEM_LIMB + BF.LMASK
+    cols_small = jnp.concatenate([
+        jnp.asarray(np.stack([BF.to_limbs(v) for v in _BOUNDARY])
+                    .astype(np.int32)),
+        _col_rows(rng, 19, 3)])
+    # a deep-stack bound: two loosen passes
+    b_big = 16 * BF._ELEM_LIMB
+    cols_big = _col_rows(rng, 24, 32)
+    for cols, bound in ((cols_small, b_small), (cols_big, b_big)):
+        want = BF.reduce_cols(cols, bound)
+        got = PF.reduce_call(cols, bound, interpret=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=f"bound={bound}")
+        for i in range(cols.shape[0]):
+            v = BF.from_limbs(np.asarray(cols[i], np.int64))
+            g = BF.from_limbs(np.asarray(got[i]))
+            assert g < 4 * P and g % P == v % P, (bound, i)
+
+
+def test_field_backend_routing_and_restore():
+    """`field_backend("interpret")` routes `fv_mul` and `reduce_cols`
+    through the kernels and produces the SAME limbs as the rolled
+    path; the context restores the prior backend on every exit."""
+    xs = jnp.asarray(BF.ints_to_limbs(list(_BOUNDARY)))
+    x = BF.fv_in(xs, bound=4 * P)
+    y = BF.fv_in(jnp.flip(xs, axis=0), bound=4 * P)
+    rolled_mul = BF.fv_mul(x, y)
+    cols = jnp.asarray(BF.ints_to_limbs([3, P - 1, 4 * P - 1]))
+    rolled_red = BF.reduce_cols(cols, BF._ELEM_LIMB + BF.LMASK)
+    assert BF.current_backend() is False
+    with BF.field_backend("interpret"):
+        assert BF.current_backend() == "interpret"
+        kern_mul = BF.fv_mul(x, y)
+        kern_red = BF.reduce_cols(cols, BF._ELEM_LIMB + BF.LMASK)
+    assert BF.current_backend() is False
+    assert kern_mul.bound == rolled_mul.bound     # FV bound contract
+    np.testing.assert_array_equal(np.asarray(kern_mul.a),
+                                  np.asarray(rolled_mul.a))
+    np.testing.assert_array_equal(np.asarray(kern_red),
+                                  np.asarray(rolled_red))
+    with pytest.raises(AssertionError):
+        BF.field_backend("cuda").__enter__()      # unknown lane name
+
+
+def test_kernel_lane_selection_is_a_retrace_static():
+    """Satellite 5: the BLS lane resolves `pallas_field` ONCE and
+    carries it in every observe's statics — after warming the rolled
+    lane and arming, a dispatch on the kernel lane raises RetraceError
+    AT THE OBSERVE (before any dispatch could trigger a live compile).
+    Registry-stubbed: the machinery under test is the signature
+    discipline, not XLA."""
+    from agnes_tpu.analysis import retrace
+    from agnes_tpu.device import registry
+    from agnes_tpu.serve.bls_lane import (
+        AggregateClass,
+        BlsKeyRegistry,
+        BlsLane,
+    )
+    from agnes_tpu.utils.metrics import Metrics
+
+    V = 2
+    _pts, pk = _keys(V)
+    reg = BlsKeyRegistry(pk)
+    reg.mark_trusted(np.arange(V))
+
+    class _Driver:
+        def __init__(self):
+            self.sentinel = retrace.RetraceSentinel(metrics=Metrics())
+
+        def _observe(self, entry, args, statics=()):
+            self.sentinel.observe(entry,
+                                  retrace.signature(args, statics))
+
+    share = ref.g2_to_bytes(ref.point_add(ref.G2, ref.G2))
+    cls = AggregateClass(key=(0, 0, 0, 0, 7), signers={0, 1},
+                         shares={0: share, 1: share}, weight=2,
+                         t_first=0.0)
+    drv = _Driver()
+    with registry.override("bls_aggregate",
+                           jit=lambda *a, **kw: (None, None)):
+        lane = BlsLane(reg, 1, pallas_field=False)
+        lane.bind(drv)
+        assert lane.uses_pallas_field is False
+        lane._msm_dispatch(cls, [0, 1])     # learning: becomes expected
+        drv.sentinel.arm()
+        lane._msm_dispatch(cls, [0, 1])     # same lane: silent
+        assert drv.sentinel.report()["unexpected"] == 0
+
+        # lane flip after warmup — the kernel-lane signature was never
+        # warmed, so the armed set rejects it BEFORE dispatch
+        lane.pallas_field = "interpret"
+        with pytest.raises(retrace.RetraceError):
+            lane._msm_dispatch(cls, [0, 1])
+    assert drv.sentinel.report()["unexpected"] == 1
+
+
+def _keys(V):
+    pts, acc = [], None
+    for _ in range(V):
+        acc = ref.point_add(acc, ref.G1)
+        pts.append(acc)
+    pk = np.stack([np.frombuffer(ref.g1_compress(p), np.uint8)
+                   for p in pts])
+    return pts, pk
