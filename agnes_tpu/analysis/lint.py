@@ -28,9 +28,21 @@
            would notice.  Paired with lockcheck's LOCK005 (no
            ``ag_*`` call under the admission lock): together they
            pin the ISSUE-14 GIL-release contract statically.
+  LINT005  bare ``threading.Thread(...)`` outside the thread-wrapper
+           modules — a thread spawned anywhere else bypasses the
+           host's failure containment (serve/threaded.py `_guard`
+           fails the whole host closed when a loop dies; a bare
+           daemon thread dies SILENTLY) and is invisible to the
+           schedule checker, whose `thread_factory` seam can only
+           serialize threads created through it.  Spawn through
+           ThreadedVoteService / FlightRecorder / the metrics
+           exporter, or annotate ``# lint: allow-thread (reason)``
+           anywhere in the call span for the rare justified case
+           (the schedule checker's own turnstile workers are one).
 
 Pragma: ``# lint: allow`` on the offending line (reason after the
-marker), mirroring lockcheck's.
+marker), mirroring lockcheck's; LINT005 uses the more specific
+``# lint: allow-thread`` so a generic allow cannot silence it.
 """
 
 from __future__ import annotations
@@ -102,6 +114,21 @@ AUDITED_CAPI_MODULES = frozenset({
     "agnes_tpu/core/native.py",
     "agnes_tpu/bridge/native_ingest.py",
     "agnes_tpu/serve/native_admission.py",
+})
+
+#: LINT005 pragma — deliberately NOT the generic PRAGMA: a thread
+#: spawn is a structural decision, so the annotation must name it
+THREAD_PRAGMA = "lint: allow-thread"
+
+#: the modules that may construct OS threads directly — each wraps
+#: its threads in a containment story (the serve host's `_guard`
+#: fails closed, the flight recorder's writer is crash-isolated, the
+#: metrics exporter owns its server thread's lifecycle).  Everything
+#: else spawns through these or carries the LINT005 pragma.
+THREAD_WRAPPER_MODULES = frozenset({
+    "agnes_tpu/serve/threaded.py",
+    "agnes_tpu/utils/flightrec.py",
+    "agnes_tpu/utils/metrics_http.py",
 })
 
 
@@ -318,6 +345,54 @@ def check_capi_wrappers(repo_root: str) -> List[Finding]:
     return findings
 
 
+# -- LINT005: bare thread construction outside the wrapper modules -----------
+
+def _span_pragma(lines, node, pragma: str) -> bool:
+    """Pragma anywhere in the call's line span — thread spawns are
+    routinely multi-line calls with the annotation on the closing
+    argument line."""
+    end = getattr(node, "end_lineno", None) or node.lineno
+    return any(pragma in lines[i]
+               for i in range(node.lineno - 1, min(end, len(lines))))
+
+
+class _ThreadVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.lines = source.splitlines()
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "Thread" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id == "threading" \
+                and not _span_pragma(self.lines, node, THREAD_PRAGMA):
+            self.findings.append(Finding(
+                "lint", "LINT005", f"{self.relpath}:{node.lineno}",
+                f"bare threading.Thread(...) outside the thread-"
+                f"wrapper modules — bypasses failure containment "
+                f"(a dead daemon thread is silent; serve/threaded.py "
+                f"fails closed) and the schedule checker's "
+                f"thread_factory seam cannot serialize it (annotate "
+                f"`# {THREAD_PRAGMA} (reason)` if the spawn owns its "
+                f"own containment)"))
+        self.generic_visit(node)
+
+
+def check_threads(repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in package_modules(repo_root):
+        if rel.replace(os.sep, "/") in THREAD_WRAPPER_MODULES:
+            continue
+        with open(os.path.join(repo_root, rel)) as fh:
+            src = fh.read()
+        v = _ThreadVisitor(rel, src)
+        v.visit(ast.parse(src, filename=rel))
+        findings.extend(v.findings)
+    return findings
+
+
 # -- LINT003: unhashable static candidates -----------------------------------
 
 class _StaticKwVisitor(ast.NodeVisitor):
@@ -352,8 +427,9 @@ def check_static_kwargs(repo_root: str) -> List[Finding]:
 
 
 def check_repo(repo_root: str) -> List[Finding]:
-    """All four rules over the repo."""
+    """All five rules over the repo."""
     return (check_hot_paths(repo_root)
             + check_import_time_jits(repo_root)
             + check_static_kwargs(repo_root)
-            + check_capi_wrappers(repo_root))
+            + check_capi_wrappers(repo_root)
+            + check_threads(repo_root))

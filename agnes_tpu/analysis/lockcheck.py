@@ -248,9 +248,17 @@ class LockOrderState:
 
 class InstrumentedLock:
     """A threading.Lock that asserts the global acquisition order at
-    runtime.  `rank` orders the locks (admission=0 < device=1); an
-    acquisition while holding an equal-or-higher rank is a violation
-    — recorded, and raised when `strict`."""
+    runtime.  `rank` orders the locks (admission=0 < device=1 < the
+    rank-2 leaf mutexes); an acquisition while holding an equal-or-
+    higher rank is a violation — recorded, and raised when `strict`.
+
+    The acquire/release steps route through `_raw_acquire` /
+    `_raw_release` and announce themselves via `_sched_point` — the
+    SchedPoint seam (ISSUE 19): the schedule checker subclasses this
+    lock to make every acquisition a serialized, explorable yield
+    point while REUSING the order bookkeeping below verbatim.  All
+    three hooks are trivial here, so the test-mode lock stays what it
+    always was."""
 
     def __init__(self, name: str, rank: int, state: LockOrderState,
                  strict: bool = True):
@@ -260,7 +268,19 @@ class InstrumentedLock:
         self.strict = strict
         self._lock = threading.Lock()
 
-    def __enter__(self):
+    # -- SchedPoint seam (overridden by schedcheck's SchedLock) -----------
+    def _sched_point(self, event: str) -> None:
+        """Called before acquire ('acquire') and after release
+        ('release'); a no-op outside the schedule checker."""
+
+    def _raw_acquire(self) -> None:
+        self._lock.acquire()  # lockcheck: allow (the wrapper IS the with)
+
+    def _raw_release(self) -> None:
+        self._lock.release()  # lockcheck: allow (wrapper __exit__)
+
+    # -- order bookkeeping (shared with SchedLock) ------------------------
+    def _order_check(self) -> None:
         held = self.state.stack()
         bad = [n for n, r in held if r >= self.rank]
         if bad:
@@ -270,15 +290,20 @@ class InstrumentedLock:
                 self.state.violations.append(msg)
             if self.strict:
                 raise AssertionError(msg)
-        self._lock.acquire()  # lockcheck: allow (the wrapper IS the with)
-        held.append((self.name, self.rank))
+
+    def __enter__(self):
+        self._order_check()
+        self._sched_point("acquire")
+        self._raw_acquire()
+        self.state.stack().append((self.name, self.rank))
         with self.state._mu:
             self.state.acquisitions += 1
         return self
 
     def __exit__(self, *exc):
         self.state.stack().remove((self.name, self.rank))
-        self._lock.release()  # lockcheck: allow (wrapper __exit__)
+        self._raw_release()
+        self._sched_point("release")
         return False
 
     # the bare-call API stays available for foreign code, but counts
@@ -293,13 +318,59 @@ class InstrumentedLock:
         return self._lock.release()  # lockcheck: allow (delegate)
 
 
-def instrument(threaded_service, strict: bool = True) -> LockOrderState:
-    """Swap a ThreadedVoteService's two locks for instrumented ones
-    (BEFORE start()); returns the shared order state the test asserts
-    on."""
+#: `threading.Lock` is a factory function (not a type) on CPython —
+#: the resolver's isinstance check needs the real lock type
+_LOCK_TYPE = type(threading.Lock())
+
+
+def _leaf(*path: str):
+    """Resolver for a rank-2 leaf mutex at threaded_service.<path>._mu
+    (getattr-safe: absent anywhere along the path means the deployment
+    has no such lock and the registry entry is skipped)."""
+    def resolve(t):
+        obj = t
+        for attr in path:
+            obj = getattr(obj, attr, None)
+            if obj is None:
+                return None
+        return (obj, "_mu") if isinstance(
+            getattr(obj, "_mu", None), _LOCK_TYPE) else None
+    return resolve
+
+
+#: the runtime-instrumented lock SET, derived here instead of
+#: hand-listed in instrument() (the ISSUE 19 satellite): every entry
+#: is (name, rank, resolver) where resolver(threaded_service) returns
+#: the (holder, attribute) to swap — or None when that deployment has
+#: no such lock (no cache, no BLS lane, no flight recorder, a
+#: duck-typed test stub).  Ranks: the two serve locks keep their
+#: admission(0) -> device(1) order; every leaf mutex held for dict/
+#: ring operations only is rank 2 — acquirable under anything,
+#: NEVER while holding another leaf.
+LOCK_REGISTRY: Tuple = (
+    ("_admission", 0, lambda t: (t, "_admission")),
+    ("_device", 1, lambda t: (t, "_device")),
+    ("cache._mu", 2, _leaf("service", "queue", "cache")),
+    ("bls_table._mu", 2, _leaf("service", "queue", "bls_table")),
+    ("flightrec._mu", 2, _leaf("service", "flightrec")),
+)
+
+
+def instrument(threaded_service, strict: bool = True,
+               lock_factory=None) -> LockOrderState:
+    """Swap a ThreadedVoteService's locks — ALL of LOCK_REGISTRY that
+    resolve on this deployment, not just the two serve locks — for
+    instrumented ones (BEFORE start()); returns the shared order state
+    the test asserts on.  `lock_factory(name, rank, state, strict)`
+    lets the schedule checker substitute its cooperative SchedLock
+    while keeping this registry as the single source of the lock
+    set."""
+    factory = lock_factory or InstrumentedLock
     state = LockOrderState()
-    threaded_service._admission = InstrumentedLock(
-        "_admission", 0, state, strict)
-    threaded_service._device = InstrumentedLock(
-        "_device", 1, state, strict)
+    for name, rank, resolve in LOCK_REGISTRY:
+        target = resolve(threaded_service)
+        if target is None:
+            continue
+        holder, attr = target
+        setattr(holder, attr, factory(name, rank, state, strict))
     return state

@@ -179,6 +179,9 @@ class Inbox:
         """Enqueue a wire blob; False (and counted) when full or
         closed."""
         with self._mu:
+            # schedcheck: atomic (closed-check + append: the PR 3
+            # close/put TOCTOU window — checking closed outside _mu
+            # lets a blob land after the final drain flush)
             if self.closed or len(self._q) >= self.capacity:
                 self.dropped += 1
                 return False
@@ -195,6 +198,9 @@ class Inbox:
         race loss-free — a stop FLAG checked outside the inbox mutex
         cannot order a racing put against the final flush."""
         with self._mu:
+            # schedcheck: atomic (close orders every racing put
+            # against the final flush — the other half of the PR 3
+            # window)
             self.closed = True
             self._not_empty.notify_all()
 
@@ -205,6 +211,9 @@ class Inbox:
         absorbs spurious condition wakeups, so the block-forever
         contract of timeout=None actually holds."""
         with self._not_empty:
+            # schedcheck: atomic (predicate + popleft under one hold:
+            # a wakeup-then-reacquire that re-checks nothing would
+            # double-pop against a racing get)
             self._not_empty.wait_for(lambda: self._q or self.closed,
                                      timeout)
             return self._q.popleft() if self._q else None

@@ -36,17 +36,26 @@ import numpy as np
 from agnes_tpu.serve.batcher import MicroBatcher, ShapeLadder
 from agnes_tpu.serve.queue import AdmissionQueue, AdmitResult, REJECT_NEWEST
 from agnes_tpu.serve.pipeline import ServePipeline
-from agnes_tpu.utils.metrics import (
+from agnes_tpu.utils.metrics import (  # noqa: F401 — SERVE_* threaded-
+    # host names are re-exports for back-compat; they are DEFINED in
+    # utils/metrics.py so the threaded host (and the schedule checker
+    # that runs its real loops, ISSUE 19) can import them without
+    # pulling this module's jax-backed pipeline
     COMPILE_MS_PREFIX,
     Metrics,
     SERVE_ADMIT_WAIT_S,
     SERVE_BATCH_CLOSE_AGE_S,
+    SERVE_DISPATCH_BUSY_FRAC,
     SERVE_E2E_DECISION_S,
+    SERVE_INBOX_DEPTH,
+    SERVE_INBOX_DROPPED,
     SERVE_NATIVE_DRAIN_WALL_S,
     SERVE_NATIVE_INBOX_DEPTH,
     SERVE_NATIVE_REJECTS_FAIRNESS,
     SERVE_NATIVE_REJECTS_MALFORMED,
     SERVE_NATIVE_REJECTS_OVERFLOW,
+    SERVE_SUBMIT_BUSY_FRAC,
+    SERVE_THREAD_FAILURES,
 )
 from agnes_tpu.utils.tracing import Tracer
 
@@ -89,14 +98,8 @@ SERVE_PREVERIFIED_DISPATCHED = "serve_preverified_votes_dispatched"
 SERVE_BLS_AGG_CLASSES = "serve_bls_agg_classes"
 SERVE_BLS_FALLBACK_VOTES = "serve_bls_fallback_votes"
 SERVE_BLS_POP_MISSING = "bls_pop_missing"
-#: threaded-host gauges (serve/threaded.py): per-thread depth and
-#: utilization — the inbox depth the submit thread drains, and each
-#: loop's busy fraction over its last gauge window
-SERVE_INBOX_DEPTH = "serve_inbox_depth"
-SERVE_INBOX_DROPPED = "serve_inbox_dropped"          # counter
-SERVE_THREAD_FAILURES = "serve_thread_failures"      # counter
-SERVE_SUBMIT_BUSY_FRAC = "serve_submit_busy_frac"
-SERVE_DISPATCH_BUSY_FRAC = "serve_dispatch_busy_frac"
+#: threaded-host gauges (serve/threaded.py): defined in
+#: utils/metrics.py, re-exported via the module import above
 
 
 #: compile-event fan-out (ISSUE 8): ONE registry observer for the

@@ -59,17 +59,25 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, TYPE_CHECKING
 
 from agnes_tpu.serve.queue import Inbox
-from agnes_tpu.serve.service import (
+#: metric names come from utils/metrics.py, NOT serve/service.py:
+#: this module is jax-free at import by contract, so the schedule
+#: checker (analysis/schedcheck.py, ISSUE 19) can run the real loop
+#: code below in the zero-XLA interpreter every checker here uses.
+#: VoteService itself is only needed by the threaded_service()
+#: assembler, which imports it lazily.
+from agnes_tpu.utils.metrics import (
     SERVE_DISPATCH_BUSY_FRAC,
     SERVE_INBOX_DEPTH,
     SERVE_INBOX_DROPPED,
     SERVE_SUBMIT_BUSY_FRAC,
     SERVE_THREAD_FAILURES,
-    VoteService,
 )
+
+if TYPE_CHECKING:  # annotation only — keep the module jax-free
+    from agnes_tpu.serve.service import VoteService
 
 
 class ThreadedVoteService:
@@ -83,12 +91,19 @@ class ThreadedVoteService:
                  inbox_capacity: int = 1024,
                  idle_wait_s: float = 0.0005,
                  gauge_interval_s: float = 0.05,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 thread_factory=threading.Thread,
+                 sleep=time.sleep):
         self.service = service
         self.inbox = Inbox(inbox_capacity)
         self.idle_wait_s = float(idle_wait_s)
         self.gauge_interval_s = float(gauge_interval_s)
         self._clock = clock
+        #: SchedPoint seams (ISSUE 19): the schedule checker passes a
+        #: cooperative thread factory + logical sleep so it can
+        #: serialize every yield point of these REAL loops.  Production
+        #: keeps the defaults — a plain attribute read, zero overhead.
+        self._sleep = sleep
         self._admission = threading.Lock()
         self._device = threading.Lock()
         #: native admission (ISSUE 14): the queue's handle holds its
@@ -121,12 +136,12 @@ class ThreadedVoteService:
         #: submit refuses) and stops the twin loop; drain() surfaces
         #: the exception in its report under "thread_failure".
         self.failure: Optional[BaseException] = None
-        self._submit_t = threading.Thread(
+        self._submit_t = thread_factory(
             target=lambda: self._guard(self._submit_loop), daemon=True,
-            name="agnes-serve-submit")
-        self._dispatch_t = threading.Thread(
+            name="agnes-serve-submit")  # lint: allow-thread (the contained-loop wrapper itself: _guard fails closed)
+        self._dispatch_t = thread_factory(
             target=lambda: self._guard(self._dispatch_loop),
-            daemon=True, name="agnes-serve-dispatch")
+            daemon=True, name="agnes-serve-dispatch")  # lint: allow-thread (the contained-loop wrapper itself: _guard fails closed)
 
     def _guard(self, loop) -> None:
         """Exception containment for a loop thread: without it, a
@@ -288,7 +303,7 @@ class ThreadedVoteService:
             elif self._stop.is_set():
                 break          # idle AND draining: nothing left to pump
             else:
-                time.sleep(self.idle_wait_s)
+                self._sleep(self.idle_wait_s)
             now = self._clock()
             if now - win_t0 >= self.gauge_interval_s:
                 self.sample_busy_gauges(now)
@@ -357,6 +372,9 @@ class ThreadedVoteService:
         # + service drain NEED both domains atomically.
         with self._admission, self._device:  # lockcheck: allow (quiescent: loops joined above)
             try:
+                # schedcheck: atomic (residue flush: every inbox blob
+                # accepted before close() must be admitted here —
+                # schedcheck's conservation monitor proves the span)
                 while True:     # TOCTOU residue (docstring)
                     blob = self.inbox.get(timeout=0)
                     if blob is None:
@@ -388,6 +406,8 @@ def threaded_service(driver, batcher, pubkeys=None, *,
     """Convenience assembler: VoteService + ThreadedVoteService,
     started.  `service_kw` passes through to VoteService (ladder,
     capacity, window_predictor, donate, ...)."""
+    from agnes_tpu.serve.service import VoteService  # lazy: jax-backed
+
     svc = VoteService(driver, batcher, pubkeys, **service_kw)
     return ThreadedVoteService(svc, inbox_capacity=inbox_capacity,
                                idle_wait_s=idle_wait_s).start()

@@ -361,6 +361,19 @@ SERVE_BATCH_CLOSE_AGE_S = "serve_batch_close_age_s"
 SERVE_DISPATCH_WALL_S = "serve_dispatch_wall_s"
 SERVE_SETTLE_WALL_S = "serve_settle_wall_s"
 SERVE_E2E_DECISION_S = "serve_submit_to_decision_s"
+#: threaded-host names (serve/threaded.py): per-thread depth and
+#: utilization gauges plus the inbox-refusal / loop-failure counters.
+#: They live HERE (not in serve/service.py, which re-exports them)
+#: because the threaded host is jax-free at import by contract — the
+#: schedule checker (analysis/schedcheck.py, ISSUE 19) runs the real
+#: ThreadedVoteService loops in the same zero-XLA interpreter as the
+#: other checkers, so the host's metric names must not pull the
+#: pipeline (and with it jax) into the process.
+SERVE_INBOX_DEPTH = "serve_inbox_depth"
+SERVE_INBOX_DROPPED = "serve_inbox_dropped"          # counter
+SERVE_THREAD_FAILURES = "serve_thread_failures"      # counter
+SERVE_SUBMIT_BUSY_FRAC = "serve_submit_busy_frac"
+SERVE_DISPATCH_BUSY_FRAC = "serve_dispatch_busy_frac"
 #: ISSUE 10 (BLS aggregate lane, serve/bls_lane.py): host wall of one
 #: class's pairing-product check — the O(1)-per-class cost the lane
 #: trades N Ed25519 verifies for (memo hits record ~0; the histogram
@@ -430,6 +443,15 @@ POD_MEMBERSHIP_EPOCH = "pod_membership_epoch"
 POD_NEGOTIATION_WALL_S = "pod_negotiation_wall_s"
 POD_HOST_READMISSIONS = "pod_host_readmissions"
 MODELCHECK_MEMBERSHIP_STATES = "modelcheck_membership_states"
+#: ISSUE 19 (deterministic interleaving explorer,
+#: analysis/schedcheck.py): distinct complete thread schedules the
+#: cooperative scheduler executed over the REAL threaded serve host,
+#: and monitor violations found (conservation / deadlock / lock-order
+#: / atomicity / gauge-sanity).  ci.sh gate [1e] exports both as
+#: AGNES_SCHEDCHECK_* env vars so bench verdict records can state that
+#: the schedule envelope ran and ran clean — the modelcheck pattern.
+SCHEDCHECK_SCHEDULES_EXPLORED = "schedcheck_schedules_explored"
+SCHEDCHECK_VIOLATIONS = "schedcheck_violations"
 #: per-entry first-dispatch wall gauges, `compile_ms_<entry>` (ISSUE 8
 #: satellite): the registry times the FIRST dispatch of every entry in
 #: the process (trace + compile dominates that call), so the next

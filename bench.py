@@ -184,7 +184,14 @@ _ANALYSIS: dict = {"analysis_entries_audited": 0,
                    # count (ci.sh [1c] exports it; -1 = gate not run
                    # in this process tree, 0 = ran clean)
                    "census_drift_entries": int(os.environ.get(
-                       "AGNES_CENSUS_DRIFT_ENTRIES", -1))}
+                       "AGNES_CENSUS_DRIFT_ENTRIES", -1)),
+                   # ISSUE 19: the interleaving-explorer gate's totals
+                   # (ci.sh [1e] exports them; -1 = gate not run in
+                   # this process tree, violations 0 = ran clean)
+                   "schedcheck_schedules_explored": int(os.environ.get(
+                       "AGNES_SCHEDCHECK_SCHEDULES_EXPLORED", -1)),
+                   "schedcheck_violations": int(os.environ.get(
+                       "AGNES_SCHEDCHECK_VIOLATIONS", -1))}
 
 
 def _harvest_audit(driver) -> None:

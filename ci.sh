@@ -14,6 +14,11 @@
 #       exploration of the consensus core (agnes_modelcheck --scope
 #       smoke): zero XLA compiles, spec-level property monitors,
 #       real-value-or-sentinel under the enclosing timeout;
+#   1e. interleaving explorer gate — deterministic schedule
+#       exploration of the REAL threaded serve host code
+#       (agnes_schedcheck --scope smoke): cooperative turnstile over
+#       real OS threads, preemption bounding + sleep sets,
+#       conservation/deadlock/lock-order/atomic-span monitors;
 #   2.  full pytest on the virtual 8-device CPU mesh;
 #   2b. the 16 interpret-heavy crypto tests in isolated child
 #       interpreters, VERBOSE, so their per-file pass/fail lands in
@@ -69,6 +74,17 @@ g++ -fsanitize=thread -O1 -g -std=c++17 -pthread -o "$TSAN_BIN" \
   agnes_tpu/core/native/sha512.cpp agnes_tpu/core/native/ed25519.cpp \
   agnes_tpu/core/native/capi.cpp
 TSAN_OPTIONS="halt_on_error=1" "$TSAN_BIN"
+# ISSUE 19: the admission queue's shared surface under TSAN — the
+# native half of the schedcheck story ([1e] below serializes every
+# PYTHON-visible yield point, but ag_adm_* release the GIL for their
+# whole span; this binary races producers / a dispatch-shaped drainer
+# / the observability reader inside that span).  Only admission.cpp +
+# its SHA-256 schedule are needed.
+TSAN_ADM_BIN="$(mktemp -d)/tsan_admission_stress"
+g++ -fsanitize=thread -O1 -g -std=c++17 -pthread -o "$TSAN_ADM_BIN" \
+  tests/native/tsan_admission_stress.cpp \
+  agnes_tpu/core/native/admission.cpp agnes_tpu/core/native/sha512.cpp
+TSAN_OPTIONS="halt_on_error=1" "$TSAN_ADM_BIN"
 
 echo "=== [1c/4] static invariant analyzer (abstract tracing, no XLA compiles) ==="
 # ISSUE 4: the five analysis passes — jaxpr audit (donation honored,
@@ -204,6 +220,49 @@ export AGNES_MODELCHECK_EPOCH_STATES="${MC_EPOCH:?}"
 export AGNES_MODELCHECK_CHURN_STATES="${MC_CHURN:?}"
 export AGNES_MODELCHECK_EPOCH_ORBIT_REDUCTION="${MC_EPRED:?}"
 export AGNES_MODELCHECK_MEMBERSHIP_STATES="${MC_MEM:?}"
+
+echo "=== [1e/4] interleaving explorer (threaded serve host, no XLA) ==="
+# ISSUE 19: CHESS-style deterministic schedule exploration of the REAL
+# ThreadedVoteService/Inbox/AdmissionQueue/VerifiedCache code — every
+# lock acquire/release, inbox put/get, condition wait, native call
+# boundary and clock read serialized under a cooperative turnstile,
+# iterative preemption bounding + sleep-set pruning, vote-conservation
+# / deadlock / lock-order / atomic-span monitors on every schedule.
+# Zero jax imports, zero XLA compiles; the CLI discovers the enclosing
+# timeout and degrades to a complete=false partial (real-value-or-
+# sentinel, like [1d]).
+SCHED_JSON="$(mktemp -d)/agnes_schedcheck.json"
+SCHED_RC=0
+timeout -k 10 300 python scripts/agnes_schedcheck.py --scope smoke \
+  --json > "$SCHED_JSON" || SCHED_RC=$?
+if [ "$SCHED_RC" -ne 0 ]; then
+  echo "interleaving explorer FAILED (rc=$SCHED_RC):"
+  tail -5 "$SCHED_JSON"; exit 1
+fi
+SCHED_NUMS="${SCHED_JSON%.json}.nums"
+python - "$SCHED_JSON" "$SCHED_NUMS" <<'PY'
+import json, sys
+rep = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert rep["ok"], [c["violations"] for c in rep["configs"].values()]
+assert rep["violations"] == 0, rep
+assert rep["schedules_explored"] > 0, rep
+if rep["complete"]:
+    # acceptance floor: a COMPLETE smoke sweep visits >= 1k distinct
+    # schedules (measured envelope well above; a complete run under
+    # the floor means someone collapsed a config or broke the DFS) —
+    # a deadline-sentinel partial is exempt (slow box, not a
+    # regression)
+    assert rep["schedules_explored"] >= 1_000, rep["schedules_explored"]
+kind = "EXHAUSTED" if rep["complete"] else "partial (deadline sentinel)"
+print(f"interleaving explorer OK: {rep['schedules_explored']} "
+      f"schedules {kind} across {len(rep['configs'])} configs, "
+      f"0 violations in {rep['seconds']}s")
+with open(sys.argv[2], "w") as f:
+    f.write(f"{rep['schedules_explored']} {rep['violations']}\n")
+PY
+read -r SCHED_SCHEDS SCHED_VIOLS < "$SCHED_NUMS"
+export AGNES_SCHEDCHECK_SCHEDULES_EXPLORED="${SCHED_SCHEDS:?}"
+export AGNES_SCHEDCHECK_VIOLATIONS="${SCHED_VIOLS:?}"
 
 echo "=== [2/4] full test suite (virtual 8-device CPU mesh) ==="
 # step 1 already ran the native differential + fuzz files under ASan

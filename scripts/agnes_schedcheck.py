@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""agnes_schedcheck: deterministic interleaving explorer for the
+threaded serve host (agnes_tpu/analysis/schedcheck.py, ISSUE 19).
+
+Runs the REAL ThreadedVoteService/Inbox/AdmissionQueue/VerifiedCache
+code on real OS threads under a cooperative turnstile scheduler —
+every lock acquire/release, inbox put/get, condition wait, native
+call boundary and clock read is a serialized, explorable yield point
+— and exhausts the schedule tree under CHESS-style iterative
+preemption bounding with sleep-set pruning, checking vote
+conservation, deadlock freedom, runtime lock order and the
+`# schedcheck: atomic` span annotations on every schedule.  Pure CPU,
+zero jax imports, ZERO XLA compiles: it shares the pre-test ci.sh
+gate slot with agnes_lint and agnes_modelcheck.
+
+Usage:
+  scripts/agnes_schedcheck.py --scope smoke --json   # the ci.sh gate
+  scripts/agnes_schedcheck.py --scope tiny           # seconds-fast
+  scripts/agnes_schedcheck.py --self-test            # mutant drill:
+                                  # the 3 shipped races re-introduced,
+                                  # caught, ddmin-minimized, honest-
+                                  # replayed clean
+  scripts/agnes_schedcheck.py --scope smoke --no-sleep-sets  # debug
+
+The CLI discovers its enclosing wall budget (AGNES_SCHEDCHECK_DEADLINE_S
+or an ancestor `timeout N`) and stops cleanly with complete=false
+partials rather than getting SIGKILLed — the same
+real-value-or-sentinel contract as the bench gates.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from agnes_tpu.analysis.schedcheck import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,7 @@ _CHEAP = (          # no XLA compiles (stdlib / numpy / ctypes / refs)
     "test_pallas_field.py",       # kernel differentials: small
     #                               interpret compiles, seconds total
     "test_round_votes.py",
+    "test_schedcheck.py",
     "test_serve.py", "test_serve_cache.py", "test_serve_threaded.py",
     "test_state_machine.py",
     "test_tpu_holders.py",

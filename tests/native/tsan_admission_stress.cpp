@@ -1,0 +1,222 @@
+// ThreadSanitizer stress for the native admission queue (ISSUE 19).
+//
+// The schedule checker (analysis/schedcheck.py) serializes every
+// PYTHON-visible yield point of the threaded serve host, but the
+// ag_adm_* calls release the GIL for their whole span — their inner
+// interleavings are exactly what the cooperative scheduler cannot
+// see.  This binary is that other half: the admission queue's shared
+// surface (core/native/admission.cpp) under real concurrency, fully
+// TSAN-instrumented, in the production threaded-host topology:
+//
+//   producer threads   ag_adm_submit batches (well-formed + one
+//                      malformed lane), then race a mark_verified
+//                      back-annotation for their own submit — the
+//                      wrapper's dedup-cache flow, which the C side
+//                      documents as racing concurrent drains safely
+//   drainer thread     the dispatch loop's shape: unlocked depth
+//                      read, then a drain sized from it — the C side
+//                      must clamp to the live size (the PR 14
+//                      review-fix contract: got <= asked, and only
+//                      rows [0, got) are real)
+//   cold reader        counters / oldest_ts / instance_depth /
+//                      capped export, racing everything — the
+//                      observability path a bench heartbeat takes
+//
+// Exit 0 = no data race AND the admission taxonomy balances:
+// submitted = admitted + rejected, admitted = drained + evicted, and
+// the drainer's accumulated row count equals the drained counter
+// (no phantom or lost records).  ci.sh builds this with
+// -fsanitize=thread and runs it as step 1b; the plain (uninstrumented)
+// build doubles as a cheap correctness test in the python suite.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* ag_adm_new(int64_t I, int64_t capacity, int64_t instance_cap,
+                 int32_t policy, int32_t with_digests);
+void ag_adm_free(void* h);
+int64_t ag_adm_submit(void* h, const uint8_t* buf, int64_t nbytes,
+                      int64_t* out_counts, uint8_t* out_digests);
+void ag_adm_mark_verified(void* h, int64_t seq, const uint8_t* ver,
+                          int64_t n);
+int64_t ag_adm_depth(void* h);
+int64_t ag_adm_instance_depth(void* h, int64_t i);
+double ag_adm_oldest_ts(void* h);
+void ag_adm_counters(void* h, int64_t* out7);
+int64_t ag_adm_drain(void* h, int64_t n, int64_t* inst, int64_t* val,
+                     int64_t* hts, int64_t* rnd, int64_t* typ,
+                     int64_t* value, uint8_t* sigs, uint8_t* ver,
+                     uint8_t* out_dig, double* ts);
+int64_t ag_adm_export(void* h, uint8_t* raw, uint8_t* ver, int64_t cap);
+}
+
+namespace {
+
+constexpr int kRecSize = 96;
+constexpr int64_t I = 4;
+constexpr int64_t kCapacity = 128;
+constexpr int64_t kInstanceCap = 64;     // python default: 2*cap/I
+constexpr int kProducers = 3;
+constexpr int kBatches = 300;
+constexpr int kPerBatch = 16;            // 15 well-formed + 1 malformed
+constexpr int64_t kDrainMax = 32;
+
+// wire-record packer (the module-top layout of ingest.cpp)
+void pack(uint8_t* p, uint32_t inst, uint32_t val, int64_t height,
+          int32_t round, uint8_t typ, int64_t value) {
+  std::memset(p, 0, kRecSize);
+  std::memcpy(p + 0, &inst, 4);
+  std::memcpy(p + 4, &val, 4);
+  std::memcpy(p + 8, &height, 8);
+  std::memcpy(p + 16, &round, 4);
+  p[20] = typ;
+  p[21] = 1;
+  std::memcpy(p + 24, &value, 8);
+}
+
+// one drain in the dispatch loop's exact shape: size from an UNLOCKED
+// depth read, then trust only the return value
+int64_t drain_once(void* h) {
+  int64_t n0 = ag_adm_depth(h);
+  if (n0 <= 0) return 0;
+  int64_t ask = std::min(n0, kDrainMax);
+  std::vector<int64_t> inst(ask), val(ask), hts(ask), rnd(ask),
+      typ(ask), value(ask);
+  std::vector<uint8_t> sigs(ask * 64), ver(ask), dig(ask * 32);
+  std::vector<double> ts(ask);
+  int64_t got = ag_adm_drain(h, ask, inst.data(), val.data(),
+                             hts.data(), rnd.data(), typ.data(),
+                             value.data(), sigs.data(), ver.data(),
+                             dig.data(), ts.data());
+  if (got < 0 || got > ask) {
+    std::fprintf(stderr, "drain clamp broken: asked %lld got %lld\n",
+                 static_cast<long long>(ask),
+                 static_cast<long long>(got));
+    std::abort();
+  }
+  // rows [0, got) must be real records, never uninitialized tail
+  for (int64_t k = 0; k < got; ++k) {
+    if (inst[k] < 0 || inst[k] >= I) {
+      std::fprintf(stderr, "phantom row: inst=%lld at %lld\n",
+                   static_cast<long long>(inst[k]),
+                   static_cast<long long>(k));
+      std::abort();
+    }
+  }
+  return got;
+}
+
+}  // namespace
+
+int main() {
+  void* h = ag_adm_new(I, kCapacity, kInstanceCap, /*drop_oldest=*/1,
+                       /*with_digests=*/1);
+  if (!h) { std::fprintf(stderr, "ag_adm_new failed\n"); return 2; }
+
+  std::atomic<int> done{0};
+  std::atomic<int64_t> drained_rows{0};
+
+  auto producer = [&](int id) {
+    std::vector<uint8_t> buf(kPerBatch * kRecSize);
+    std::vector<uint8_t> dig(kPerBatch * 32);
+    int64_t counts[5];
+    std::vector<uint8_t> mark(kPerBatch);
+    for (int b = 0; b < kBatches; ++b) {
+      for (int k = 0; k < kPerBatch - 1; ++k) {
+        uint32_t inst = static_cast<uint32_t>((b + k) % I);
+        uint32_t val = static_cast<uint32_t>((id * 17 + k) % 64);
+        pack(buf.data() + k * kRecSize, inst, val, 0, 0, 1, 5);
+      }
+      // one malformed lane per batch (out-of-range instance id)
+      pack(buf.data() + (kPerBatch - 1) * kRecSize, 0xFFFF, 0, 0, 0, 1, 5);
+      int64_t seq = ag_adm_submit(h, buf.data(), kPerBatch * kRecSize,
+                                  counts, dig.data());
+      // dedup-cache back-annotation, racing the drainer — the C side's
+      // documented contract: already-drained records are skipped
+      if (counts[0] > 0) {
+        std::fill(mark.begin(), mark.begin() + counts[0],
+                  static_cast<uint8_t>(b & 1));
+        ag_adm_mark_verified(h, seq, mark.data(), counts[0]);
+      }
+    }
+    done.fetch_add(1);
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve(kProducers + 1);
+  for (int p = 0; p < kProducers; ++p) threads.emplace_back(producer, p);
+
+  // cold reader: the observability surface, racing everything
+  threads.emplace_back([&] {
+    int64_t counters[7];
+    std::vector<uint8_t> raw(kCapacity * kRecSize), ver(kCapacity);
+    while (done.load() < kProducers) {
+      ag_adm_counters(h, counters);
+      (void)ag_adm_oldest_ts(h);
+      for (int64_t i = 0; i < I; ++i) (void)ag_adm_instance_depth(h, i);
+      // export sized from a racy depth read; the C side clamps writes
+      int64_t cap = std::min(ag_adm_depth(h), kCapacity);
+      if (cap > 0) (void)ag_adm_export(h, raw.data(), ver.data(), cap);
+    }
+  });
+
+  // drainer on the main thread, racing the producers
+  while (done.load() < kProducers) drained_rows += drain_once(h);
+  for (auto& t : threads) t.join();
+  // residue: everything still queued must drain exactly once
+  for (int64_t got; (got = drain_once(h)) > 0;) drained_rows += got;
+
+  int64_t c[7];  // [submitted, admitted, rej_overflow, rej_fairness,
+                 //  rej_malformed, evicted, drained]
+  ag_adm_counters(h, c);
+  const int64_t want_submitted =
+      int64_t{kProducers} * kBatches * kPerBatch;
+  const int64_t want_malformed = int64_t{kProducers} * kBatches;
+  int rc = 0;
+  if (c[0] != want_submitted) {
+    std::fprintf(stderr, "submitted=%lld want %lld\n",
+                 static_cast<long long>(c[0]),
+                 static_cast<long long>(want_submitted));
+    rc = 1;
+  }
+  if (c[4] != want_malformed) {
+    std::fprintf(stderr, "malformed=%lld want %lld\n",
+                 static_cast<long long>(c[4]),
+                 static_cast<long long>(want_malformed));
+    rc = 1;
+  }
+  if (c[1] != c[0] - c[2] - c[3] - c[4]) {
+    std::fprintf(stderr, "admission taxonomy unbalanced\n");
+    rc = 1;
+  }
+  if (drained_rows.load() != c[6]) {
+    std::fprintf(stderr, "drained rows %lld != drained counter %lld "
+                 "(phantom/lost records)\n",
+                 static_cast<long long>(drained_rows.load()),
+                 static_cast<long long>(c[6]));
+    rc = 1;
+  }
+  if (c[1] != c[6] + c[5] || ag_adm_depth(h) != 0) {
+    std::fprintf(stderr, "conservation: admitted %lld != drained %lld "
+                 "+ evicted %lld (+ depth %lld)\n",
+                 static_cast<long long>(c[1]),
+                 static_cast<long long>(c[6]),
+                 static_cast<long long>(c[5]),
+                 static_cast<long long>(ag_adm_depth(h)));
+    rc = 1;
+  }
+  ag_adm_free(h);
+  if (rc == 0)
+    std::printf("tsan_admission_stress ok: submitted=%lld drained=%lld "
+                "evicted=%lld\n",
+                static_cast<long long>(c[0]),
+                static_cast<long long>(c[6]),
+                static_cast<long long>(c[5]));
+  return rc;
+}

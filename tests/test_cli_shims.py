@@ -26,6 +26,8 @@ SHIMS = {
     "agnes_lint.py": ("agnes_tpu.analysis.lint_cli", "agnes-lint"),
     "agnes_metrics.py": ("agnes_tpu.utils.metrics_cli",
                          "agnes-metrics"),
+    "agnes_schedcheck.py": ("agnes_tpu.analysis.schedcheck",
+                            "agnes-schedcheck"),
 }
 
 
@@ -82,7 +84,8 @@ def test_jax_free_shims_stay_jax_free():
     code = (
         "import importlib, sys\n"
         "for m in ('agnes_tpu.analysis.modelcheck',"
-        " 'agnes_tpu.utils.metrics_cli'):\n"
+        " 'agnes_tpu.utils.metrics_cli',"
+        " 'agnes_tpu.analysis.schedcheck'):\n"
         "    assert callable(importlib.import_module(m).main)\n"
         "assert 'jax' not in sys.modules, 'jax leaked into the CLIs'\n"
         "print('SHIM-JAXFREE-OK')\n")

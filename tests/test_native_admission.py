@@ -217,6 +217,12 @@ def test_threaded_drain_clamp_stress_drop_oldest():
     q = NativeAdmissionQueue(I, 8, instance_cap=100,
                              policy="drop_oldest")
     wires = [rand_wire(rng, n) for n in (2, 3, 5, 8)]
+    # overflow the 8-record capacity single-threaded FIRST (18 records
+    # submitted) so drop_oldest provably bites even when the producer
+    # thread is starved by a loaded box — the eviction assertion below
+    # must not depend on OS scheduling winning a race
+    for w in wires:
+        q.submit(w)
     stop = threading.Event()
     errs = []
     drained = [0, 0]

@@ -1,0 +1,237 @@
+"""Deterministic interleaving explorer (ISSUE 19): the checker's own
+tripwires.
+
+The explorer's value rests on four properties that are easy to break
+silently while refactoring the serve host: (1) replay determinism —
+the same forced schedule must reproduce the identical execution, or
+minimized repros are fiction; (2) pruning soundness — sleep sets must
+not hide terminal states the full tree reaches; (3) bite — the three
+shipped races, resurrected as mutants, must still be caught, their
+schedules ddmin-minimized, and the minimized schedules must replay
+CLEAN on the honest build (a checker that flags honest code is worse
+than none); (4) jax-freedom — the ci.sh [1e] gate slot budget assumes
+zero XLA compiles.  The TSan harness's plain build rides along as a
+cheap correctness test of the native half, and the LINT005 /
+lock-registry satellites are pinned here too.
+
+Everything in this file is pure CPU and compile-free (conftest _CHEAP
+tier).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from agnes_tpu.analysis import lint, lockcheck, schedcheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = schedcheck.SCOPES["tiny"][0]
+
+
+# -- (1) replay determinism ---------------------------------------------------
+
+def test_replay_is_deterministic():
+    """The same forced schedule reproduces the identical execution:
+    same choices, same decision points, same digest, same trace."""
+    base = schedcheck.run_once(TINY)
+    assert base.completed and not base.violations
+    # perturb: force the lexicographically-next sibling at the first
+    # multi-enabled decision, then replay THAT schedule twice
+    forced = list(base.choices)
+    for res in (schedcheck.run_once(TINY, forced=forced),
+                schedcheck.run_once(TINY, forced=forced)):
+        assert res.choices == base.choices
+        assert res.digest == base.digest
+        assert res.trace == base.trace
+        assert len(res.decisions) == len(base.decisions)
+
+
+def test_distinct_schedules_reach_distinct_traces():
+    """Exploration is not a no-op: the tiny scope's schedule tree has
+    more than one execution and at least one real interleaving fork."""
+    r = schedcheck.explore(TINY)
+    assert r.complete
+    assert r.schedules > 100
+    assert r.max_decisions > 1
+    assert not r.violations
+
+
+# -- (2) pruning soundness ----------------------------------------------------
+
+def test_sleep_set_pruning_preserves_terminal_states():
+    """Sleep-set pruning must visit every terminal state the full
+    tree visits (fewer schedules, same digest SET) — the standard
+    soundness argument, checked by brute force on the tiny scope."""
+    full = schedcheck.explore(TINY, sleep_sets=False)
+    pruned = schedcheck.explore(TINY, sleep_sets=True)
+    assert full.complete and pruned.complete
+    assert pruned.schedules <= full.schedules
+    assert pruned.digests == full.digests
+    assert not full.violations and not pruned.violations
+
+
+# -- (3) bite: the three shipped races, resurrected ---------------------------
+
+def test_self_test_catches_minimizes_and_exonerates():
+    """Every mutant caught, its schedule ddmin-minimized, and the
+    minimized schedule replaying clean on the honest build."""
+    rep = schedcheck.self_test()
+    assert rep["ok"], rep
+    for name, kinds in (("inbox_close_toctou",
+                         ("conservation", "atomicity")),
+                        ("native_drain_shrink", ("conservation",)),
+                        ("busy_frac_inflight", ("busy_frac",))):
+        rec = rep[name]
+        assert rec["caught"], (name, rec)
+        assert rec["honest_clean"], (name, rec)
+        assert rec["minimized_len"] <= rec["schedule_len"], (name, rec)
+        assert set(rec["kinds"]) & set(kinds), (name, rec)
+        # the minimized schedule still reproduces ON DEMAND — the
+        # repro a regression investigation would actually run
+        res = schedcheck.run_once(schedcheck.MUTANTS[name][0], name,
+                                  forced=rec["minimized"])
+        assert any(v.kind in kinds for v in res.violations), (name, res)
+
+
+def test_smoke_scope_runs_clean():
+    """One pass of the cheapest smoke config end-to-end through
+    run_scope (the ci.sh [1e] shape) — bounded so the full sweep
+    stays in the gate, not the test suite."""
+    rep = schedcheck.run_scope("tiny")
+    assert rep["ok"] and rep["complete"], rep
+    assert rep["violations"] == 0
+    assert rep["schedules_explored"] > 100
+
+
+# -- (4) jax-freedom + atomic annotations -------------------------------------
+
+def test_schedcheck_import_is_jax_free():
+    code = (
+        "import sys, agnes_tpu.analysis.schedcheck as sc\n"
+        "r = sc.run_once(sc.SCOPES['tiny'][0])\n"
+        "assert r.completed and not r.violations, r.violations\n"
+        "assert 'jax' not in sys.modules, 'jax leaked into schedcheck'\n"
+        "print('SCHEDCHECK-JAXFREE-OK')\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120,
+                         cwd=REPO)
+    assert out.returncode == 0 and "SCHEDCHECK-JAXFREE-OK" in out.stdout, (
+        out.stdout, out.stderr)
+
+
+def test_atomic_annotations_match_registry():
+    """Every `# schedcheck: atomic` marker in the serve tree has a
+    registry entry and vice versa — a moved/renamed span fails here,
+    not silently in the monitor."""
+    assert schedcheck.check_atomic_annotations(REPO) == []
+
+
+# -- satellite: TSan harness plain build --------------------------------------
+
+def test_tsan_admission_harness_plain_build(tmp_path):
+    """The ci.sh [1b] admission stress binary, built WITHOUT
+    -fsanitize=thread, doubles as a cheap correctness test: the
+    admission taxonomy must balance under real producer/drainer/reader
+    concurrency (exit 0 prints the ok line)."""
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ on this box (ci.sh [1b] covers it)")
+    binary = tmp_path / "tsan_admission_stress"
+    build = subprocess.run(
+        ["g++", "-O1", "-std=c++17", "-pthread", "-o", str(binary),
+         os.path.join(REPO, "tests/native/tsan_admission_stress.cpp"),
+         os.path.join(REPO, "agnes_tpu/core/native/admission.cpp"),
+         os.path.join(REPO, "agnes_tpu/core/native/sha512.cpp")],
+        capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run([str(binary)], capture_output=True, text=True,
+                         timeout=300)
+    assert run.returncode == 0, (run.stdout, run.stderr)
+    assert "tsan_admission_stress ok" in run.stdout, run.stdout
+
+
+# -- satellite: registry-derived lock instrumentation -------------------------
+
+def test_lock_registry_names_and_ranks():
+    """The instrumented lock set is registry-derived (not hand-listed
+    in instrument()); the serve pair keeps admission(0) -> device(1),
+    leaf mutexes are rank 2."""
+    reg = {name: rank for name, rank, _ in lockcheck.LOCK_REGISTRY}
+    assert reg == {"_admission": 0, "_device": 1, "cache._mu": 2,
+                   "bls_table._mu": 2, "flightrec._mu": 2}
+
+
+def test_instrument_skips_absent_leaves():
+    """Resolvers are getattr-safe: a deployment without a cache / BLS
+    table / flight recorder instruments only the locks it has."""
+    class Bare:
+        pass
+
+    t = Bare()
+    t._admission = None
+    t._device = None
+    state = lockcheck.instrument(t, strict=True)
+    assert isinstance(t._admission, lockcheck.InstrumentedLock)
+    assert isinstance(t._device, lockcheck.InstrumentedLock)
+    assert state.violations == []
+    # none of the leaf resolvers invented an attribute
+    assert not hasattr(t, "service")
+
+
+# -- satellite: LINT005 (bare thread construction) ----------------------------
+
+def _lint_tmp_repo(tmp_path, body):
+    pkg = tmp_path / "agnes_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(body))
+    return str(tmp_path)
+
+
+def test_lint005_flags_bare_thread(tmp_path):
+    root = _lint_tmp_repo(tmp_path, """\
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+        """)
+    findings = lint.check_threads(root)
+    assert len(findings) == 1
+    assert findings[0].code == "LINT005"
+    assert "agnes_tpu/mod.py:4" in findings[0].where.replace(os.sep, "/")
+
+
+def test_lint005_span_pragma_clears_multiline_call(tmp_path):
+    root = _lint_tmp_repo(tmp_path, """\
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(
+                target=fn,
+                daemon=True)  # lint: allow-thread (owns containment)
+            t.start()
+            return t
+        """)
+    assert lint.check_threads(root) == []
+
+
+def test_lint005_wrapper_modules_exempt(tmp_path):
+    pkg = tmp_path / "agnes_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "threaded.py").write_text(
+        "import threading\n"
+        "def spawn(fn):\n"
+        "    return threading.Thread(target=fn)\n")
+    assert lint.check_threads(str(tmp_path)) == []
+
+
+def test_lint005_repo_is_clean():
+    """Every bare threading.Thread in the real tree is in a wrapper
+    module or pragma-annotated — the rule holds on the code it was
+    written for."""
+    assert lint.check_threads(REPO) == []
