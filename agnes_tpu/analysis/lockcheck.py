@@ -355,6 +355,33 @@ LOCK_REGISTRY: Tuple = (
     ("flightrec._mu", 2, _leaf("service", "flightrec")),
 )
 
+#: the NATIVE side of the lock order (ISSUE 20): the C++ mutexes the
+#: sharded admission front-end holds below everything Python.  These
+#: cannot be runtime-swapped (they live inside the handle), so this
+#: table is the documented contract the TSan lane
+#: (tests/native/tsan_admission_stress.cpp) exercises and a drift
+#: test greps the C source against.  Entries are (name, rank, rule):
+#:
+#:   AdmQ::mu        per-shard leaf — one per shard; when a group
+#:                   operation must hold SEVERAL (the k-way merged
+#:                   drain, the atomic export) they are acquired in
+#:                   ASCENDING shard order, always all-or-nothing
+#:   AdmShards::route_mu   routing-table leaf (seq -> shard route for
+#:                   mark_verified) — never nested with any AdmQ::mu
+#:                   in either direction: submit stores the route
+#:                   AFTER every per-shard screen returned, the mark
+#:                   moves the route OUT under route_mu before any
+#:                   shard back-walk
+#:
+#: Both sit strictly below the Python locks: every ag_adms_* entry
+#: point acquires them inside one GIL-released span and returns with
+#: none held, which is WHY LOCK005 can demand the admission lock be
+#: elided — there is no lock-order edge from Python into the handle.
+NATIVE_LOCK_ORDER: Tuple = (
+    ("AdmQ::mu", 2, "per-shard leaf; multi-shard holds ascending"),
+    ("AdmShards::route_mu", 2, "routing leaf; never nested with mu"),
+)
+
 
 def instrument(threaded_service, strict: bool = True,
                lock_factory=None) -> LockOrderState:

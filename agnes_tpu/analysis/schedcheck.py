@@ -84,11 +84,12 @@ import numpy as np
 
 from agnes_tpu.analysis import lockcheck
 from agnes_tpu.analysis.modelcheck import _ddmin
-from agnes_tpu.bridge.native_ingest import pack_wire_votes
+from agnes_tpu.bridge.native_ingest import REC_SIZE, pack_wire_votes
 from agnes_tpu.serve.batcher import MicroBatcher, ShapeLadder
 from agnes_tpu.serve.cache import VerifiedCache
 from agnes_tpu.serve.queue import (
     AdmissionQueue,
+    AdmitResult,
     DROP_OLDEST,
     Inbox,
     REJECT_NEWEST,
@@ -715,6 +716,110 @@ class _ShrinkDrainQueue(_NativeQueue):
         return _PaddedBatch(cols, n0)
 
 
+class _ShardedQueue:
+    """The ISSUE-20 sharded native handle, modeled: N REAL
+    AdmissionQueues (capacity split evenly, home shard =
+    instance // L — the C side's HostPlan-style routing) behind the
+    single-queue duck surface.  The HONEST submit is ONE announced
+    native span: route + per-shard fan-out inside one quantum, which
+    is exactly what the real handle's whole-call GIL release gives.
+    The model checks CONSERVATION across the fan-in (`records_in`
+    below is the accounting boundary every record crosses before
+    routing); byte-level merge determinism is the conformance
+    differential's job (tests/test_native_admission.py), not this
+    checker's."""
+
+    native = True
+
+    def __init__(self, inners: List[AdmissionQueue],
+                 sched: Scheduler, instances_per_shard: int):
+        self.shards = inners
+        self.sched = sched
+        self.L = instances_per_shard
+        self.records_in = 0          # records handed to the fan-in
+
+    @property
+    def depth(self):
+        return sum(q.depth for q in self.shards)
+
+    @property
+    def oldest_ts(self):
+        live = [t for t in (q.oldest_ts for q in self.shards)
+                if t is not None]
+        return min(live) if live else None
+
+    @property
+    def counters(self):
+        out: Dict[str, int] = {}
+        for q in self.shards:
+            for k, v in q.counters.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    @property
+    def cache(self):
+        return self.shards[0].cache
+
+    def _route(self, raw: bytes) -> List[bytearray]:
+        """Per-shard byte groups, ascending-shard order preserved
+        within each group (the real fan-in's arrival order)."""
+        groups = [bytearray() for _ in self.shards]
+        for k in range(len(raw) // REC_SIZE):
+            rec = raw[k * REC_SIZE:(k + 1) * REC_SIZE]
+            inst = int.from_bytes(rec[0:4], "little")
+            s = min(inst // self.L, len(self.shards) - 1)
+            groups[s] += rec
+        return groups
+
+    def _fan_out(self, groups) -> AdmitResult:
+        rs = [self.shards[s].submit(bytes(g))
+              for s, g in enumerate(groups) if g]
+        if not rs:
+            return AdmitResult(0, 0, 0, 0, 0, 0)
+        return AdmitResult(*(sum(f) for f in zip(*rs)))
+
+    def submit(self, wire_bytes):
+        raw = wire_bytes if isinstance(wire_bytes, bytes) \
+            else bytes(wire_bytes)
+        self.records_in += len(raw) // REC_SIZE
+        self.sched.point("native", "queue")   # ONE atomic native span
+        return self._fan_out(self._route(raw))
+
+    def drain(self, max_records=None):
+        self.sched.point("native", "queue")
+        for q in self.shards:
+            b = q.drain(max_records)
+            if b is not None:
+                return b
+        return None
+
+
+class _LostRouteShards(_ShardedQueue):
+    """[mutant: shard_route_lost] the ISSUE 20 pre-review fan-in: the
+    routing scratch lived on the HANDLE (one shared buffer, not a
+    stack-local) and the fan-out ran as a SECOND native span.  Two
+    concurrent submits: B preempts A inside the gap, routes into the
+    shared scratch and consumes it; A resumes to a consumed scratch
+    (its records never reach any shard) — or A fans out B's groups
+    and B finds the scratch consumed (B's records lost instead).
+    Either interleaving breaks fan-in conservation: records_in !=
+    the summed per-shard `submitted` counters."""
+
+    _scratch: Optional[List[bytearray]] = None
+
+    def submit(self, wire_bytes):
+        raw = wire_bytes if isinstance(wire_bytes, bytes) \
+            else bytes(wire_bytes)
+        self.records_in += len(raw) // REC_SIZE
+        self.sched.point("native", "queue")   # span 1: route
+        self._scratch = self._route(raw)
+        self.sched.point("native", "queue")   # span 2: fan-out (gap!)
+        groups, self._scratch = self._scratch, None
+        if groups is None:                    # consumed by the racer
+            return AdmitResult(0, 0, 0, 0, 0, 0)
+        return self._fan_out(groups)
+
+
 class _ToctouInbox(Inbox):
     """[mutant: inbox_close_toctou] the PR 3 bug: closed/capacity
     checked OUTSIDE the mutex.  The unlocked reads are announced as
@@ -791,11 +896,22 @@ class SchedConfig:
     raw_drainers: int = 0
     drain_calls: int = 2            # per raw drainer
     drain_records: int = 3          # max_records per raw drain call
+    #: extra threads calling queue.submit() directly, racing each
+    #: other and the submit loop — the ISSUE-20 sharded handle's
+    #: documented contract (N socket threads through one fan-in, no
+    #: shared mutex); the Python queue's contract is the admission
+    #: lock, so this too requires native=True
+    raw_submitters: int = 0
+    submit_blobs: int = 1           # per raw submitter
     instances: int = 2
     capacity: int = 64
     inbox_capacity: int = 8
     target_votes: int = 4
     native: bool = False
+    #: >1 models the ISSUE-20 sharded native handle (_ShardedQueue):
+    #: N real AdmissionQueues behind one fan-in; requires native=True
+    #: and instances % native_shards == 0
+    native_shards: int = 1
     drop_oldest: bool = False
     cache: bool = False
     gauge_interval_s: float = 1e9   # huge: no clock-value branching
@@ -867,16 +983,28 @@ def _build(cfg: SchedConfig, sched: Scheduler,
                      "clock" if cfg.clock_dep else None)
     metrics = Metrics()
     cache = VerifiedCache(max_bytes=1 << 16) if cfg.cache else None
-    inner = AdmissionQueue(
-        cfg.instances, cfg.capacity,
-        policy=DROP_OLDEST if cfg.drop_oldest else REJECT_NEWEST,
-        cache=cache,
-        clock=_PlainTick(cfg.tick_s) if cfg.native else clk)
-    queue = inner
-    if cfg.native:
-        shim = (_ShrinkDrainQueue if mutant == "native_drain_shrink"
-                else _NativeQueue)
-        queue = shim(inner, sched)
+    policy = DROP_OLDEST if cfg.drop_oldest else REJECT_NEWEST
+    if cfg.native and cfg.native_shards > 1:
+        per_cap = cfg.capacity // cfg.native_shards
+        inners = [AdmissionQueue(cfg.instances, per_cap,
+                                 policy=policy, cache=cache,
+                                 clock=_PlainTick(cfg.tick_s))
+                  for _ in range(cfg.native_shards)]
+        shim = (_LostRouteShards if mutant == "shard_route_lost"
+                else _ShardedQueue)
+        # the sharded handle IS the terminal-state authority: its
+        # summed counters feed the digest + conservation monitors
+        queue = inner = shim(inners, sched,
+                             cfg.instances // cfg.native_shards)
+    else:
+        inner = AdmissionQueue(
+            cfg.instances, cfg.capacity, policy=policy, cache=cache,
+            clock=_PlainTick(cfg.tick_s) if cfg.native else clk)
+        queue = inner
+        if cfg.native:
+            shim = (_ShrinkDrainQueue if mutant == "native_drain_shrink"
+                    else _NativeQueue)
+            queue = shim(inner, sched)
     micro = MicroBatcher(queue, ShapeLadder(rungs=(cfg.target_votes,)),
                          target_votes=cfg.target_votes,
                          max_delay_s=0.0, clock=clk)
@@ -915,6 +1043,19 @@ def run_once(cfg: SchedConfig, mutant: Optional[str] = None,
             "raw_drainers requires native=True: only the internally-"
             "synchronized native handle documents concurrent drains; "
             "the Python queue's contract is the _admission lock")
+    if cfg.raw_submitters and not cfg.native:
+        raise ValueError(
+            "raw_submitters requires native=True: only the "
+            "internally-synchronized native handle documents "
+            "concurrent submits (ISSUE 20 shard fan-in); the Python "
+            "queue's contract is the _admission lock")
+    if cfg.native_shards > 1 and (
+            not cfg.native or cfg.instances % cfg.native_shards
+            or cfg.capacity % cfg.native_shards):
+        raise ValueError(
+            "native_shards > 1 requires native=True and instances/"
+            "capacity divisible by the shard count (the real handle's "
+            "fail-closed construction screens)")
     sched = Scheduler(forced=forced,
                       preemption_bound=cfg.preemption_bound,
                       max_steps=cfg.max_steps)
@@ -939,6 +1080,13 @@ def run_once(cfg: SchedConfig, mutant: Optional[str] = None,
                         sys_.accepted += 1
             return produce
 
+        def make_submitter(i: int):
+            def subloop():
+                for b in range(cfg.submit_blobs):
+                    sys_.svc.queue.submit(
+                        _blob(cfg, 211 * (i + 1) + b))
+            return subloop
+
         def make_drainer(i: int):
             def drainloop():
                 total = 0
@@ -952,6 +1100,9 @@ def run_once(cfg: SchedConfig, mutant: Optional[str] = None,
         prods = [sched.thread_factory(target=make(p),
                                       name=f"producer-{p}")
                  for p in range(cfg.producers)]
+        prods += [sched.thread_factory(target=make_submitter(i),
+                                       name=f"submitter-{i}")
+                  for i in range(cfg.raw_submitters)]
         prods += [sched.thread_factory(target=make_drainer(i),
                                        name=f"drainer-{i}")
                   for i in range(cfg.raw_drainers)]
@@ -986,6 +1137,13 @@ def run_once(cfg: SchedConfig, mutant: Optional[str] = None,
                 "conservation",
                 f"producer-accepted {sys_.accepted} != enqueued "
                 f"{inbox.enqueued}", sched.steps))
+        if isinstance(q, _ShardedQueue) \
+                and q.records_in != q.counters["submitted"]:
+            res.violations.append(Violation(
+                "conservation",
+                f"fan-in records {q.records_in} != sharded submitted "
+                f"{q.counters['submitted']} (records lost or "
+                f"duplicated in shard routing)", sched.steps))
         claimed = svc.votes_drained + sum(sys_.raw_drained)
         if claimed != q.counters["drained"]:
             res.violations.append(Violation(
@@ -1095,7 +1253,7 @@ def explore(cfg: SchedConfig, mutant: Optional[str] = None, *,
 
 
 # ---------------------------------------------------------------------------
-# Mutants: the three shipped races, resurrected
+# Mutants: shipped (or review-caught) races, resurrected
 # ---------------------------------------------------------------------------
 
 #: name -> (config, expected violation kinds, description)
@@ -1116,6 +1274,15 @@ MUTANTS: Dict[str, Tuple[SchedConfig, Tuple[str, ...], str]] = {
         "PR 14 review-fix: drain sized batches from an unlocked "
         "pre-call depth read; a concurrent drain shrinks the queue "
         "inside the GIL-release gap -> phantom uninitialized rows"),
+    "shard_route_lost": (
+        SchedConfig("mut_shard_route", producers=0, records=2,
+                    native=True, native_shards=2, raw_submitters=2,
+                    polls=0, preemption_bound=2),
+        ("conservation",),
+        "ISSUE 20 pre-review fan-in: the routing scratch lived on the "
+        "shard-group handle (shared) and the fan-out ran as a second "
+        "native span — a concurrent submit clobbers/consumes the "
+        "route inside the gap and records never reach any shard"),
     "busy_frac_inflight": (
         SchedConfig("mut_busy", producers=1, blobs=2, records=2,
                     polls=4, gauge_interval_s=0.02, clock_dep=True,

@@ -898,6 +898,67 @@ class VoteBatcher:
             sig=jnp.asarray(sig), blocks=jnp.asarray(blocks))
         return phases, dense
 
+    def adopt_native_phases(self, cols, ph, pubkeys: np.ndarray):
+        """Adopt a NATIVE phase drain (ISSUE 20 zero-copy densify):
+        `cols` is the drained WireColumns batch and `ph` the
+        NativePhases bundle core/native/admission_phases.cpp filled for
+        it — the exact arrays build_phases_device would have produced
+        for these rows against this window (the native side bails to a
+        plain drain on ANY case where the Python build would drop,
+        split, intern or multi-phase, so adoption is only ever offered
+        for the no-op-screen single-round fast path).  Returns
+        (phases, SignedLanes) with every device array wrapped by ONE
+        jnp.asarray — no per-record Python work.
+
+        What this method still owes the Python build, per-batch not
+        per-record:
+
+        * the evidence log entry — the ARRIVAL-order batch with the
+          nil encoding normalized, plus the build's pubkey epoch table
+          (device-verify builds log pre-verdict; signed_evidence
+          re-verifies against exactly this table)
+        * last_build_keys — the dedup-cache insertion keys of the real
+          lanes in the build's PHASE-GROUPED cat order (`ph.lane_rows`
+          is the native side's lane -> drained-row permutation)
+
+        The caller (ServePipeline.stage) owns the preconditions: no
+        other pending votes (the build must drain exactly `cols`), and
+        ph.heights/base_round equal to the batcher's post-sync window
+        (native_phase_state predicted it; a rotation between drain and
+        stage falls back to add_arrays on the plain columns)."""
+        value = cols.value
+        if (value < _NIL).any():
+            value = np.where(value < 0, _NIL, value)
+        b = _Batch(cols.instance, cols.validator, cols.height,
+                   cols.round_, cols.typ, value, cols.signatures,
+                   np.asarray(cols.verified, bool), cols.digest)
+        pk = np.asarray(pubkeys)
+        self._log.append(b)
+        self._log_pk.append(pk)
+        rows = ph.lane_rows
+        if cols.digest is not None:
+            self.last_build_keys = (cols.digest[rows],
+                                    cols.instance[rows],
+                                    cols.height[rows])
+        else:
+            self.last_build_keys = None
+        hts = jnp.asarray(self.heights.astype(np.int32))
+        phases = [(VotePhase(
+            round=jnp.full(self.I, int(ph.round_), jnp.int32),
+            typ=jnp.full(self.I, int(ph.typ[p]), jnp.int32),
+            slots=jnp.asarray(ph.slots[p]),
+            mask=jnp.asarray(ph.mask[p]),
+            height=hts), int(ph.counts[p]))
+            for p in range(ph.n_phases)]
+        from agnes_tpu.device.step import SignedLanes
+        lanes = SignedLanes(
+            pub=jnp.asarray(ph.pub), sig=jnp.asarray(ph.sig),
+            blocks=jnp.asarray(ph.blocks),
+            phase_idx=jnp.asarray(ph.phase_idx),
+            inst=jnp.asarray(ph.inst), val=jnp.asarray(ph.val),
+            real=jnp.asarray(ph.real))
+        return phases, lanes
+
     def _intern_and_spill(self, b: _Batch, layer: Optional[np.ndarray] = None):
         """Intern slots; votes whose value overflows the instance's
         slot budget spill to the HOST tally (SlotMap's documented

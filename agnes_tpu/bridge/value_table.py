@@ -59,6 +59,14 @@ class SlotMap:
         self._maps: List[Dict[int, int]] = [dict()
                                             for _ in range(n_instances)]
         self.overflowed: int = 0
+        # dense [I, S] slot -> value-id export (-1 = unallocated),
+        # maintained incrementally so the native densify drain
+        # (ISSUE 20) can scan it by POINTER — value ids are 31-bit
+        # non-negative, so -1 is a safe sentinel.  numpy is imported
+        # lazily to keep this module's import surface unchanged for
+        # the table-only users.
+        import numpy as _np
+        self.dense = _np.full((n_instances, n_slots), -1, _np.int64)
 
     def slot_for(self, instance: int, value_id: int) -> Optional[int]:
         m = self._maps[instance]
@@ -70,6 +78,7 @@ class SlotMap:
             return None
         slot = len(m)
         m[value_id] = slot
+        self.dense[instance, slot] = value_id
         return slot
 
     def prealloc(self, instance: int, value_id: int) -> None:
@@ -80,6 +89,7 @@ class SlotMap:
         per-vote accounting."""
         m = self._maps[instance]
         if value_id not in m and len(m) < self.n_slots:
+            self.dense[instance, len(m)] = value_id
             m[value_id] = len(m)
 
     def value_for(self, instance: int, slot: int) -> Optional[int]:
@@ -91,3 +101,4 @@ class SlotMap:
     def reset_instance(self, instance: int) -> None:
         """Free an instance's slots (height advance)."""
         self._maps[instance].clear()
+        self.dense[instance, :] = -1

@@ -22,6 +22,11 @@
 //                    PoP / quarantine) for BlsClassTable.fold; the
 //                    on-curve share decode stays with the oracle
 //
+// ISSUE 20 split the queue internals into admission.hpp so the shard
+// group (admission_shards.cpp) and the zero-copy densify drain
+// (admission_phases.cpp) share the exact submit/drain arithmetic; the
+// single-queue C ABI lives here unchanged.
+//
 // Semantics are a LEAF-FOR-LEAF port of AdmissionQueue.submit/drain
 // (reject taxonomy, counter names and ordering, eviction math, digest
 // bytes) — the admission model checker (PR 7) specifies the behavior,
@@ -35,6 +40,7 @@
 // a native queue, keeping the GIL-release span lock-free (the LOCK005
 // rule in analysis/lockcheck.py polices the inverse nesting).
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -43,50 +49,11 @@
 #include <mutex>
 #include <vector>
 
+#include "admission.hpp"
 #include "sha512.hpp"
 
-namespace {
+namespace agnes_adm {
 
-constexpr int kRecSize = 96;       // the packed Ed25519 wire record
-constexpr int kBlsRecSize = 224;   // 32B header + 192B G2 share
-
-struct NRec {
-  uint8_t raw[kRecSize];
-  uint8_t digest[32];
-  double ts;                       // admission instant (caller clock)
-  int64_t seq;                     // submit id (mark_verified target)
-  uint8_t verified;                // dedup-cache pre-verified flag
-};
-
-struct AdmQ {
-  int64_t I, capacity, instance_cap;
-  int32_t policy;                  // 0 reject_newest, 1 drop_oldest
-  bool digests;                    // hash admitted records (cache on)
-
-  std::mutex mu;
-  std::deque<NRec> q;
-  std::vector<int64_t> inst_counts;   // [I] queue occupancy
-  // per-submit rank scratch, epoch-stamped so a submit never pays an
-  // O(I) clear (the ingest.cpp cell_epoch idiom)
-  std::vector<int64_t> seen;
-  std::vector<uint64_t> seen_epoch;
-  uint64_t epoch = 0;
-  int64_t next_seq = 0;
-
-  // counters, AdmissionQueue.counters order:
-  // [submitted, admitted, rejected_overflow, rejected_fairness,
-  //  rejected_malformed, evicted, drained]
-  int64_t counters[7] = {0, 0, 0, 0, 0, 0, 0};
-};
-
-inline int64_t rec_instance(const uint8_t* p) {
-  uint32_t u32;
-  std::memcpy(&u32, p, 4);
-  return static_cast<int64_t>(u32);
-}
-
-// pop the n oldest records (n <= q.size()), updating occupancy; the
-// Python _pop's count_drained flag is the caller's job
 void pop_front(AdmQ* A, int64_t n) {
   for (int64_t k = 0; k < n; ++k) {
     A->inst_counts[static_cast<size_t>(rec_instance(A->q.front().raw))]--;
@@ -94,7 +61,158 @@ void pop_front(AdmQ* A, int64_t n) {
   }
 }
 
-}  // namespace
+int64_t submit_records(AdmQ* A, const uint8_t* buf,
+                       const int64_t* rec_idx, int64_t n_rec,
+                       int64_t tail_malformed, int64_t seq,
+                       int64_t* out_counts, uint8_t* out_digests,
+                       uint8_t* out_kept) {
+  std::lock_guard<std::mutex> g(A->mu);
+  if (seq < 0) seq = ++A->next_seq;
+  A->counters[0] += n_rec + tail_malformed;
+  int64_t malformed = tail_malformed;
+  if (out_kept) std::memset(out_kept, 0, static_cast<size_t>(n_rec));
+  if (n_rec == 0) {
+    A->counters[4] += malformed;
+    out_counts[0] = 0; out_counts[1] = 0; out_counts[2] = 0;
+    out_counts[3] = malformed; out_counts[4] = 0;
+    return seq;
+  }
+
+  // instance-range screen + fairness: occupancy-so-far + rank within
+  // this submit < cap (the rank counts every malformed-surviving
+  // record of the instance, matching queue._cumcount over inst_k)
+  ++A->epoch;
+  std::vector<int64_t> keep;   // positions into rec_idx, ascending
+  keep.reserve(static_cast<size_t>(n_rec));
+  int64_t rejected_fairness = 0;
+  for (int64_t j = 0; j < n_rec; ++j) {
+    const int64_t k = rec_idx ? rec_idx[j] : j;
+    const int64_t inst = rec_instance(buf + k * kRecSize);
+    if (inst >= A->I) {
+      ++malformed;
+      continue;
+    }
+    const size_t i = static_cast<size_t>(inst);
+    if (A->seen_epoch[i] != A->epoch) {
+      A->seen_epoch[i] = A->epoch;
+      A->seen[i] = 0;
+    }
+    const int64_t occ = A->inst_counts[i] + A->seen[i]++;
+    if (occ >= A->instance_cap)
+      ++rejected_fairness;
+    else
+      keep.push_back(j);
+  }
+
+  // capacity / overload policy (the exact queue.submit arithmetic)
+  int64_t rejected_overflow = 0;
+  int64_t evicted = 0;
+  const int64_t depth = static_cast<int64_t>(A->q.size());
+  const int64_t room = A->capacity - depth;
+  if (static_cast<int64_t>(keep.size()) > room) {
+    if (A->policy == 0) {                       // reject-newest
+      const int64_t hold = room > 0 ? room : 0;
+      rejected_overflow = static_cast<int64_t>(keep.size()) - hold;
+      keep.resize(static_cast<size_t>(hold));
+    } else {                                    // drop-oldest
+      if (static_cast<int64_t>(keep.size()) > A->capacity) {
+        rejected_overflow =
+            static_cast<int64_t>(keep.size()) - A->capacity;
+        keep.erase(keep.begin(),
+                   keep.end() - static_cast<size_t>(A->capacity));
+      }
+      const int64_t over =
+          static_cast<int64_t>(keep.size()) - (A->capacity - depth);
+      evicted = depth < over ? depth : over;
+      if (evicted > 0) {
+        pop_front(A, evicted);                  // never counts drained
+        A->counters[5] += evicted;
+      }
+    }
+  }
+
+  // enqueue at the sorted (seq, sub_idx) position: a plain push_back
+  // in the single-queue / unraced case, a mid-deque splice only when
+  // the shard group's atomic handed a racing submit a smaller seq
+  // after a larger one already landed here (see admission.hpp)
+  const int64_t accepted = static_cast<int64_t>(keep.size());
+  auto ins = A->q.end();
+  if (!A->q.empty() && A->q.back().seq > seq)
+    ins = std::upper_bound(
+        A->q.begin(), A->q.end(), seq,
+        [](int64_t s, const NRec& r) { return s < r.seq; });
+  for (size_t j = 0; j < keep.size(); ++j) {
+    const int64_t k = rec_idx ? rec_idx[keep[j]] : keep[j];
+    NRec r;
+    std::memcpy(r.raw, buf + k * kRecSize, kRecSize);
+    if (A->digests) {
+      // digest of the RAW record bytes — the "these exact bytes were
+      // device-verified" key (queue._record_digests)
+      agnes::sha256(r.raw, kRecSize, r.digest);
+      if (out_digests)
+        std::memcpy(out_digests + 32 * j, r.digest, 32);
+    } else {
+      std::memset(r.digest, 0, 32);
+    }
+    // NaN until ag_adm_set_chunk_ts stamps it: a concurrent drain
+    // popping the record in that gap must be able to TELL it is
+    // unstamped (the wrapper substitutes its own clock) — a 0.0
+    // sentinel would read as epoch-scale admission wait and pin the
+    // latency histograms' p99 at hours
+    r.ts = std::numeric_limits<double>::quiet_NaN();
+    r.seq = seq;
+    r.sub_idx = k;
+    r.verified = 0;
+    ins = A->q.insert(ins, r);
+    ++ins;
+    A->inst_counts[static_cast<size_t>(rec_instance(r.raw))]++;
+    if (out_kept) out_kept[keep[j]] = 1;
+  }
+
+  A->counters[1] += accepted;
+  A->counters[2] += rejected_overflow;
+  A->counters[3] += rejected_fairness;
+  A->counters[4] += malformed;
+  out_counts[0] = accepted;
+  out_counts[1] = rejected_overflow;
+  out_counts[2] = rejected_fairness;
+  out_counts[3] = malformed;
+  out_counts[4] = evicted;
+  return seq;
+}
+
+void set_chunk_ts_core(AdmQ* A, int64_t seq, double ts) {
+  std::lock_guard<std::mutex> g(A->mu);
+  for (auto it = A->q.rbegin(); it != A->q.rend(); ++it) {
+    if (it->seq > seq) continue;
+    if (it->seq < seq) break;
+    it->ts = ts;
+  }
+}
+
+void mark_verified_core(AdmQ* A, int64_t seq, const uint8_t* ver,
+                        int64_t n) {
+  std::lock_guard<std::mutex> g(A->mu);
+  int64_t j = n - 1;
+  for (auto it = A->q.rbegin(); it != A->q.rend() && j >= 0; ++it) {
+    if (it->seq > seq) continue;      // a later submit's records
+    if (it->seq < seq) break;         // past the target (FIFO order)
+    it->verified = ver[j--] ? 1 : 0;
+  }
+}
+
+double min_stamped_ts(AdmQ* A) {
+  std::lock_guard<std::mutex> g(A->mu);
+  double best = std::numeric_limits<double>::quiet_NaN();
+  for (const NRec& r : A->q)
+    if (!std::isnan(r.ts) && (std::isnan(best) || r.ts < best))
+      best = r.ts;
+  return best;
+}
+
+}  // namespace agnes_adm
+
+using namespace agnes_adm;
 
 extern "C" {
 
@@ -135,104 +253,8 @@ int64_t ag_adm_submit(void* h, const uint8_t* buf, int64_t nbytes,
   auto* A = static_cast<AdmQ*>(h);
   const int64_t n_whole = nbytes / kRecSize;
   const int64_t tail = (nbytes % kRecSize) ? 1 : 0;
-  std::lock_guard<std::mutex> g(A->mu);
-  const int64_t seq = ++A->next_seq;
-  A->counters[0] += n_whole + tail;
-  int64_t malformed = tail;
-  if (n_whole == 0) {
-    A->counters[4] += malformed;
-    out_counts[0] = 0; out_counts[1] = 0; out_counts[2] = 0;
-    out_counts[3] = malformed; out_counts[4] = 0;
-    return seq;
-  }
-
-  // instance-range screen + fairness: occupancy-so-far + rank within
-  // this submit < cap (the rank counts every malformed-surviving
-  // record of the instance, matching queue._cumcount over inst_k)
-  ++A->epoch;
-  std::vector<int64_t> keep;
-  keep.reserve(static_cast<size_t>(n_whole));
-  int64_t rejected_fairness = 0;
-  for (int64_t k = 0; k < n_whole; ++k) {
-    const int64_t inst = rec_instance(buf + k * kRecSize);
-    if (inst >= A->I) {
-      ++malformed;
-      continue;
-    }
-    const size_t i = static_cast<size_t>(inst);
-    if (A->seen_epoch[i] != A->epoch) {
-      A->seen_epoch[i] = A->epoch;
-      A->seen[i] = 0;
-    }
-    const int64_t occ = A->inst_counts[i] + A->seen[i]++;
-    if (occ >= A->instance_cap)
-      ++rejected_fairness;
-    else
-      keep.push_back(k);
-  }
-
-  // capacity / overload policy (the exact queue.submit arithmetic)
-  int64_t rejected_overflow = 0;
-  int64_t evicted = 0;
-  const int64_t depth = static_cast<int64_t>(A->q.size());
-  const int64_t room = A->capacity - depth;
-  if (static_cast<int64_t>(keep.size()) > room) {
-    if (A->policy == 0) {                       // reject-newest
-      const int64_t hold = room > 0 ? room : 0;
-      rejected_overflow = static_cast<int64_t>(keep.size()) - hold;
-      keep.resize(static_cast<size_t>(hold));
-    } else {                                    // drop-oldest
-      if (static_cast<int64_t>(keep.size()) > A->capacity) {
-        rejected_overflow =
-            static_cast<int64_t>(keep.size()) - A->capacity;
-        keep.erase(keep.begin(),
-                   keep.end() - static_cast<size_t>(A->capacity));
-      }
-      const int64_t over =
-          static_cast<int64_t>(keep.size()) - (A->capacity - depth);
-      evicted = depth < over ? depth : over;
-      if (evicted > 0) {
-        pop_front(A, evicted);                  // never counts drained
-        A->counters[5] += evicted;
-      }
-    }
-  }
-
-  const int64_t accepted = static_cast<int64_t>(keep.size());
-  for (size_t j = 0; j < keep.size(); ++j) {
-    NRec r;
-    std::memcpy(r.raw, buf + keep[j] * kRecSize, kRecSize);
-    if (A->digests) {
-      // digest of the RAW record bytes — the "these exact bytes were
-      // device-verified" key (queue._record_digests)
-      agnes::sha256(r.raw, kRecSize, r.digest);
-      if (out_digests)
-        std::memcpy(out_digests + 32 * j, r.digest, 32);
-    } else {
-      std::memset(r.digest, 0, 32);
-    }
-    // NaN until ag_adm_set_chunk_ts stamps it: a concurrent drain
-    // popping the record in that gap must be able to TELL it is
-    // unstamped (the wrapper substitutes its own clock) — a 0.0
-    // sentinel would read as epoch-scale admission wait and pin the
-    // latency histograms' p99 at hours
-    r.ts = std::numeric_limits<double>::quiet_NaN();
-    r.seq = seq;
-    r.verified = 0;
-    A->q.push_back(r);
-    A->inst_counts[static_cast<size_t>(rec_instance(r.raw))]++;
-  }
-
-  A->counters[1] += accepted;
-  A->counters[2] += rejected_overflow;
-  A->counters[3] += rejected_fairness;
-  A->counters[4] += malformed;
-  out_counts[0] = accepted;
-  out_counts[1] = rejected_overflow;
-  out_counts[2] = rejected_fairness;
-  out_counts[3] = malformed;
-  out_counts[4] = evicted;
-  return seq;
+  return submit_records(A, buf, nullptr, n_whole, tail, -1, out_counts,
+                        out_digests, nullptr);
 }
 
 // stamp submit `seq`'s accepted records with their admission instant.
@@ -244,13 +266,7 @@ int64_t ag_adm_submit(void* h, const uint8_t* buf, int64_t nbytes,
 // the wrapper's drain replaces with its own clock (only reachable
 // under a concurrent drain).
 void ag_adm_set_chunk_ts(void* h, int64_t seq, double ts) {
-  auto* A = static_cast<AdmQ*>(h);
-  std::lock_guard<std::mutex> g(A->mu);
-  for (auto it = A->q.rbegin(); it != A->q.rend(); ++it) {
-    if (it->seq > seq) continue;
-    if (it->seq < seq) break;
-    it->ts = ts;
-  }
+  set_chunk_ts_core(static_cast<AdmQ*>(h), seq, ts);
 }
 
 // flag submit `seq`'s accepted records as dedup-cache hits.  `ver` is
@@ -262,14 +278,7 @@ void ag_adm_set_chunk_ts(void* h, int64_t seq, double ts) {
 // ver[n-1].
 void ag_adm_mark_verified(void* h, int64_t seq, const uint8_t* ver,
                           int64_t n) {
-  auto* A = static_cast<AdmQ*>(h);
-  std::lock_guard<std::mutex> g(A->mu);
-  int64_t j = n - 1;
-  for (auto it = A->q.rbegin(); it != A->q.rend() && j >= 0; ++it) {
-    if (it->seq > seq) continue;      // a later submit's records
-    if (it->seq < seq) break;         // past the target (FIFO order)
-    it->verified = ver[j--] ? 1 : 0;
-  }
+  mark_verified_core(static_cast<AdmQ*>(h), seq, ver, n);
 }
 
 int64_t ag_adm_depth(void* h) {
@@ -285,12 +294,15 @@ int64_t ag_adm_instance_depth(void* h, int64_t i) {
   return A->inst_counts[static_cast<size_t>(i)];
 }
 
-// admission instant of the oldest queued record; NaN when empty
+// admission instant of the oldest STAMPED record; NaN when empty or
+// when nothing queued is stamped yet.  ISSUE 20 fix: the front record
+// can transiently carry the NaN sentinel while deeper records are
+// stamped (submit enqueues, THEN stamps; a racing drain can observe
+// the gap), and the old front-only read handed that NaN to
+// MicroBatcher's deadline close.  A guarded min over the live records
+// can never surface a transient NaN while stamped work is waiting.
 double ag_adm_oldest_ts(void* h) {
-  auto* A = static_cast<AdmQ*>(h);
-  std::lock_guard<std::mutex> g(A->mu);
-  if (A->q.empty()) return std::numeric_limits<double>::quiet_NaN();
-  return A->q.front().ts;
+  return min_stamped_ts(static_cast<AdmQ*>(h));
 }
 
 void ag_adm_counters(void* h, int64_t* out7) {
@@ -325,29 +337,9 @@ int64_t ag_adm_drain(void* h, int64_t n, int64_t* inst, int64_t* val,
     n = static_cast<int64_t>(A->q.size());
   for (int64_t k = 0; k < n; ++k) {
     const NRec& r = A->q.front();
-    const uint8_t* p = r.raw;
-    uint32_t u32;
-    std::memcpy(&u32, p + 0, 4);
-    inst[k] = u32;
-    A->inst_counts[static_cast<size_t>(u32)]--;
-    std::memcpy(&u32, p + 4, 4);
-    val[k] = u32;
-    std::memcpy(&hts[k], p + 8, 8);
-    int32_t i32;
-    std::memcpy(&i32, p + 16, 4);
-    rnd[k] = i32;
-    typ[k] = p[20];
-    // nil flag: ANY nonzero byte is non-nil (unpack_wire_votes'
-    // `rec[:, 21] != 0` — not bit0; a hostile flag byte of 2 must
-    // drain identically on both implementations)
-    if (p[21])
-      std::memcpy(&value[k], p + 24, 8);
-    else
-      value[k] = -1;
-    std::memcpy(sigs + 64 * k, p + 32, 64);
-    ver[k] = r.verified;
-    if (out_dig) std::memcpy(out_dig + 32 * k, r.digest, 32);
-    ts[k] = r.ts;
+    parse_record(r, k, inst, val, hts, rnd, typ, value, sigs, ver,
+                 out_dig, ts);
+    A->inst_counts[static_cast<size_t>(rec_instance(r.raw))]--;
     A->q.pop_front();
   }
   A->counters[6] += n;

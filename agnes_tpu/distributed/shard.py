@@ -57,7 +57,8 @@ from agnes_tpu.utils.metrics import POD_FOREIGN_REJECTS  # noqa: F401
 class HostShard:
     """Per-host serve front-end (module docstring).  `driver` must be
     a DistributedDriver; `service_kwargs` forward to VoteService
-    (dedup_cache, bls_lane, native_admission, metrics, flightrec,
+    (dedup_cache, bls_lane, native_admission, native_shards, metrics,
+    flightrec,
     window_predictor, target_votes ... — the full single-host
     surface)."""
 

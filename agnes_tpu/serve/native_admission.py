@@ -52,6 +52,7 @@ from agnes_tpu.core.native_build import lib as _build_lib
 from agnes_tpu.serve.queue import (
     AdmitResult,
     DROP_OLDEST,
+    NativePhases,
     REJECT_NEWEST,
     WireColumns,
 )
@@ -94,6 +95,50 @@ def _lib() -> ctypes.CDLL:
                                         c.c_int64, c.c_int64,
                                         c.c_void_p, c.c_void_p,
                                         c.c_void_p]
+        # zero-copy densify drain (ISSUE 20): handle, n, 10 column
+        # pointers, then the PhaseBuildState scalars/pointers, then the
+        # 13 phase/lane output pointers
+        _phase_args = ([c.c_void_p, c.c_int64] + [c.c_void_p] * 10
+                       + [c.c_void_p, c.c_void_p, c.c_int64, c.c_void_p,
+                          c.c_int64, c.c_int64, c.c_void_p, c.c_int64,
+                          c.c_int64, c.c_int64, c.c_int64]
+                       + [c.c_void_p] * 13)
+        L.ag_adm_drain_phases.restype = c.c_int64
+        L.ag_adm_drain_phases.argtypes = _phase_args
+        # sharded group (ISSUE 20): the ag_adm_* twins under ag_adms_
+        L.ag_adms_new.restype = c.c_void_p
+        L.ag_adms_new.argtypes = [c.c_int64, c.c_int64, c.c_int64,
+                                  c.c_int64, c.c_int32, c.c_int32]
+        L.ag_adms_free.argtypes = [c.c_void_p]
+        L.ag_adms_n_shards.restype = c.c_int64
+        L.ag_adms_n_shards.argtypes = [c.c_void_p]
+        L.ag_adms_submit.restype = c.c_int64
+        L.ag_adms_submit.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+                                     c.c_void_p, c.c_void_p]
+        L.ag_adms_set_chunk_ts.argtypes = [c.c_void_p, c.c_int64,
+                                           c.c_double]
+        L.ag_adms_mark_verified.argtypes = [c.c_void_p, c.c_int64,
+                                            c.c_char_p, c.c_int64]
+        L.ag_adms_depth.restype = c.c_int64
+        L.ag_adms_depth.argtypes = [c.c_void_p]
+        L.ag_adms_shard_depth.restype = c.c_int64
+        L.ag_adms_shard_depth.argtypes = [c.c_void_p, c.c_int64]
+        L.ag_adms_instance_depth.restype = c.c_int64
+        L.ag_adms_instance_depth.argtypes = [c.c_void_p, c.c_int64]
+        L.ag_adms_oldest_ts.restype = c.c_double
+        L.ag_adms_oldest_ts.argtypes = [c.c_void_p]
+        L.ag_adms_counters.argtypes = [c.c_void_p, c.c_void_p]
+        L.ag_adms_shard_counters.argtypes = [c.c_void_p, c.c_int64,
+                                             c.c_void_p]
+        L.ag_adms_add_counters.argtypes = [c.c_void_p, c.c_void_p]
+        L.ag_adms_drain.restype = c.c_int64
+        L.ag_adms_drain.argtypes = [c.c_void_p, c.c_int64] + \
+            [c.c_void_p] * 10
+        L.ag_adms_drain_phases.restype = c.c_int64
+        L.ag_adms_drain_phases.argtypes = _phase_args
+        L.ag_adms_export.restype = c.c_int64
+        L.ag_adms_export.argtypes = [c.c_void_p, c.c_void_p,
+                                     c.c_void_p, c.c_int64]
         _configured = True
     return L
 
@@ -121,6 +166,164 @@ def bls_screen(wire_bytes, n_instances: int, n_validators: int,
         raw, len(raw), int(n_instances), int(n_validators),
         pop.ctypes.data, quar.ctypes.data, codes.ctypes.data)
     return codes[:got]
+
+
+def _native_drain(q, drain_fn, phases_fn, max_records):
+    """The shared drain body of NativeAdmissionQueue and
+    NativeAdmissionShards (`q` supplies _h/I/cache/_clock and the
+    histogram/hook attributes; the fns are the handle-flavored C entry
+    points).
+
+    Plain path: pop up to `max_records` oldest records, densified to
+    the WireColumns arrays in ONE GIL-releasing native call (None when
+    empty).  The batch is sized from the native call's RETURN value,
+    not the pre-read depth — the queue may shrink between the two
+    under concurrent drains.
+
+    Phases path (ISSUE 20): when the pipeline wired a `phase_state`
+    hook and it yields a PhaseBuildState, the same single call ALSO
+    fills the padded device-build arrays (slot/mask planes + signed
+    lanes) when the rows are device-verify eligible; the batch then
+    carries a NativePhases bundle and the pipeline skips add_arrays
+    entirely.  Ineligible rows (multi-round, held/past, stale,
+    pre-verified, uninterned value, ...) fill only the plain columns —
+    the Python path owns every screen and split, so the dispatch
+    stream is leaf-identical either way.
+
+    Wait-histogram recording keeps the Python queue's chunk
+    granularity: records of one submit share one admission instant, so
+    the run-length groups of the ts column ARE the chunks (two submits
+    stamped with an identical coarse-clock value merge into one
+    record() call — histogram contents identical, invocation count
+    not)."""
+    n = q.depth
+    if n == 0:
+        return None
+    if max_records is not None:
+        n = min(n, int(max_records))
+        if n <= 0:
+            # zero/negative cap: None, matching AdmissionQueue
+            # (np.empty(n < 0) would raise; the C side clamps >= 0)
+            return None
+    st = None
+    if phases_fn is not None and q.phase_state is not None:
+        st = q.phase_state()
+        if st is not None and n > int(st.max_votes):
+            # the batcher would _defer_pending-split this batch: let
+            # the Python path own the split (and skip the plane
+            # allocation for a build that must bail)
+            st = None
+    inst = np.empty(n, np.int64)
+    val = np.empty(n, np.int64)
+    hts = np.empty(n, np.int64)
+    rnd = np.empty(n, np.int64)
+    typ = np.empty(n, np.int64)
+    value = np.empty(n, np.int64)
+    sigs = np.empty((n, 64), np.uint8)
+    ver = np.empty(n, np.uint8)
+    dig = (np.empty((n, 32), np.uint8)
+           if q.cache is not None else None)
+    ts = np.empty(n, np.float64)
+    cols = (inst.ctypes.data, val.ctypes.data, hts.ctypes.data,
+            rnd.ctypes.data, typ.ctypes.data, value.ctypes.data,
+            sigs.ctypes.data, ver.ctypes.data,
+            dig.ctypes.data if dig is not None else None,
+            ts.ctypes.data)
+    ph = None
+    t0 = time.perf_counter()
+    if st is None:
+        got = int(drain_fn(q._h, n, *cols))
+    else:
+        I = q.I
+        S = int(st.slot_lut.shape[1])
+        V = int(st.n_validators)
+        pad_cap = 1
+        while pad_cap < n:
+            pad_cap <<= 1
+        pad_cap = max(pad_cap, int(st.lane_floor))
+        ph_slots = np.empty((2, I, V), np.int32)
+        ph_mask = np.empty((2, I, V), np.bool_)
+        ph_typ = np.empty(2, np.int64)
+        ph_counts = np.empty(2, np.int64)
+        l_pub = np.empty((pad_cap, 32), np.int32)
+        l_sig = np.empty((pad_cap, 64), np.int32)
+        l_blocks = np.empty((pad_cap, 32), np.uint32)
+        l_pidx = np.empty(pad_cap, np.int32)
+        l_inst = np.empty(pad_cap, np.int32)
+        l_val = np.empty(pad_cap, np.int32)
+        l_real = np.empty(pad_cap, np.bool_)
+        l_rows = np.empty(n, np.int64)
+        meta = np.zeros(5, np.int64)
+        win_h = np.ascontiguousarray(st.heights, np.int64)
+        win_b = np.ascontiguousarray(st.base_round, np.int64)
+        lut = np.ascontiguousarray(st.slot_lut, np.int64)
+        pk = np.ascontiguousarray(st.pubkeys, np.uint8)
+        got = int(phases_fn(
+            q._h, n, *cols, win_h.ctypes.data, win_b.ctypes.data,
+            int(st.window), lut.ctypes.data, S, V, pk.ctypes.data,
+            int(st.lane_floor), int(st.max_votes),
+            int(st.phase_offset), pad_cap, ph_slots.ctypes.data,
+            ph_mask.ctypes.data, ph_typ.ctypes.data,
+            ph_counts.ctypes.data, l_pub.ctypes.data,
+            l_sig.ctypes.data, l_blocks.ctypes.data,
+            l_pidx.ctypes.data, l_inst.ctypes.data, l_val.ctypes.data,
+            l_real.ctypes.data, l_rows.ctypes.data, meta.ctypes.data))
+        if meta[0] == 1:
+            n_ph, n_ln, n_pad = int(meta[1]), int(meta[2]), int(meta[3])
+            ph = NativePhases(
+                n_phases=n_ph, n_lanes=n_ln, n_pad=n_pad,
+                round_=int(meta[4]), typ=ph_typ[:n_ph],
+                counts=ph_counts[:n_ph], slots=ph_slots[:n_ph],
+                mask=ph_mask[:n_ph], pub=l_pub[:n_pad],
+                sig=l_sig[:n_pad],
+                blocks=l_blocks[:n_pad].reshape(n_pad, 1, 32),
+                phase_idx=l_pidx[:n_pad], inst=l_inst[:n_pad],
+                val=l_val[:n_pad], real=l_real[:n_pad],
+                lane_rows=l_rows[:n_ln],
+                heights=win_h, base_round=win_b)
+            q.phase_fill += 1
+        else:
+            q.phase_bail += 1
+    wall = time.perf_counter() - t0
+    # the C side clamps n to the LIVE queue size under its mutex —
+    # a concurrent drain (or anything else shrinking the queue)
+    # between the unlocked depth read above and the native call
+    # means rows past `got` are uninitialized np.empty memory and
+    # must never reach VoteBatcher
+    if got == 0:
+        return None
+    if got < n:
+        n = got
+        inst, val, hts, rnd, typ, value, ts = (
+            a[:n] for a in (inst, val, hts, rnd, typ, value, ts))
+        sigs, ver = sigs[:n], ver[:n]
+        if dig is not None:
+            dig = dig[:n]
+    if q.drain_hist is not None:
+        q.drain_hist.record(wall, n)
+    if ph is not None and q.densify_hist is not None:
+        q.densify_hist.record(wall, n)
+    # a record popped between a lock-free submit and its
+    # set_chunk_ts stamp carries NaN — substitute "admitted just
+    # now" so neither the wait histogram nor t_first (and the
+    # batch-close-age histogram downstream of it) ever sees an
+    # epoch-scale outlier.  Never taken single-threaded, so the
+    # fake-clock invocation parity of the differentials holds.
+    nan = np.isnan(ts)
+    if nan.any():
+        ts[nan] = q._clock()
+    if q.wait_hist is not None:
+        # one clock read, and ONLY with a histogram attached —
+        # AdmissionQueue.drain's exact clock discipline
+        now = q._clock()
+        edges = np.flatnonzero(np.diff(ts)) + 1
+        starts = np.concatenate(([0], edges))
+        ends = np.concatenate((edges, [n]))
+        for s, e in zip(starts, ends):
+            q.wait_hist.record(now - ts[s].item(), int(e - s))
+    return WireColumns(inst, val, hts, rnd, typ, value, sigs,
+                       ver.astype(bool), digest=dig,
+                       t_first=ts.min().item(), native_phases=ph)
 
 
 class NativeAdmissionQueue:
@@ -164,6 +367,15 @@ class NativeAdmissionQueue:
         #: drain wall-clock sink (serve_native_drain_wall_s): the
         #: service wires the shared registry's histogram in
         self.drain_hist = None
+        #: zero-copy densify (ISSUE 20): the pipeline wires
+        #: phase_state = ServePipeline.native_phase_state so drain can
+        #: fill the device-build arrays natively; densify_hist is the
+        #: serve_native_densify_wall_s sink.  phase_fill/phase_bail
+        #: count eligible vs bailed-to-Python phase drains.
+        self.phase_state = None
+        self.densify_hist = None
+        self.phase_fill = 0
+        self.phase_bail = 0
         self._clock = clock
         L = _lib()
         self._h = L.ag_adm_new(
@@ -274,14 +486,18 @@ class NativeAdmissionQueue:
 
     @property
     def oldest_ts(self) -> Optional[float]:
-        """Admission instant of the oldest queued record, None when
-        empty — with one documented transient: the front record can be
-        drained-visible between a lock-free submit and its
-        set_chunk_ts stamp, in which case its ts is still NaN and this
-        reads None while depth > 0.  MicroBatcher.poll treats that as
-        "no deadline anchor yet" and just defers the deadline close by
-        one poll; the next read sees the stamp.  Never taken
-        single-threaded, so differentials are unaffected."""
+        """Admission instant of the oldest STAMPED queued record, None
+        when empty or when nothing queued is stamped yet.  ISSUE 20
+        fix for the PR 14 transient: the FRONT record can be unstamped
+        (NaN) between a lock-free submit and its set_chunk_ts call
+        while DEEPER records already carry stamps — the old front-only
+        read handed MicroBatcher.poll a None even though stamped work
+        was past its deadline, deferring the close arbitrarily under a
+        sustained race.  The native side now takes a guarded min over
+        the live records, so a stamped record's deadline is always
+        visible; None still means "no deadline anchor yet", which poll
+        treats as defer-one-poll.  Never taken single-threaded, so
+        differentials are unaffected."""
         v = _lib().ag_adm_oldest_ts(self._h)
         return None if math.isnan(v) else v
 
@@ -302,6 +518,8 @@ class NativeAdmissionQueue:
         """The drain report's native-admission section."""
         out = self.counters
         out["depth"] = self.depth
+        out["phase_fill"] = self.phase_fill
+        out["phase_bail"] = self.phase_bail
         return out
 
     # -- state-space surface -------------------------------------------------
@@ -331,78 +549,259 @@ class NativeAdmissionQueue:
 
     def drain(self, max_records: Optional[int] = None
               ) -> Optional[WireColumns]:
-        """Pop up to `max_records` oldest records, densified to the
-        WireColumns arrays in ONE GIL-releasing native call (None when
-        empty).  The batch is sized from the native call's RETURN
-        value, not the pre-read depth — the queue may shrink between
-        the two under concurrent drains.  Wait-histogram recording
-        keeps the Python queue's chunk granularity: records of one
-        submit share one admission instant, so the run-length groups
-        of the ts column ARE the chunks (two submits stamped with an
-        identical coarse-clock value merge into one record() call —
-        histogram contents identical, invocation count not)."""
+        """Pop up to `max_records` oldest records in ONE GIL-releasing
+        native call — plain WireColumns, or columns + a NativePhases
+        device build when the pipeline wired a phase_state hook and
+        the rows are eligible (see _native_drain)."""
+        L = _lib()
+        return _native_drain(self, L.ag_adm_drain,
+                             L.ag_adm_drain_phases, max_records)
+
+
+class NativeAdmissionShards:
+    """Sharded native ingest (ISSUE 20): N C++ admission shards behind
+    the NativeAdmissionQueue interface — one handle (and one mutex)
+    per shard, instance-range partitioned exactly like
+    distributed/topology.HostPlan (shard s owns instances
+    [s*L, (s+1)*L), L = I / n_shards), with ONE submit fan-in routing
+    each 96-byte record by instance id and a deterministic k-way
+    merged drain (global (seq, sub_idx) order — byte-identical to the
+    single queue's stream whenever the accept decisions agree).
+
+    Per-instance fairness is EXACT at any shard count (the partition
+    key is the fairness key).  Capacity is split evenly across shards
+    (capacity / n_shards each), so aggregate overflow near the ceiling
+    can differ from a single queue when the instance mix is skewed —
+    producers that stay below the per-shard ceiling see identical
+    admission.  Construction therefore requires I % n_shards == 0 and
+    capacity % n_shards == 0 (the C side's fail-closed screens,
+    surfaced here as ValueError).
+
+    One wrapper-contract difference from the single queue: when a
+    dedup cache is attached, mark_verified is called for EVERY
+    accepted submit (hits or not) — the native side holds a per-submit
+    routing vector (global admission order -> owning shard) that the
+    mark consumes."""
+
+    native = True
+
+    def __init__(self, n_instances: int, capacity: int,
+                 instance_cap: Optional[int] = None,
+                 policy: str = REJECT_NEWEST,
+                 cache=None,
+                 bls_table=None,
+                 clock=time.monotonic,
+                 n_shards: int = 1):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive: {capacity}")
+        if policy not in (REJECT_NEWEST, DROP_OLDEST):
+            raise ValueError(f"unknown overload policy: {policy}")
+        self.n_shards = int(n_shards)
+        self.I = int(n_instances)
+        if self.n_shards <= 0:
+            raise ValueError(
+                f"n_shards must be positive: {n_shards}")
+        if self.I % self.n_shards != 0:
+            raise ValueError(
+                f"n_instances={n_instances} not divisible by "
+                f"n_shards={n_shards} (the HostPlan equal-range "
+                f"contract)")
+        self.capacity = int(capacity)
+        if self.capacity % self.n_shards != 0:
+            raise ValueError(
+                f"capacity={capacity} not divisible by "
+                f"n_shards={n_shards}: the per-shard ceiling must be "
+                f"an integer (capacity splits evenly across shards)")
+        self.L = self.I // self.n_shards
+        self.instance_cap = (int(instance_cap)
+                             if instance_cap is not None
+                             else max(1, (2 * self.capacity) // self.I))
+        if self.instance_cap <= 0:
+            raise ValueError(
+                f"instance_cap must be positive: {instance_cap}")
+        self.policy = policy
+        self._digests = cache is not None
+        self._cache = cache
+        self.bls_table = bls_table
+        self.wait_hist = None
+        self.drain_hist = None
+        self.phase_state = None
+        self.densify_hist = None
+        self.phase_fill = 0
+        self.phase_bail = 0
+        self._clock = clock
+        L = _lib()
+        self._h = L.ag_adms_new(
+            self.n_shards, self.I, self.capacity, self.instance_cap,
+            0 if policy == REJECT_NEWEST else 1,
+            1 if cache is not None else 0)
+        if not self._h:
+            raise ValueError(
+                f"invalid admission dimensions: I={n_instances} "
+                f"capacity={capacity} instance_cap={instance_cap} "
+                f"n_shards={n_shards}")
+        self._free = L.ag_adms_free
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._free(self._h)
+            self._h = None
+
+    @property
+    def cache(self):
+        return self._cache
+
+    @cache.setter
+    def cache(self, value) -> None:
+        # same frozen-digest contract as NativeAdmissionQueue.cache
+        if value is not None and not self._digests:
+            raise ValueError(
+                "NativeAdmissionShards cannot attach a dedup cache "
+                "after construction: the native handles were created "
+                "without digest computation (pass cache= to "
+                "__init__)")
+        self._cache = value
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, wire_bytes) -> AdmitResult:
+        """Admit packed wire records through the shard fan-in: route
+        by instance, screen per shard (no shared mutex), gather
+        digests back into global admission order.  Counts are the
+        summed per-shard taxonomy."""
+        raw = wire_bytes if isinstance(wire_bytes, bytes) \
+            else bytes(wire_bytes)
+        n_whole = len(raw) // REC_SIZE
+        counts = np.zeros(5, np.int64)
+        cache = self.cache
+        dig = (np.empty((n_whole, 32), np.uint8)
+               if cache is not None and n_whole else None)
+        seq = _lib().ag_adms_submit(
+            self._h, raw, len(raw), counts.ctypes.data,
+            dig.ctypes.data if dig is not None else None)
+        accepted = int(counts[0])
+        if accepted:
+            # one clock read per ACCEPTED submit (broadcast to every
+            # shard holding records of this seq) — the Python queue's
+            # clock discipline
+            _lib().ag_adms_set_chunk_ts(self._h, seq, self._clock())
+        pre_verified = 0
+        if cache is not None and accepted:
+            ver = cache.lookup(dig[:accepted])
+            pre_verified = int(ver.sum())
+            # ALWAYS mark (even all-miss): the native side drops the
+            # per-submit routing vector when consumed
+            _lib().ag_adms_mark_verified(
+                self._h, seq,
+                np.ascontiguousarray(ver, np.uint8).tobytes(),
+                accepted)
+        return AdmitResult(accepted, int(counts[1]), int(counts[2]),
+                           int(counts[3]), int(counts[4]), pre_verified)
+
+    def submit_bls(self, wire_bytes) -> AdmitResult:
+        """BlsClassTable fold + taxonomy mapping, exactly
+        NativeAdmissionQueue.submit_bls (counter deltas land on
+        shard 0 — the aggregate is what reports sum)."""
+        if self.bls_table is None:
+            raise ValueError(
+                "submit_bls on a queue without a bls_table (pass "
+                "BlsClassTable/BlsLane at construction)")
+        res = self.bls_table.fold(wire_bytes)
+        fairness = (res["pop_missing"] + res["unknown_validator"]
+                    + res["duplicate"] + res["quarantined"])
+        deltas = np.asarray(
+            [res["folded"] + fairness + res["malformed"]
+             + res["overflow"],
+             res["folded"], res["overflow"], fairness,
+             res["malformed"]], np.int64)
+        _lib().ag_adms_add_counters(self._h, deltas.ctypes.data)
+        return AdmitResult(res["folded"], res["overflow"], fairness,
+                           res["malformed"], 0)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        return int(_lib().ag_adms_depth(self._h))
+
+    def shard_depth(self, shard: int) -> int:
+        return int(_lib().ag_adms_shard_depth(self._h, int(shard)))
+
+    @property
+    def oldest_ts(self) -> Optional[float]:
+        """Guarded min over every shard's stamped records (the ISSUE 20
+        oldest_ts fix, grouped) — None only when nothing stamped
+        anywhere; see NativeAdmissionQueue.oldest_ts."""
+        v = _lib().ag_adms_oldest_ts(self._h)
+        return None if math.isnan(v) else v
+
+    def instance_depth(self, instance: int) -> int:
+        return int(_lib().ag_adms_instance_depth(self._h,
+                                                 int(instance)))
+
+    @property
+    def counters(self) -> dict:
+        buf = np.empty(7, np.int64)
+        _lib().ag_adms_counters(self._h, buf.ctypes.data)
+        return {"submitted": int(buf[0]), "admitted": int(buf[1]),
+                "rejected_overflow": int(buf[2]),
+                "rejected_fairness": int(buf[3]),
+                "rejected_malformed": int(buf[4]),
+                "evicted": int(buf[5]), "drained": int(buf[6])}
+
+    def shard_counters(self, shard: int) -> dict:
+        buf = np.empty(7, np.int64)
+        _lib().ag_adms_shard_counters(self._h, int(shard),
+                                      buf.ctypes.data)
+        return {"submitted": int(buf[0]), "admitted": int(buf[1]),
+                "rejected_overflow": int(buf[2]),
+                "rejected_fairness": int(buf[3]),
+                "rejected_malformed": int(buf[4]),
+                "evicted": int(buf[5]), "drained": int(buf[6])}
+
+    def native_snapshot(self) -> dict:
+        """The drain report's native-admission section, with the
+        per-shard breakdown alongside the aggregate."""
+        out = self.counters
+        out["depth"] = self.depth
+        out["phase_fill"] = self.phase_fill
+        out["phase_bail"] = self.phase_bail
+        out["n_shards"] = self.n_shards
+        shards = []
+        for s in range(self.n_shards):
+            c = self.shard_counters(s)
+            c["depth"] = self.shard_depth(s)
+            shards.append(c)
+        out["shards"] = shards
+        return out
+
+    # -- state-space surface -------------------------------------------------
+
+    def mc_canonical(self) -> tuple:
+        """AdmissionQueue.mc_canonical's row format over the MERGED
+        (seq, sub_idx) stream — the shard-group-vs-Python queue
+        content differential."""
+        from agnes_tpu.bridge.native_ingest import unpack_wire_votes
+
         n = self.depth
-        if n == 0:
-            return None
-        if max_records is not None:
-            n = min(n, int(max_records))
-            if n <= 0:
-                # zero/negative cap: None, matching AdmissionQueue
-                # (np.empty(n < 0) would raise; the C side clamps >= 0)
-                return None
-        inst = np.empty(n, np.int64)
-        val = np.empty(n, np.int64)
-        hts = np.empty(n, np.int64)
-        rnd = np.empty(n, np.int64)
-        typ = np.empty(n, np.int64)
-        value = np.empty(n, np.int64)
-        sigs = np.empty((n, 64), np.uint8)
-        ver = np.empty(n, np.uint8)
-        dig = (np.empty((n, 32), np.uint8)
-               if self.cache is not None else None)
-        ts = np.empty(n, np.float64)
-        t0 = time.perf_counter()
-        got = int(_lib().ag_adm_drain(
-            self._h, n, inst.ctypes.data, val.ctypes.data,
-            hts.ctypes.data, rnd.ctypes.data, typ.ctypes.data,
-            value.ctypes.data, sigs.ctypes.data, ver.ctypes.data,
-            dig.ctypes.data if dig is not None else None,
-            ts.ctypes.data))
-        wall = time.perf_counter() - t0
-        # the C side clamps n to the LIVE queue size under its mutex —
-        # a concurrent drain (or anything else shrinking the queue)
-        # between the unlocked depth read above and the native call
-        # means rows past `got` are uninitialized np.empty memory and
-        # must never reach VoteBatcher
-        if got == 0:
-            return None
-        if got < n:
-            n = got
-            inst, val, hts, rnd, typ, value, ts = (
-                a[:n] for a in (inst, val, hts, rnd, typ, value, ts))
-            sigs, ver = sigs[:n], ver[:n]
-            if dig is not None:
-                dig = dig[:n]
-        if self.drain_hist is not None:
-            self.drain_hist.record(wall, n)
-        # a record popped between a lock-free submit and its
-        # set_chunk_ts stamp carries NaN — substitute "admitted just
-        # now" so neither the wait histogram nor t_first (and the
-        # batch-close-age histogram downstream of it) ever sees an
-        # epoch-scale outlier.  Never taken single-threaded, so the
-        # fake-clock invocation parity of the differentials holds.
-        nan = np.isnan(ts)
-        if nan.any():
-            ts[nan] = self._clock()
-        if self.wait_hist is not None:
-            # one clock read, and ONLY with a histogram attached —
-            # AdmissionQueue.drain's exact clock discipline
-            now = self._clock()
-            edges = np.flatnonzero(np.diff(ts)) + 1
-            starts = np.concatenate(([0], edges))
-            ends = np.concatenate((edges, [n]))
-            for s, e in zip(starts, ends):
-                self.wait_hist.record(now - ts[s].item(), int(e - s))
-        return WireColumns(inst, val, hts, rnd, typ, value, sigs,
-                           ver.astype(bool), digest=dig,
-                           t_first=ts.min().item())
+        raw = np.empty((max(n, 1), REC_SIZE), np.uint8)
+        ver = np.empty(max(n, 1), np.uint8)
+        n = int(_lib().ag_adms_export(self._h, raw.ctypes.data,
+                                      ver.ctypes.data, n))
+        inst, val, hts, rnd, typ, value, _sigs = unpack_wire_votes(
+            raw[:n].tobytes())
+        rows = [(int(inst[j]), int(val[j]), int(hts[j]), int(rnd[j]),
+                 int(typ[j]), int(value[j]), int(ver[j]))
+                for j in range(n)]
+        return (tuple(rows), n)
+
+    # -- drain ---------------------------------------------------------------
+
+    def drain(self, max_records: Optional[int] = None
+              ) -> Optional[WireColumns]:
+        """K-way merged drain across the shards in ONE GIL-releasing
+        native call — plain WireColumns, or columns + a NativePhases
+        device build when eligible (see _native_drain)."""
+        L = _lib()
+        return _native_drain(self, L.ag_adms_drain,
+                             L.ag_adms_drain_phases, max_records)

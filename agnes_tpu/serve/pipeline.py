@@ -52,7 +52,7 @@ import jax.numpy as jnp
 from agnes_tpu.device.encoding import I32
 from agnes_tpu.device.step import VotePhase
 from agnes_tpu.serve.batcher import ShapeLadder
-from agnes_tpu.serve.queue import WireColumns
+from agnes_tpu.serve.queue import PhaseBuildState, WireColumns
 from agnes_tpu.types import NIL_ID
 from agnes_tpu.utils.tracing import Tracer
 
@@ -207,6 +207,14 @@ class ServePipeline:
         # this; the counter stays as the regression alarm (tests
         # assert it is 0)
         self.offladder_builds = 0
+        # zero-copy densify (ISSUE 20): builds adopted straight from a
+        # native phase drain — no add_arrays, no build_phases_device;
+        # the C++ drain already produced the device-build arrays.  The
+        # numpy pubkey table handed to the drain is cached here because
+        # self.pubkeys may be device-resident (one fetch, not one per
+        # drain).
+        self.native_phase_builds = 0
+        self._pk_np: Optional[np.ndarray] = None
         # elastic-pod negotiation support (ISSUE 17): warmup() records
         # every (kind, P[, rung]) it compiled so the negotiation layer
         # can PROVE a padded plan lands on a warmed shape before
@@ -255,6 +263,38 @@ class ServePipeline:
         self.batcher.sync_device(base, hts)
         return hts
 
+    def native_phase_state(self) -> Optional[PhaseBuildState]:
+        """The PhaseBuildState a native zero-copy phase drain densifies
+        against (ISSUE 20), or None when this deployment cannot adopt
+        one — no window predictor (the drain runs BEFORE _sync_window,
+        so only a predicted window can be densified against without a
+        device fetch), unsigned, dense dispatch mode, or MSM verify
+        mode.  The service wires this as the native queue's
+        `phase_state` hook; it runs once per drain, on the drain's
+        thread, and must stay cheap (the predictor is the honest-path
+        host computation _sync_window already trusts).  stage()
+        re-validates the prediction against the just-synced window and
+        falls back to add_arrays on the plain columns if a rotation
+        landed in between — correctness never rests on the prediction,
+        only the zero-copy fast path does."""
+        if (self.window_predictor is None or self.pubkeys is None
+                or self.dense or self.batcher.verify_mode != "lanes"):
+            return None
+        base, hts = self.window_predictor()
+        if self._pk_np is None:
+            self._pk_np = np.ascontiguousarray(
+                np.asarray(self.pubkeys), np.uint8)  # lint: allow (one-time pubkey table snapshot)
+        return PhaseBuildState(
+            heights=np.asarray(hts, np.int64),  # lint: allow (host predictor output)
+            base_round=np.asarray(base, np.int64),  # lint: allow (host predictor output)
+            window=self.batcher.W,
+            slot_lut=self.batcher.slots.dense,
+            pubkeys=self._pk_np,
+            n_validators=self.batcher.V,
+            lane_floor=self.ladder.min_rung,
+            max_votes=self.ladder.max_rung,
+            phase_offset=1)
+
     def _entry_phase(self, heights: np.ndarray) -> VotePhase:
         """The round-entry phase, built from HOST heights so nothing
         in a donated dispatch aliases the driver's live state
@@ -295,13 +335,41 @@ class ServePipeline:
             if self.batcher.pending_votes:
                 staged_any |= self._build_all(hts, self._clock())
             if n_new:
-                self.batcher.add_arrays(batch.instance, batch.validator,
-                                        batch.height, batch.round_,
-                                        batch.typ, batch.value,
-                                        batch.signatures,
-                                        verified=batch.verified,
-                                        digest=batch.digest)
-                staged_any |= self._build_all(hts, batch.t_first)
+                ph = batch.native_phases
+                if (ph is not None
+                        and self.batcher.pending_votes == 0
+                        and self.pubkeys is not None and not self.dense
+                        and self.batcher.verify_mode == "lanes"
+                        and np.array_equal(ph.heights,
+                                           self.batcher.heights)
+                        and np.array_equal(ph.base_round,
+                                           self.batcher.base_round)):
+                    # zero-copy adopt (ISSUE 20): the native drain
+                    # already produced this batch's device-build
+                    # arrays, and the window it densified against IS
+                    # the window just synced — skip add_arrays and
+                    # build_phases_device entirely.  Any mismatch (a
+                    # rotation landed between drain and stage, held
+                    # re-entry left rows pending, a mode flip) falls
+                    # through to the plain columns, which are always
+                    # filled.
+                    phases, lanes = self.batcher.adopt_native_phases(
+                        batch, ph, self.pubkeys)
+                    keys = (self.batcher.last_build_keys
+                            if self.cache is not None else None)
+                    self.native_phase_builds += 1
+                    staged_any |= self._stage_signed(
+                        phases, lanes, hts, batch.t_first, keys,
+                        native=True)
+                else:
+                    self.batcher.add_arrays(batch.instance,
+                                            batch.validator,
+                                            batch.height, batch.round_,
+                                            batch.typ, batch.value,
+                                            batch.signatures,
+                                            verified=batch.verified,
+                                            digest=batch.digest)
+                    staged_any |= self._build_all(hts, batch.t_first)
         if not staged_any:
             self.noop_ticks += 1
         return staged_any
@@ -382,6 +450,15 @@ class ServePipeline:
             # ineligible traffic (equivocation layers, mixed
             # rounds, MSM mode): the batcher host-verified instead
             self.host_fallback_builds += 1
+        return self._stage_signed(phases, lanes, hts, t_first, keys)
+
+    def _stage_signed(self, phases, lanes, hts: np.ndarray,
+                      t_first: float, keys,
+                      native: bool = False) -> bool:
+        """The staging tail shared by _build_one and stage()'s native
+        adopt path: off-ladder alarm, tick lifecycle, entry policy,
+        staged-FIFO append.  `phases` is [(VotePhase, n_votes)]; lanes
+        may be None (host-verified/unsigned builds)."""
         if (not self.dense and lanes is not None
                 and int(lanes.pub.shape[0]) > self.ladder.max_rung):
             # unreachable since the max_votes cap (lanes <= votes and
@@ -396,9 +473,10 @@ class ServePipeline:
         # compile keys carry no rung)
         rung = (int(lanes.pub.shape[0])
                 if (not self.dense and lanes is not None) else None)
+        extra = {"native": True} if native else {}
         self._event("tick_open", tick=tick,
                     votes=sum(n for _, n in phases), rung=rung,
-                    signed=lanes is not None)
+                    signed=lanes is not None, **extra)
         # Entry policy: signed builds ALWAYS prepend the empty entry
         # phase (their lanes were packed with phase_offset=1, and the
         # honest steady state advances heights every batch anyway —

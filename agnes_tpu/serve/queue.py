@@ -88,9 +88,67 @@ class WireColumns(NamedTuple):
     digest: Optional[np.ndarray]  # [N, 32] uint8 wire SHA-256s (cache
     #                               attached) or None (dedup off)
     t_first: float             # earliest admission instant in the batch
+    #: zero-copy densify (ISSUE 20): a NativePhases bundle when the
+    #: native drain already built the phase/lane arrays for this batch
+    #: (None on the Python queue and on any native bail-to-Python
+    #: drain).  The columns above are ALWAYS filled regardless — the
+    #: pipeline's adopt path still logs them as evidence, and a window
+    #: mismatch at stage time falls back to add_arrays on them.
+    native_phases: Optional["NativePhases"] = None
 
     def __len__(self) -> int:
         return len(self.instance)
+
+
+class PhaseBuildState(NamedTuple):
+    """Inputs a native phase drain needs to replay the batcher's
+    device-verify build: the WINDOW the batch will be staged against
+    (predicted — the drain runs before ServePipeline._sync_window, so
+    the pipeline hands the post-sync window it will install and
+    validates the prediction at stage time) plus the value-table and
+    ladder geometry.  Built by ServePipeline.native_phase_state()."""
+
+    heights: np.ndarray        # [I] int64 window heights (predicted)
+    base_round: np.ndarray     # [I] int64 window base rounds
+    window: int                # rounds per window (W)
+    slot_lut: np.ndarray       # [I, S] int64 dense SlotMap export
+    pubkeys: np.ndarray        # [V, 32] uint8 validator keys
+    n_validators: int
+    lane_floor: int            # ladder.min_rung (pad floor)
+    max_votes: int             # ladder.max_rung (defer threshold)
+    phase_offset: int          # entry-phase slot count (1)
+
+
+@dataclass
+class NativePhases:
+    """The padded device-build arrays a native phase drain produced —
+    exactly VoteBatcher.build_phases_device's output layout, filled by
+    core/native/admission_phases.cpp into numpy buffers the pipeline
+    wraps WITHOUT per-record Python work (jnp.asarray per ARRAY, not
+    per record).  `heights`/`base_round` echo the PhaseBuildState the
+    build assumed so the adopter can validate the window prediction."""
+
+    n_phases: int
+    n_lanes: int               # real lanes (== batch length)
+    n_pad: int                 # padded lane rung
+    round_: int                # the single round of the batch
+    typ: np.ndarray            # [n_phases] int64 phase vote types
+    counts: np.ndarray         # [n_phases] int64 votes per phase
+    slots: np.ndarray          # [n_phases, I, V] int32 slot planes
+    mask: np.ndarray           # [n_phases, I, V] bool
+    pub: np.ndarray            # [n_pad, 32] int32 widened pubkeys
+    sig: np.ndarray            # [n_pad, 64] int32 widened signatures
+    blocks: np.ndarray         # [n_pad, 1, 32] uint32 SHA-512 words
+    phase_idx: np.ndarray      # [n_pad] int32
+    inst: np.ndarray           # [n_pad] int32
+    val: np.ndarray            # [n_pad] int32
+    real: np.ndarray           # [n_pad] bool pad mask
+    lane_rows: np.ndarray      # [n_lanes] int64 lane -> drained-row
+    #                            permutation (the phase-grouped cat
+    #                            order; the adopter's last_build_keys
+    #                            and log gathers)
+    heights: np.ndarray        # [I] int64 window the build assumed
+    base_round: np.ndarray     # [I] int64
 
 
 def _record_digests(wire_bytes, idx: np.ndarray) -> np.ndarray:

@@ -49,11 +49,15 @@ from agnes_tpu.utils.metrics import (  # noqa: F401 — SERVE_* threaded-
     SERVE_E2E_DECISION_S,
     SERVE_INBOX_DEPTH,
     SERVE_INBOX_DROPPED,
+    SERVE_NATIVE_DENSIFY_WALL_S,
     SERVE_NATIVE_DRAIN_WALL_S,
     SERVE_NATIVE_INBOX_DEPTH,
+    SERVE_NATIVE_PHASE_BUILDS,
     SERVE_NATIVE_REJECTS_FAIRNESS,
     SERVE_NATIVE_REJECTS_MALFORMED,
     SERVE_NATIVE_REJECTS_OVERFLOW,
+    SERVE_NATIVE_SHARD_DEPTH_PREFIX,
+    SERVE_NATIVE_SHARD_REJECTS_PREFIX,
     SERVE_SUBMIT_BUSY_FRAC,
     SERVE_THREAD_FAILURES,
 )
@@ -153,6 +157,7 @@ class VoteService:
                  dedup_cache=None,
                  bls_lane=None,
                  native_admission: bool = False,
+                 native_shards: int = 1,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
                  flightrec=None,
@@ -196,7 +201,21 @@ class VoteService:
         native path is byte-compatible with the Python queue
         (identical reject taxonomy, cache hit/miss counts and
         dispatch streams — tests/test_native_admission.py), so
-        flipping it changes throughput, never decisions."""
+        flipping it changes throughput, never decisions.
+
+        `native_shards` (ISSUE 20, requires `native_admission`) splits
+        the native front-end into N admission shards — one C++ queue
+        (and one mutex) per shard, instance-range partitioned like
+        distributed/topology.HostPlan — behind one submit fan-in and a
+        deterministic k-way merged drain, so producer threads landing
+        on different instance ranges never contend.  Needs
+        I % native_shards == 0 and capacity % native_shards == 0 (the
+        per-shard capacity ceiling must be an integer).  On a
+        native_admission service the drain ALSO densifies eligible
+        batches straight to the device-build phase/lane arrays
+        (zero-copy densify — serve_native_densify_wall_s /
+        serve_native_phase_builds measure it); both are throughput
+        knobs, never decision changes."""
         I, V = driver.I, driver.V
         if dedup_cache is not None and dedup_cache is not False:
             from agnes_tpu.serve.cache import VerifiedCache
@@ -231,23 +250,45 @@ class VoteService:
         # that overload surfaces as rejects, not as unbounded memory
         capacity = capacity if capacity is not None else 4 * I * V
         self.native_admission = bool(native_admission)
+        self.native_shards = int(native_shards)
+        if self.native_shards < 1:
+            raise ValueError(
+                f"native_shards must be >= 1: {native_shards}")
+        if self.native_shards > 1 and not self.native_admission:
+            raise ValueError(
+                "native_shards > 1 requires native_admission=True "
+                "(sharding is a property of the C++ front-end)")
+        qkw = {}
         if self.native_admission:
-            from agnes_tpu.serve.native_admission import (
-                NativeAdmissionQueue,
-            )
+            if self.native_shards > 1:
+                from agnes_tpu.serve.native_admission import (
+                    NativeAdmissionShards,
+                )
 
-            queue_cls = NativeAdmissionQueue
+                queue_cls = NativeAdmissionShards
+                qkw["n_shards"] = self.native_shards
+            else:
+                from agnes_tpu.serve.native_admission import (
+                    NativeAdmissionQueue,
+                )
+
+                queue_cls = NativeAdmissionQueue
         else:
             queue_cls = AdmissionQueue
-        # ONE construction site: the two queues are byte-compatible
-        # twins, so a config kwarg can never apply to one and not the
-        # other
+        # ONE construction site: the queues are byte-compatible twins,
+        # so a config kwarg can never apply to one and not the others
         self.queue = queue_cls(
             I, capacity, instance_cap=instance_cap,
             policy=overload_policy, cache=self.cache,
             bls_table=(bls_lane.table if bls_lane is not None
                        else None),
-            clock=clock)
+            clock=clock, **qkw)
+        # per-shard depth gauge names, precomputed (submit is the hot
+        # path — no per-submit string building)
+        self._shard_depth_names = [
+            SERVE_NATIVE_SHARD_DEPTH_PREFIX + str(s)
+            for s in range(self.native_shards)] \
+            if self.native_shards > 1 else []
         if self.native_admission:
             # ISSUE 14 observability: wall of the GIL-releasing
             # drain-and-densify span, into the shared registry
@@ -273,6 +314,14 @@ class VoteService:
                                       tracer=tracer,
                                       metrics=self.metrics,
                                       flightrec=flightrec, clock=clock)
+        if self.native_admission:
+            # ISSUE 20 zero-copy densify: the native drain fills the
+            # device-build phase/lane arrays against the pipeline's
+            # predicted window (None hook result = plain drain; the
+            # pipeline re-validates at stage time either way)
+            self.queue.phase_state = self.pipeline.native_phase_state
+            self.queue.densify_hist = self.metrics.histogram(
+                SERVE_NATIVE_DENSIFY_WALL_S)
         if bls_lane is not None:
             bls_lane.bind(driver, metrics=self.metrics, ladder=ladder)
         self.driver = driver
@@ -351,6 +400,11 @@ class VoteService:
                 m.count(SERVE_NATIVE_REJECTS_MALFORMED,
                         res.rejected_malformed)
             m.gauge(SERVE_NATIVE_INBOX_DEPTH, depth)
+            for s, name in enumerate(self._shard_depth_names):
+                # ISSUE 20: per-shard resident depth — a skewed
+                # instance mix shows up here long before the aggregate
+                # ceiling does
+                m.gauge(name, self.queue.shard_depth(s))
         m.gauge(SERVE_QUEUE_DEPTH, depth)
         return res
 
@@ -470,6 +524,24 @@ class VoteService:
             for b in done:
                 self._h_e2e.record(now - b.t_first, b.n_votes)
         self.metrics.gauge(SERVE_INFLIGHT, 0)
+        if self.native_admission:
+            m = self.metrics
+            # ISSUE 20: adopted native phase builds into the registry
+            # (delta-reconciled — settle is the one sync point)
+            delta = (self.pipeline.native_phase_builds
+                     - m.counters.get(SERVE_NATIVE_PHASE_BUILDS, 0))
+            if delta > 0:
+                m.count(SERVE_NATIVE_PHASE_BUILDS, delta)
+            if self.native_shards > 1:
+                # shard-summed reject taxonomy under the shard names,
+                # so a shards-vs-single A/B reads off one scrape
+                c = self.queue.counters
+                for cause in ("overflow", "fairness", "malformed"):
+                    name = SERVE_NATIVE_SHARD_REJECTS_PREFIX + cause
+                    delta = (c["rejected_" + cause]
+                             - m.counters.get(name, 0))
+                    if delta > 0:
+                        m.count(name, delta)
         self.metrics.gauge(SERVE_ADMIT_RATE,
                            self.metrics.interval_rate(SERVE_ADMITTED))
         self.metrics.gauge(
@@ -611,6 +683,9 @@ class VoteService:
             # mirror of the serve_native_* registry names
             "native_admission": (self.queue.native_snapshot()
                                  if self.native_admission else None),
+            # ISSUE 20: builds adopted straight from a native phase
+            # drain (0 on a Python-admission or fetch-mode service)
+            "native_phase_builds": self.pipeline.native_phase_builds,
             "bls": (self.bls.snapshot() if self.bls is not None
                     else None),
             "bls_votes": self.pipeline.bls_votes,

@@ -35,6 +35,12 @@ for the whole native call (the nesting lockcheck's LOCK005 forbids on
 the C-API surface).  The submit thread's work becomes a memcpy into
 the native inbox; everything else it touches (Metrics, the cache's
 leaf mutex, the flight recorder's ring) is thread-safe on its own.
+The same elision covers the SHARDED native front-end (ISSUE 20,
+NativeAdmissionShards): the shard group's handle synchronizes
+internally (per-shard leaf mutexes + a routing-table mutex), carries
+the same ``native = True`` class attribute this module keys on, and
+its submit is the same single GIL-releasing ctypes call — so N socket
+threads spread across shards without ever meeting a Python lock.
 The verified-vote dedup lookup (ISSUE 5, serve/cache.py) runs inside
 `queue.submit` on the SUBMIT thread under the admission lock — never
 under the device lock — and the cache's own leaf mutex is held for

@@ -423,6 +423,32 @@ def render_postmortem(path: str,
                    + (" — HELD GOSSIP DROPPED (reroute capacity "
                       "overflow)" if memb.get(
                           "membership_hold_overflow") else ""))
+    # ISSUE 20: the native admission front-end — zero-copy phase
+    # builds actually taken, per-shard queue depths and per-cause
+    # shard rejects, by name (same stdlib-only contract: the names
+    # mirror utils/metrics.py's SERVE_NATIVE_PHASE_BUILDS /
+    # SERVE_NATIVE_SHARD_DEPTH_PREFIX / _REJECTS_PREFIX).  A host
+    # whose densify fell back to the Python path — phase builds zero
+    # while native submits flowed — should say so here, and a shard
+    # sitting deep while its siblings drain is a routing red flag.
+    nat_builds = last.get("serve_native_phase_builds")
+    depths = {k: v for k, v in last.items()
+              if k.startswith("serve_native_shard_depth_")
+              and isinstance(v, (int, float))}
+    rejects = {k: v for k, v in last.items()
+               if k.startswith("serve_native_shard_rejects_")
+               and isinstance(v, (int, float)) and v > 0}
+    if nat_builds or depths or rejects:
+        bits = []
+        if isinstance(nat_builds, (int, float)):
+            bits.append(f"{int(nat_builds)} zero-copy phase build(s)")
+        if depths:
+            bits.append("shard depths " + "/".join(
+                f"{int(v)}" for _k, v in sorted(depths.items())))
+        bits.extend(
+            f"{k[len('serve_native_shard_rejects_'):]}={int(v)}"
+            for k, v in sorted(rejects.items()))
+        out.append("  native admission: " + ", ".join(bits))
     return "\n".join(out)
 
 

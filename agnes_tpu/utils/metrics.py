@@ -418,6 +418,29 @@ SERVE_NATIVE_REJECTS_OVERFLOW = SERVE_NATIVE_REJECTS_PREFIX + "overflow"
 SERVE_NATIVE_REJECTS_FAIRNESS = SERVE_NATIVE_REJECTS_PREFIX + "fairness"
 SERVE_NATIVE_REJECTS_MALFORMED = (SERVE_NATIVE_REJECTS_PREFIX
                                   + "malformed")
+#: ISSUE 20 (zero-copy densify + sharded ingest):
+#:   serve_native_densify_wall_s — wall of drains whose phase/lane
+#:                                 device-build arrays were filled
+#:                                 NATIVELY (a subset of the plain
+#:                                 drain histogram's population; the
+#:                                 A/B between the two is the densify
+#:                                 speedup read off one scrape)
+#:   serve_native_phase_builds   — builds the pipeline adopted from a
+#:                                 native phase drain (counter; zero
+#:                                 per-record Python work end-to-end)
+#:   serve_native_shard_depth_<s> — per-shard resident depth gauges
+#:                                 (sharded ingest only; the aggregate
+#:                                 stays serve_native_inbox_depth)
+#:   serve_native_shard_rejects_<cause> — reject counters summed
+#:                                 across shards, mirrored at settle
+#:                                 (delta-reconciled from the native
+#:                                 counters, so per-shard screens and
+#:                                 the fan-in's routing are one
+#:                                 number, not n_shards scrapes)
+SERVE_NATIVE_DENSIFY_WALL_S = "serve_native_densify_wall_s"
+SERVE_NATIVE_PHASE_BUILDS = "serve_native_phase_builds"
+SERVE_NATIVE_SHARD_DEPTH_PREFIX = "serve_native_shard_depth_"
+SERVE_NATIVE_SHARD_REJECTS_PREFIX = "serve_native_shard_rejects_"
 #: ISSUE 15 (multi-host serve, agnes_tpu/distributed/): records the
 #: pod front door screened off because their GLOBAL instance id
 #: belongs to another host's block (counter, distributed/shard.py —

@@ -74,6 +74,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 # make `from scripts.tpu_holders import ...` resolve regardless of the
@@ -233,9 +234,18 @@ _SERVE_BLS_SMOKE = bool(os.environ.get("AGNES_BENCH_SERVE_BLS_SMOKE"))
 #: native-admission serve probe — the threaded host over the C++
 #: admission front-end, then the SAME traffic through the Python
 #: queue in-process (shared compiles) plus a host-only submit/drain
-#: A/B for native_admission_speedup; CPU, crash-safe
+#: A/B for native_admission_speedup; CPU, crash-safe.  The var's
+#: VALUE doubles as the shard knob (ISSUE 20): any integer > 1 sets
+#: the shard count of the sharded-ingest A/B (and the closed-loop ON
+#: run, when it divides the shape); "1"/non-numeric keeps the
+#: default of 2
 _SERVE_NATIVE_SMOKE = bool(
     os.environ.get("AGNES_BENCH_SERVE_NATIVE_SMOKE"))
+
+
+def _native_shard_knob() -> int:
+    v = os.environ.get("AGNES_BENCH_SERVE_NATIVE_SMOKE", "")
+    return int(v) if v.isdigit() and int(v) > 1 else 2
 #: multi-host-smoke mode (ci.sh gate, ISSUE 15): ONLY the pod serve
 #: probe — the PARENT spawns 2 jax.distributed worker processes (2
 #: faked CPU devices each, gloo collectives) via
@@ -1718,7 +1728,20 @@ def _pipeline_serve_native(n_instances: int, n_validators: int,
     submit/drain A/B over the same wire bytes: at smoke shapes the
     end-to-end rate is compile/dispatch-bound and would bury the
     admission delta in device noise, while the submit/drain path is
-    exactly what the front-end moved to C++."""
+    exactly what the front-end moved to C++.
+
+    ISSUE 20 extends the probe with two more host-only A/Bs over the
+    same wire: `native_densify_speedup` — drain_phases + adopt (the
+    zero-copy device-build fill in C) vs plain drain + add_arrays +
+    build_phases_device (the Python densify) — and
+    `native_shard_speedup` — 2 producer threads hammering the
+    gossip-shaped submit path against NativeAdmissionShards
+    (per-shard mutexes) vs the single native queue (one mutex); the
+    shard count rides the AGNES_BENCH_SERVE_NATIVE_SMOKE value and is
+    exported as `native_shards`.  The closed-loop ON run itself goes
+    through the shard group + phases path whenever the shard count
+    divides the shape, so `native_phase_builds` measures real
+    adoption under the threaded host."""
     from agnes_tpu.bridge.native_ingest import pack_wire_votes
     from agnes_tpu.core import native
     from agnes_tpu.harness.device_driver import DeviceDriver
@@ -1755,6 +1778,12 @@ def _pipeline_serve_native(n_instances: int, n_validators: int,
     all_wire = [wire_height(h, _sign_height_sigs(seeds, h))
                 for h in range(heights + 1)]
 
+    n_shards = _native_shard_knob()
+    # the closed-loop ON run rides the shard group + phases path when
+    # the knob divides the shape (the construction-time contract)
+    run_shards = (n_shards if I % n_shards == 0
+                  and (4 * n) % n_shards == 0 else 1)
+
     def run(native_admission: bool):
         d = DeviceDriver(I, V, advance_height=True, defer_collect=True,
                          audit=True)
@@ -1767,6 +1796,7 @@ def _pipeline_serve_native(n_instances: int, n_validators: int,
             ladder=ShapeLadder.plan(I, V, min_rung=rung),
             dedup_cache=VerifiedCache(),
             native_admission=native_admission,
+            native_shards=(run_shards if native_admission else 1),
             window_predictor=lambda: (np.zeros(I, np.int64),
                                       np.full(I, cur["h"], np.int64)),
             flightrec=_FLIGHTREC)
@@ -1858,6 +1888,141 @@ def _pipeline_serve_native(n_instances: int, n_validators: int,
 
     adm_native = admission_votes_per_sec(True)
     adm_python = admission_votes_per_sec(False)
+
+    # -- ISSUE 20 A/B 1: zero-copy densify vs Python densify ------------
+    # same wire, same batcher discipline: ON drains phase-filled
+    # batches (C wrote the device-build arrays) and adopts them; OFF
+    # drains plain columns and pays add_arrays + build_phases_device.
+    # Both arms end each drain holding device-shaped phases + lanes,
+    # so the delta is exactly the per-record Python densify work.
+    def densify_votes_per_sec(native_phases: bool) -> float:
+        from agnes_tpu.serve.queue import PhaseBuildState
+
+        bat = RunConfig(n_validators=V, n_instances=I,
+                        n_slots=4).validate().make_batcher()
+        for i in range(I):
+            bat.slots.slot_for(i, 7)       # LUT warm: value 7 interned
+        q = NativeAdmissionQueue(I, 4 * n)
+        if native_phases:
+            state = PhaseBuildState(
+                heights=np.zeros(I, np.int64),
+                base_round=np.zeros(I, np.int64),
+                window=bat.W, slot_lut=bat.slots.dense,
+                pubkeys=np.ascontiguousarray(pubkeys, np.uint8),
+                n_validators=V, lane_floor=rung, max_votes=rung,
+                phase_offset=1)
+            q.phase_state = lambda: state
+        chunk = 16 * 96
+        wire0 = all_wire[0]                # height 0 == batcher window
+        chunks = [wire0[k:k + chunk] for k in range(0, len(wire0),
+                                                    chunk)]
+        per_pass = 2 * n
+        reps = max(1, 12_000 // per_pass)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for w in chunks:
+                q.submit(w)
+            while q.depth:
+                b = q.drain(2 * n)
+                if native_phases:
+                    assert b.native_phases is not None, \
+                        (q.phase_fill, q.phase_bail)
+                    bat.adopt_native_phases(b, b.native_phases,
+                                            pubkeys)
+                else:
+                    bat.add_arrays(b.instance, b.validator, b.height,
+                                   b.round_, b.typ, b.value,
+                                   b.signatures, verified=b.verified,
+                                   digest=b.digest)
+                    _phases, lanes = bat.build_phases_device(
+                        pubkeys, phase_offset=1, lane_floor=rung,
+                        max_votes=rung)
+                    # device-verify eligible: no host Ed25519 leaked
+                    # into the Python arm (that would inflate the
+                    # ratio with work neither arm should pay)
+                    assert lanes is not None
+        dt = time.perf_counter() - t0
+        assert q.counters["admitted"] == reps * per_pass, q.counters
+        return reps * per_pass / dt
+
+    dens_native = densify_votes_per_sec(True)
+    dens_python = densify_votes_per_sec(False)
+
+    # -- ISSUE 20 A/B 2: sharded ingest vs single native queue ----------
+    # the 2-CPU gossip-shaped host: 2 producer threads hammering
+    # 16-record submits (each owning its instance half — gossip routed
+    # by home host) against ONE concurrent drainer.  shards=1 is the
+    # single queue (one mutex on the whole path); shards=N the shard
+    # group (per-shard leaf mutexes + a routing fan-in).
+    from agnes_tpu.serve.native_admission import NativeAdmissionShards
+
+    def shard_votes_per_sec(shards: int) -> float:
+        half = I // 2
+        n_half = half * V
+        reps = max(1, 20_000 // (2 * n_half))
+        total = reps * 2 * n_half
+        cap = ((total + shards - 1) // shards) * shards  # no overflow
+        if shards == 1:
+            q = NativeAdmissionQueue(I, cap, instance_cap=cap)
+        else:
+            q = NativeAdmissionShards(I, cap, instance_cap=cap,
+                                      n_shards=shards)
+        chunk = 16 * 96
+        wires = []
+        for p in range(2):
+            ip = np.repeat(np.arange(p * half, (p + 1) * half), V)
+            vp = np.tile(np.arange(V), half)
+            w = pack_wire_votes(ip, vp, np.zeros(n_half),
+                                np.zeros(n_half), np.ones(n_half),
+                                np.full(n_half, 7),
+                                np.zeros((n_half, 64), np.uint8))
+            wires.append([w[k:k + chunk]
+                          for k in range(0, len(w), chunk)])
+        barrier = threading.Barrier(3)
+
+        def producer(p):
+            barrier.wait()
+            for _ in range(reps):
+                for w in wires[p]:
+                    q.submit(w)
+
+        threads = [threading.Thread(target=producer, args=(p,))
+                   for p in range(2)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        drained = 0
+        while drained < total:
+            b = q.drain(4096)
+            if b is None:
+                time.sleep(1e-5)
+                continue
+            drained += len(b.instance)
+        dt = time.perf_counter() - t0
+        for t in threads:
+            t.join()
+        c = q.counters
+        assert c["admitted"] == total and c["drained"] == total, c
+        return total / dt
+
+    if I % n_shards == 0 and (os.cpu_count() or 1) >= 2:
+        # best-of-3 per arm so a scheduler hiccup on a loaded CI box
+        # lands on one trial, not one ARM — the ratio gate is > 1 and
+        # must not flake.  The A/B is only MEANINGFUL with real
+        # concurrency: producers and the drainer must be able to run
+        # in parallel for per-shard mutexes to buy anything (on a
+        # single-core box the measurement is pure scheduler noise
+        # over the routing fan-in's overhead — sentinel instead)
+        shard_single = max(shard_votes_per_sec(1) for _ in range(3))
+        shard_group = max(shard_votes_per_sec(n_shards)
+                          for _ in range(3))
+        shard_speedup = (round(shard_group / shard_single, 2)
+                         if shard_single > 0 else -1)
+    else:
+        shard_group = shard_single = -1
+        shard_speedup = -1      # knob does not divide I, or 1 core
+
     _EXTRA_RECORD.update({
         "pipeline_serve_native_off_votes_per_sec": round(rate_off),
         "native_admission_speedup": (round(adm_native / adm_python, 2)
@@ -1870,6 +2035,18 @@ def _pipeline_serve_native(n_instances: int, n_validators: int,
         "serve_native_drain_wall_p50_s":
             rep_on["metrics"].get(SERVE_NATIVE_DRAIN_WALL_S + "_p50",
                                   -1),
+        # ISSUE 20: the two new A/Bs + the closed-loop adoption count
+        "native_densify_speedup": (round(dens_native / dens_python, 2)
+                                   if dens_python > 0 else -1),
+        "native_densify_votes_per_sec": round(dens_native),
+        "python_densify_votes_per_sec": round(dens_python),
+        "native_shard_speedup": shard_speedup,
+        "native_shards": n_shards,
+        "native_shard_votes_per_sec": (round(shard_group)
+                                       if shard_group > 0 else -1),
+        "native_single_votes_per_sec": (round(shard_single)
+                                        if shard_single > 0 else -1),
+        "native_phase_builds": rep_on.get("native_phase_builds", 0),
     })
     return rate_on
 

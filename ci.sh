@@ -78,12 +78,17 @@ TSAN_OPTIONS="halt_on_error=1" "$TSAN_BIN"
 # native half of the schedcheck story ([1e] below serializes every
 # PYTHON-visible yield point, but ag_adm_* release the GIL for their
 # whole span; this binary races producers / a dispatch-shaped drainer
-# / the observability reader inside that span).  Only admission.cpp +
-# its SHA-256 schedule are needed.
+# / the observability reader inside that span).  ISSUE 20 adds stage
+# 2: producers racing across >= 2 shards through the ag_adms_ fan-in
+# while a phase drainer runs the fused k-way merge + zero-copy
+# densify (admission_shards.cpp + admission_phases.cpp).
 TSAN_ADM_BIN="$(mktemp -d)/tsan_admission_stress"
 g++ -fsanitize=thread -O1 -g -std=c++17 -pthread -o "$TSAN_ADM_BIN" \
   tests/native/tsan_admission_stress.cpp \
-  agnes_tpu/core/native/admission.cpp agnes_tpu/core/native/sha512.cpp
+  agnes_tpu/core/native/admission.cpp \
+  agnes_tpu/core/native/admission_phases.cpp \
+  agnes_tpu/core/native/admission_shards.cpp \
+  agnes_tpu/core/native/sha512.cpp
 TSAN_OPTIONS="halt_on_error=1" "$TSAN_ADM_BIN"
 
 echo "=== [1c/4] static invariant analyzer (abstract tracing, no XLA compiles) ==="
@@ -539,7 +544,11 @@ echo "=== [3f/4] native admission smoke gate (CPU) ==="
 # through one GIL-releasing native call per blob (parse/screen/
 # fairness/SHA-256 in admission.cpp), then the SAME traffic through
 # the Python AdmissionQueue in-process, plus a host-only submit/drain
-# A/B for native_admission_speedup.  Same crash-safe contract as
+# A/B for native_admission_speedup.  ISSUE 20 adds the zero-copy
+# densify A/B (drain_phases + adopt vs drain + add_arrays +
+# build_phases_device) and the sharded-ingest A/B (2 producers vs
+# NativeAdmissionShards at the env knob's shard count vs the single
+# queue) to the same probe.  Same crash-safe contract as
 # [3c]/[3d]: a real pipeline_serve_native_votes_per_sec record (which
 # must then show speedup > 1, zero unexpected retraces and ZERO new
 # XLA compiles on the Python replay — native admission is host-only)
@@ -575,9 +584,26 @@ else:
     assert rec["native_admission_speedup"] > 1, rec
     assert rec["retrace_unexpected"] == 0, rec
     assert rec["native_new_compiles"] == 0, rec
+    # ISSUE 20: the zero-copy densify and sharded-ingest A/Bs must
+    # have produced real numbers or the explicit -1 sentinel (knob
+    # not dividing the shape).  When real: the shard group must beat
+    # the single queue on the 2-producer gossip-shaped host (the
+    # acceptance floor — per-shard mutexes vs one), and the densify
+    # ratio must at least be positive (zero-copy never SLOWER is
+    # asserted at > 1 only on the shard axis; the densify arm's win
+    # is wall-dependent on CPU device-wrap cost, so the gate pins
+    # real-or-sentinel + the key's presence)
+    dens = rec["native_densify_speedup"]
+    assert dens == -1 or dens > 0, rec
+    shard = rec["native_shard_speedup"]
+    assert shard == -1 or shard > 1, rec
+    assert rec["native_shards"] >= 2, rec
     print(f"native admission smoke gate OK: {rec['value']:.0f} votes/s "
           f"(admission {rec['native_admission_speedup']}x vs Python "
-          f"{rec['python_admission_votes_per_sec']:.0f} rec/s; submit "
+          f"{rec['python_admission_votes_per_sec']:.0f} rec/s; densify "
+          f"{dens}x zero-copy; shards x{rec['native_shards']} "
+          f"{shard}x vs single; {rec['native_phase_builds']} adopted "
+          f"phase builds; submit "
           f"busy frac {rec['serve_submit_busy_frac_native']} native "
           f"vs {rec['serve_submit_busy_frac_python']} python)")
 PY

@@ -1,4 +1,5 @@
-// ThreadSanitizer stress for the native admission queue (ISSUE 19).
+// ThreadSanitizer stress for the native admission queue (ISSUE 19)
+// and the sharded group + phase drain on top of it (ISSUE 20).
 //
 // The schedule checker (analysis/schedcheck.py) serializes every
 // PYTHON-visible yield point of the threaded serve host, but the
@@ -22,12 +23,22 @@
 //                      capped export, racing everything — the
 //                      observability path a bench heartbeat takes
 //
+// Stage 2 repeats the topology over the ISSUE-20 shard group
+// (admission_shards.cpp + admission_phases.cpp): producers fan 96-byte
+// records across >= 2 shards through ag_adms_submit (racing
+// set_chunk_ts + mark_verified route consumption), while the drainer
+// runs the PHASES drain — the fused k-way merge + zero-copy densify
+// (ag_adms_drain_phases) — and the cold reader hits the per-shard
+// observability surface (shard_depth / shard_counters / oldest_ts /
+// export).
+//
 // Exit 0 = no data race AND the admission taxonomy balances:
 // submitted = admitted + rejected, admitted = drained + evicted, and
 // the drainer's accumulated row count equals the drained counter
-// (no phantom or lost records).  ci.sh builds this with
-// -fsanitize=thread and runs it as step 1b; the plain (uninstrumented)
-// build doubles as a cheap correctness test in the python suite.
+// (no phantom or lost records) — summed across shards in stage 2.
+// ci.sh builds this with -fsanitize=thread and runs it as step 1b;
+// the plain (uninstrumented) build doubles as a cheap correctness
+// test in the python suite.
 
 #include <algorithm>
 #include <atomic>
@@ -54,6 +65,37 @@ int64_t ag_adm_drain(void* h, int64_t n, int64_t* inst, int64_t* val,
                      int64_t* value, uint8_t* sigs, uint8_t* ver,
                      uint8_t* out_dig, double* ts);
 int64_t ag_adm_export(void* h, uint8_t* raw, uint8_t* ver, int64_t cap);
+
+// the ISSUE-20 shard group (admission_shards.cpp)
+void* ag_adms_new(int64_t n_shards, int64_t I, int64_t capacity,
+                  int64_t instance_cap, int32_t policy,
+                  int32_t with_digests);
+void ag_adms_free(void* h);
+int64_t ag_adms_submit(void* h, const uint8_t* buf, int64_t nbytes,
+                       int64_t* out_counts, uint8_t* out_digests);
+void ag_adms_set_chunk_ts(void* h, int64_t seq, double ts);
+void ag_adms_mark_verified(void* h, int64_t seq, const uint8_t* ver,
+                           int64_t n);
+int64_t ag_adms_depth(void* h);
+int64_t ag_adms_shard_depth(void* h, int64_t s);
+int64_t ag_adms_instance_depth(void* h, int64_t i);
+double ag_adms_oldest_ts(void* h);
+void ag_adms_counters(void* h, int64_t* out7);
+void ag_adms_shard_counters(void* h, int64_t s, int64_t* out7);
+int64_t ag_adms_export(void* h, uint8_t* raw, uint8_t* ver,
+                       int64_t cap);
+int64_t ag_adms_drain_phases(
+    void* h, int64_t n, int64_t* inst, int64_t* val, int64_t* hts,
+    int64_t* rnd, int64_t* typ, int64_t* value, uint8_t* sigs,
+    uint8_t* ver, uint8_t* out_dig, double* ts,
+    const int64_t* win_heights, const int64_t* win_base, int64_t W,
+    const int64_t* slot_lut, int64_t S, int64_t V,
+    const uint8_t* pubkeys, int64_t lane_floor, int64_t max_votes,
+    int64_t phase_offset, int64_t pad_cap, int32_t* ph_slots,
+    uint8_t* ph_mask, int64_t* ph_typ, int64_t* ph_counts,
+    int32_t* ln_pub, int32_t* ln_sig, uint32_t* ln_blocks,
+    int32_t* ln_phase_idx, int32_t* ln_inst, int32_t* ln_val,
+    uint8_t* ln_real, int64_t* ln_rows, int64_t* out_meta);
 }
 
 namespace {
@@ -114,7 +156,7 @@ int64_t drain_once(void* h) {
 
 }  // namespace
 
-int main() {
+static int run_single() {
   void* h = ag_adm_new(I, kCapacity, kInstanceCap, /*drop_oldest=*/1,
                        /*with_digests=*/1);
   if (!h) { std::fprintf(stderr, "ag_adm_new failed\n"); return 2; }
@@ -219,4 +261,228 @@ int main() {
                 static_cast<long long>(c[6]),
                 static_cast<long long>(c[5]));
   return rc;
+}
+
+// -- stage 2: the shard group under the PHASES drain (ISSUE 20) --------------
+
+namespace {
+
+constexpr int64_t kShards = 2;
+constexpr int64_t V = 64;                // validator-id space
+constexpr int64_t S = 4;                 // value slots per instance
+constexpr int64_t kPadCap = 32;          // pow2 >= kDrainMax, >= floor
+
+// one phases drain in the dispatch loop's exact shape: ask sized from
+// an unlocked group-depth read, both the clamp and the permutation
+// validated on the return
+int64_t drain_phases_once(void* g, const int64_t* win_h,
+                          const int64_t* win_b, const int64_t* lut,
+                          const uint8_t* pks) {
+  int64_t n0 = ag_adms_depth(g);
+  if (n0 <= 0) return 0;
+  int64_t ask = std::min(n0, kDrainMax);
+  std::vector<int64_t> inst(ask), val(ask), hts(ask), rnd(ask),
+      typ(ask), value(ask), rows(kPadCap), meta(8);
+  std::vector<uint8_t> sigs(ask * 64), ver(ask), dig(ask * 32);
+  std::vector<double> ts(ask);
+  std::vector<int32_t> ph_slots(2 * I * V);
+  std::vector<uint8_t> ph_mask(2 * I * V);
+  std::vector<int64_t> ph_typ(2), ph_counts(2);
+  std::vector<int32_t> l_pub(kPadCap * 32), l_sig(kPadCap * 64),
+      l_pidx(kPadCap), l_inst(kPadCap), l_val(kPadCap);
+  std::vector<uint32_t> l_blocks(kPadCap * 32);
+  std::vector<uint8_t> l_real(kPadCap);
+  int64_t got = ag_adms_drain_phases(
+      g, ask, inst.data(), val.data(), hts.data(), rnd.data(),
+      typ.data(), value.data(), sigs.data(), ver.data(), dig.data(),
+      ts.data(), win_h, win_b, /*W=*/1, lut, S, V, pks,
+      /*lane_floor=*/4, /*max_votes=*/kDrainMax, /*phase_offset=*/1,
+      kPadCap, ph_slots.data(), ph_mask.data(), ph_typ.data(),
+      ph_counts.data(), l_pub.data(), l_sig.data(), l_blocks.data(),
+      l_pidx.data(), l_inst.data(), l_val.data(), l_real.data(),
+      rows.data(), meta.data());
+  if (got < 0 || got > ask) {
+    std::fprintf(stderr, "phases drain clamp broken: asked %lld got "
+                 "%lld\n", static_cast<long long>(ask),
+                 static_cast<long long>(got));
+    std::abort();
+  }
+  for (int64_t k = 0; k < got; ++k) {
+    if (inst[k] < 0 || inst[k] >= I) {
+      std::fprintf(stderr, "phantom merged row: inst=%lld at %lld\n",
+                   static_cast<long long>(inst[k]),
+                   static_cast<long long>(k));
+      std::abort();
+    }
+  }
+  if (got > 0 && meta[0] == 1) {
+    // a FILLED phase build: counts cover every drained row and the
+    // lane permutation stays inside the drained range
+    int64_t covered = ph_counts[0] + ph_counts[1];
+    if (covered != got || meta[2] != got) {
+      std::fprintf(stderr, "phase counts %lld+%lld != drained %lld\n",
+                   static_cast<long long>(ph_counts[0]),
+                   static_cast<long long>(ph_counts[1]),
+                   static_cast<long long>(got));
+      std::abort();
+    }
+    for (int64_t j = 0; j < got; ++j) {
+      if (rows[j] < 0 || rows[j] >= got) {
+        std::fprintf(stderr, "lane_rows[%lld]=%lld out of [0,%lld)\n",
+                     static_cast<long long>(j),
+                     static_cast<long long>(rows[j]),
+                     static_cast<long long>(got));
+        std::abort();
+      }
+    }
+  }
+  return got;
+}
+
+}  // namespace
+
+static int run_sharded() {
+  void* g = ag_adms_new(kShards, I, kCapacity, kInstanceCap,
+                        /*drop_oldest=*/1, /*with_digests=*/1);
+  if (!g) { std::fprintf(stderr, "ag_adms_new failed\n"); return 2; }
+
+  // a static window every drained record is eligible under: height 0,
+  // base round 0, W=1, value 5 interned at slot 0 of every instance
+  std::vector<int64_t> win_h(I, 0), win_b(I, 0), lut(I * S, -1);
+  for (int64_t i = 0; i < I; ++i) lut[i * S] = 5;
+  std::vector<uint8_t> pks(V * 32, 0x42);
+
+  std::atomic<int> done{0};
+  std::atomic<int64_t> drained_rows{0};
+
+  auto producer = [&](int id) {
+    std::vector<uint8_t> buf(kPerBatch * kRecSize);
+    std::vector<uint8_t> dig(kPerBatch * 32);
+    int64_t counts[5];
+    std::vector<uint8_t> mark(kPerBatch);
+    for (int b = 0; b < kBatches; ++b) {
+      for (int k = 0; k < kPerBatch - 1; ++k) {
+        // spread across BOTH shards (home = inst / (I / kShards))
+        uint32_t inst = static_cast<uint32_t>((b + k) % I);
+        uint32_t val = static_cast<uint32_t>((id * 17 + k) % V);
+        pack(buf.data() + k * kRecSize, inst, val, 0, 0, 1, 5);
+      }
+      pack(buf.data() + (kPerBatch - 1) * kRecSize, 0xFFFF, 0, 0, 0, 1,
+           5);
+      int64_t seq = ag_adms_submit(g, buf.data(),
+                                   kPerBatch * kRecSize, counts,
+                                   dig.data());
+      if (counts[0] > 0) {
+        ag_adms_set_chunk_ts(g, seq, 1.0 + b);
+        // route consumption racing the merged drain (the wrapper's
+        // ALWAYS-mark contract)
+        std::fill(mark.begin(), mark.begin() + counts[0],
+                  static_cast<uint8_t>(0));
+        ag_adms_mark_verified(g, seq, mark.data(), counts[0]);
+      }
+    }
+    done.fetch_add(1);
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve(kProducers + 1);
+  for (int p = 0; p < kProducers; ++p) threads.emplace_back(producer, p);
+
+  // cold reader: the per-shard observability surface, racing everything
+  threads.emplace_back([&] {
+    int64_t counters[7];
+    std::vector<uint8_t> raw(kCapacity * kRecSize), ver(kCapacity);
+    while (done.load() < kProducers) {
+      ag_adms_counters(g, counters);
+      for (int64_t s = 0; s < kShards; ++s) {
+        (void)ag_adms_shard_depth(g, s);
+        ag_adms_shard_counters(g, s, counters);
+      }
+      (void)ag_adms_oldest_ts(g);
+      for (int64_t i = 0; i < I; ++i)
+        (void)ag_adms_instance_depth(g, i);
+      int64_t cap = std::min(ag_adms_depth(g), kCapacity);
+      if (cap > 0) (void)ag_adms_export(g, raw.data(), ver.data(), cap);
+    }
+  });
+
+  // phase drainer on the main thread: the fused k-way merge + densify
+  while (done.load() < kProducers)
+    drained_rows += drain_phases_once(g, win_h.data(), win_b.data(),
+                                      lut.data(), pks.data());
+  for (auto& t : threads) t.join();
+  for (int64_t got; (got = drain_phases_once(
+           g, win_h.data(), win_b.data(), lut.data(),
+           pks.data())) > 0;)
+    drained_rows += got;
+
+  int64_t c[7];
+  ag_adms_counters(g, c);
+  const int64_t want_submitted =
+      int64_t{kProducers} * kBatches * kPerBatch;
+  const int64_t want_malformed = int64_t{kProducers} * kBatches;
+  int rc = 0;
+  if (c[0] != want_submitted) {
+    std::fprintf(stderr, "sharded submitted=%lld want %lld\n",
+                 static_cast<long long>(c[0]),
+                 static_cast<long long>(want_submitted));
+    rc = 1;
+  }
+  if (c[4] != want_malformed) {
+    std::fprintf(stderr, "sharded malformed=%lld want %lld\n",
+                 static_cast<long long>(c[4]),
+                 static_cast<long long>(want_malformed));
+    rc = 1;
+  }
+  if (c[1] != c[0] - c[2] - c[3] - c[4]) {
+    std::fprintf(stderr, "sharded admission taxonomy unbalanced\n");
+    rc = 1;
+  }
+  if (drained_rows.load() != c[6]) {
+    std::fprintf(stderr, "sharded drained rows %lld != drained "
+                 "counter %lld (phantom/lost records)\n",
+                 static_cast<long long>(drained_rows.load()),
+                 static_cast<long long>(c[6]));
+    rc = 1;
+  }
+  if (c[1] != c[6] + c[5] || ag_adms_depth(g) != 0) {
+    std::fprintf(stderr, "sharded conservation: admitted %lld != "
+                 "drained %lld + evicted %lld (+ depth %lld)\n",
+                 static_cast<long long>(c[1]),
+                 static_cast<long long>(c[6]),
+                 static_cast<long long>(c[5]),
+                 static_cast<long long>(ag_adms_depth(g)));
+    rc = 1;
+  }
+  // per-shard counters must SUM to the group's (the wrapper's
+  // shard_counters gauges report against this)
+  int64_t sum7[7] = {0, 0, 0, 0, 0, 0, 0};
+  for (int64_t s = 0; s < kShards; ++s) {
+    int64_t sc[7];
+    ag_adms_shard_counters(g, s, sc);
+    for (int j = 0; j < 7; ++j) sum7[j] += sc[j];
+  }
+  for (int j = 0; j < 7; ++j) {
+    if (sum7[j] != c[j]) {
+      std::fprintf(stderr, "shard counter %d sums %lld != group "
+                   "%lld\n", j, static_cast<long long>(sum7[j]),
+                   static_cast<long long>(c[j]));
+      rc = 1;
+      break;
+    }
+  }
+  ag_adms_free(g);
+  if (rc == 0)
+    std::printf("tsan_admission_stress sharded ok: submitted=%lld "
+                "drained=%lld evicted=%lld\n",
+                static_cast<long long>(c[0]),
+                static_cast<long long>(c[6]),
+                static_cast<long long>(c[5]));
+  return rc;
+}
+
+int main() {
+  int rc = run_single();
+  if (rc != 0) return rc;
+  return run_sharded();
 }

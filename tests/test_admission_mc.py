@@ -166,13 +166,15 @@ def test_admission_corpus_replays_deterministically(entry):
 
 
 def _real_service(cfg: am.AdmissionMCConfig,
-                  native_admission: bool = False):
+                  native_admission: bool = False,
+                  native_shards: int = 1):
     """A VoteService assembled from the REAL queue/batcher/pipeline
     with step_async stubbed (test_serve_cache.py pattern) and a
     1-round batcher window so the model's held-vote semantics map
     onto the real hold-back path.  `native_admission=True` swaps in
-    the C++ admission front-end (ISSUE 14) — the conformance
-    differential drives both and asserts leaf-for-leaf equality."""
+    the C++ admission front-end (ISSUE 14); `native_shards>1` the
+    sharded group (ISSUE 20) — the conformance differentials drive
+    both and assert leaf-for-leaf equality."""
     from agnes_tpu.bridge import VoteBatcher
     from agnes_tpu.harness.device_driver import DeviceDriver
     from agnes_tpu.harness.fixtures import (
@@ -215,6 +217,7 @@ def _real_service(cfg: am.AdmissionMCConfig,
         overload_policy=cfg.policy, target_votes=cfg.target,
         max_delay_s=0.0,
         native_admission=native_admission,
+        native_shards=native_shards,
         ladder=ShapeLadder.plan(I, V, min_rung=4),
         window_predictor=lambda: (window["base"].copy(),
                                   np.zeros(I, np.int64)))

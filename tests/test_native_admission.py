@@ -17,7 +17,13 @@ serve/queue.AdmissionQueue, differential-tested leaf-for-leaf:
   (runtime instrumented locks prove the submit path never takes it),
   N-producer conservation, drain report parity;
 * the LOCK005 / LINT004 static rules: bite on synthetic fixtures,
-  clean on the repo.
+  clean on the repo;
+* the ISSUE 20 perf layers: zero-copy densify FILL-path conformance
+  (dispatch leaf-identical to native-OFF with `add_arrays` provably
+  never entered on the adopt tick) and the sharded ingest group —
+  shard grid {1, 2, 4} byte-identical to the single queue, N-producer
+  conservation summed across shards, the `oldest_ts` guarded-min NaN
+  fix, construction validation, and the ag_adms_* static-rule teeth.
 
 Zero XLA compiles (dispatch stubbed; conftest._CHEAP).  ci.sh [1/3]
 re-runs this file under the ASan/UBSan build of admission.cpp.
@@ -33,6 +39,7 @@ from agnes_tpu.bridge.native_ingest import REC_SIZE, pack_wire_votes
 from agnes_tpu.serve.cache import VerifiedCache
 from agnes_tpu.serve.native_admission import (
     NativeAdmissionQueue,
+    NativeAdmissionShards,
     bls_screen,
 )
 from agnes_tpu.serve.queue import AdmissionQueue
@@ -613,6 +620,310 @@ def test_threaded_native_elides_admission_lock_and_conserves():
 
 
 # ---------------------------------------------------------------------------
+# zero-copy densify: the FILL path, proven (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def _fill_pair(native_shards=1):
+    """A native-OFF / native-ON serve pair over the smoke config, plus
+    the model that mints its wire records.  The smoke templates put
+    two same-value round-0 votes on instance 0, so one warm round
+    interns the value into the SlotMap LUT and the NEXT round's drain
+    is densify-eligible."""
+    from agnes_tpu.analysis import admission_mc as am
+    from tests.test_admission_mc import _real_service
+
+    cfg = am.ADMISSION_SMOKE[0]
+    sys_model = am.AdmissionSystem(cfg)
+    return (sys_model,
+            _real_service(cfg, native_admission=False),
+            _real_service(cfg, native_admission=True,
+                          native_shards=native_shards))
+
+
+@pytest.mark.parametrize("native_shards", [1, 2])
+def test_densify_fill_leaf_identical_and_skips_add_arrays(
+        native_shards):
+    """The acceptance property: a steady-state serve tick on the
+    phases path performs NO per-record Python work between submit and
+    dispatch — `VoteBatcher.add_arrays` is instrumented and provably
+    never entered on the adopt tick — while the dispatch stream stays
+    leaf-for-leaf identical to native-OFF.  Round 1 bails (the vote
+    value is not in the SlotMap LUT yet — the Python fallback IS the
+    interning path), round 2 fills."""
+    from agnes_tpu.utils.metrics import SERVE_NATIVE_DENSIFY_WALL_S
+
+    sys_model, (svc_off, win_off, disp_off), \
+        (svc_on, win_on, disp_on) = _fill_pair(native_shards)
+    warm = [("s", 0), ("s", 1), ("b",)]
+    for svc, win in ((svc_off, win_off), (svc_on, win_on)):
+        _drive(svc, win, sys_model, warm)
+    assert svc_on.queue.phase_fill == 0
+    assert svc_on.queue.phase_bail == 1
+    assert svc_on.pipeline.native_phase_builds == 0
+    # round 2: the value is interned now — instrument add_arrays
+    # BEFORE driving, so any per-record Python work would be counted
+    calls = {"n": 0}
+    real_add = svc_on.pipeline.batcher.add_arrays
+
+    def counting_add(*a, **k):
+        calls["n"] += 1
+        return real_add(*a, **k)
+
+    svc_on.pipeline.batcher.add_arrays = counting_add
+    for svc, win in ((svc_off, win_off), (svc_on, win_on)):
+        _drive(svc, win, sys_model, warm)
+    assert svc_on.queue.phase_fill == 1, (
+        svc_on.queue.phase_fill, svc_on.queue.phase_bail)
+    assert svc_on.pipeline.native_phase_builds == 1
+    assert calls["n"] == 0, (
+        "add_arrays entered on the native adopt path")
+    # ... and nothing about the stream moved: dispatches, queue
+    # taxonomy, and dispatched-vote counts are native-OFF's, exactly
+    assert disp_on == disp_off
+    assert len(disp_on) > 0
+    assert svc_on.queue.counters == svc_off.queue.counters
+    assert svc_on.pipeline.dispatched_votes == \
+        svc_off.pipeline.dispatched_votes
+    # observability satellite: the densify wall histogram saw the fill
+    h = svc_on.metrics.hists[SERVE_NATIVE_DENSIFY_WALL_S]
+    assert h.snapshot()["count"] >= 1
+    rep = svc_on.drain()
+    assert rep["native_phase_builds"] == 1
+    assert rep["native_admission"]["phase_fill"] == 1
+
+
+def test_densify_metrics_mirrored_at_settle():
+    """The settle-path registry mirrors (ISSUE 20): adopted builds
+    land on the serve_native_phase_builds counter; a sharded service
+    also carries per-shard depth gauges keyed by shard index."""
+    from agnes_tpu.utils.metrics import (
+        SERVE_NATIVE_PHASE_BUILDS,
+        SERVE_NATIVE_SHARD_DEPTH_PREFIX,
+    )
+
+    sys_model, _off, (svc_on, win_on, _disp) = _fill_pair(2)
+    warm = [("s", 0), ("s", 1), ("b",)]
+    _drive(svc_on, win_on, sys_model, warm + warm + [("v",)])
+    assert svc_on.metrics.counters[SERVE_NATIVE_PHASE_BUILDS] == 1
+    for s in range(2):
+        assert (SERVE_NATIVE_SHARD_DEPTH_PREFIX + str(s)
+                in svc_on.metrics.gauges)
+
+
+# ---------------------------------------------------------------------------
+# sharded native ingest: shard grid + conservation + oldest_ts
+# ---------------------------------------------------------------------------
+
+
+def _shard_pair(n_shards, policy="reject_newest", cache=False):
+    """Single native queue vs N-shard group, identical dimensions
+    (capacity 40 keeps every instance below the per-shard ceiling at
+    any grid point, so admission decisions must agree exactly)."""
+    cA = VerifiedCache() if cache else None
+    cB = VerifiedCache() if cache else None
+    qa = NativeAdmissionQueue(I, 40, instance_cap=7, policy=policy,
+                              cache=cA, clock=make_clock())
+    qb = NativeAdmissionShards(I, 40, instance_cap=7, policy=policy,
+                               cache=cB, clock=make_clock(),
+                               n_shards=n_shards)
+    return qa, qb
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4])
+@pytest.mark.parametrize("policy", ["reject_newest", "drop_oldest"])
+def test_shard_grid_byte_identical_to_single_queue(n_shards, policy):
+    """The shard-count grid {1, 2, 4}: per-submit AdmitResults,
+    counters, canonical queue content, and every drained batch
+    (columns + digests + t_first) byte-identical to the single
+    native queue, under hostile traffic and a dedup cache — and the
+    per-shard counter breakdown sums to the group aggregate."""
+    qa, qb = _shard_pair(n_shards, policy=policy, cache=True)
+    rng = np.random.default_rng(100 + n_shards)
+    for k in range(40):
+        w = rand_wire(rng, int(rng.integers(1, 6)),
+                      hostile=(k % 5 == 4))
+        ra, rb = qa.submit(w), qb.submit(w)
+        assert ra == rb, (k, ra, rb)
+        assert qa.depth == qb.depth
+        assert qa.oldest_ts == qb.oldest_ts
+        for i in range(I):
+            assert qa.instance_depth(i) == qb.instance_depth(i)
+        if k % 4 == 3:
+            _assert_batches_equal(qa.drain(5), qb.drain(5))
+    assert qa.mc_canonical() == qb.mc_canonical()
+    _assert_batches_equal(qa.drain(), qb.drain())
+    assert qa.counters == qb.counters
+    assert qa.cache.counters == qb.cache.counters
+    assert qb.depth == 0
+    # the per-shard taxonomy is a partition of the aggregate
+    agg = {k: 0 for k in qb.counters}
+    for s in range(n_shards):
+        for key, v in qb.shard_counters(s).items():
+            agg[key] += v
+    assert agg == qb.counters
+    snap = qb.native_snapshot()
+    assert snap["n_shards"] == n_shards
+    assert len(snap["shards"]) == n_shards
+
+
+def test_shards_construction_validation():
+    """The fail-closed screens: shard count must divide both the
+    instance range (the HostPlan equal-range contract) and the
+    capacity (integer per-shard ceiling)."""
+    with pytest.raises(ValueError, match="not divisible"):
+        NativeAdmissionShards(I, 40, n_shards=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        NativeAdmissionShards(I, 42, n_shards=4)
+    with pytest.raises(ValueError, match="n_shards"):
+        NativeAdmissionShards(I, 40, n_shards=0)
+    # the frozen-digest contract carries over from the single queue
+    q = NativeAdmissionShards(I, 40, n_shards=2)
+    with pytest.raises(ValueError, match="cannot attach"):
+        q.cache = VerifiedCache()
+    qc = NativeAdmissionShards(I, 40, n_shards=2,
+                               cache=VerifiedCache())
+    qc.cache = None          # detach is fine
+    qc.cache = VerifiedCache()   # re-attach on a digest handle too
+
+
+def test_oldest_ts_none_until_stamped():
+    """The ISSUE 20 oldest_ts fix: a record admitted by the lock-free
+    submit but not yet clock-stamped must surface as None (guarded
+    min over STAMPED records), never NaN — MicroBatcher's deadline
+    close arithmetic would propagate NaN into every close decision.
+    Driven at the raw C API (the wrapper stamps immediately, so the
+    transient is only visible between the two calls)."""
+    from agnes_tpu.serve import native_admission as na
+
+    # instances 0 and 2: with n_shards=2 over I=4 (L=2) the chunk
+    # spans BOTH shards, so the group min really is a cross-shard min
+    w = pack_wire_votes(np.array([0, 2]), np.arange(2),
+                        np.zeros(2, np.int64), np.zeros(2, np.int64),
+                        np.zeros(2, np.int64), np.zeros(2, np.int64),
+                        np.zeros((2, 64), np.uint8))
+    L = na._lib()
+    counts = np.zeros(5, np.int64)
+    # single queue
+    q = NativeAdmissionQueue(I, 40, clock=make_clock())
+    seq = L.ag_adm_submit(q._h, w, len(w), counts.ctypes.data, None)
+    assert int(counts[0]) == 2
+    assert q.oldest_ts is None          # admitted, unstamped: no NaN
+    L.ag_adm_set_chunk_ts(q._h, seq, 7.5)
+    assert q.oldest_ts == 7.5
+    # shard group (records of one chunk live on different shards)
+    g = NativeAdmissionShards(I, 40, clock=make_clock(), n_shards=2)
+    counts[:] = 0
+    seq = L.ag_adms_submit(g._h, w, len(w), counts.ctypes.data, None)
+    assert int(counts[0]) == 2
+    assert g.oldest_ts is None
+    L.ag_adms_set_chunk_ts(g._h, seq, 9.25)
+    assert g.oldest_ts == 9.25
+    assert g.shard_depth(0) == 1 and g.shard_depth(1) == 1
+
+
+def test_serve_randomized_identical_sharded_vs_single():
+    """The serve-level shard differential: randomized schedules
+    through native_shards=2 match native_shards=1 (and hence, by the
+    ISSUE 14 differentials, the Python path) dispatch-for-dispatch.
+    Every ≤2 submits are followed by a pump, keeping resident depth
+    below the per-shard ceiling — the regime where the shard group's
+    admission decisions provably agree with the single queue's."""
+    from agnes_tpu.analysis import admission_mc as am
+    from tests.test_admission_mc import _real_service
+
+    cfg = am.ADMISSION_SMOKE[0]
+    sys_model = am.AdmissionSystem(cfg)
+    rng = np.random.default_rng(23)
+    actions = []
+    for _ in range(30):
+        for _ in range(int(rng.integers(1, 3))):
+            actions.append(("s", int(rng.integers(
+                0, len(sys_model._wire)))))
+        actions.append(("b",))
+        if rng.integers(0, 3) == 0:
+            actions.append(("v",))
+        if rng.integers(0, 6) == 0:
+            actions.append(("w",))
+    svc1, win1, disp1 = _real_service(cfg, native_admission=True)
+    svc2, win2, disp2 = _real_service(cfg, native_admission=True,
+                                      native_shards=2)
+    _drive(svc1, win1, sys_model, actions)
+    _drive(svc2, win2, sys_model, actions)
+    assert disp2 == disp1
+    assert svc2.queue.counters == svc1.queue.counters
+    rep1, rep2 = svc1.drain(), svc2.drain()
+    assert rep2["dispatched_votes"] == rep1["dispatched_votes"]
+    assert rep2["native_phase_builds"] == rep1["native_phase_builds"]
+    assert rep2["native_admission"]["n_shards"] == 2
+
+
+def test_threaded_sharded_conservation_and_elision():
+    """N producer threads through the threaded host over the SHARD
+    group: loss-free conservation summed across shards (admitted ==
+    drained + evicted + depth, per shard and in aggregate) and the
+    admission-lock elision the single native queue earned — the shard
+    group's `native = True` marker keeps the submit path lock-free."""
+    from agnes_tpu.analysis import admission_mc as am
+    from agnes_tpu.analysis.lockcheck import instrument
+    from agnes_tpu.serve.threaded import ThreadedVoteService
+    from tests.test_admission_mc import _real_service
+
+    cfg = am.ADMISSION_SMOKE[0]
+    sys_model = am.AdmissionSystem(cfg)
+    svc, _window, _disp = _real_service(cfg, native_admission=True,
+                                        native_shards=2)
+    tsvc = ThreadedVoteService(svc, inbox_capacity=4096,
+                               idle_wait_s=1e-4)
+    state = instrument(tsvc)
+    acquired = {"n": 0}
+
+    class _Counting:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def __enter__(self):
+            acquired["n"] += 1
+            return self.inner.__enter__()
+
+        def __exit__(self, *exc):
+            return self.inner.__exit__(*exc)
+
+    tsvc._admission = _Counting(tsvc._admission)
+    tsvc.start()
+    wires = list(sys_model._wire)
+    n_threads, per_thread = 4, 12
+
+    def producer(seed):
+        for k in range(per_thread):
+            tsvc.submit(wires[(seed + k) % len(wires)])
+
+    threads = [threading.Thread(target=producer, args=(s,))
+               for s in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    adm_before_drain = acquired["n"]
+    rep = tsvc.drain()
+    assert not state.violations, state.violations
+    assert rep["thread_failure"] is None
+    assert rep["inbox"]["dropped"] == 0
+    assert adm_before_drain == 0, adm_before_drain
+    na = rep["queue"]
+    assert na["admitted"] == na["drained"] + na["evicted"]
+    snap = rep["native_admission"]
+    assert snap["n_shards"] == 2
+    # conservation PER SHARD, and the shard partition sums to the
+    # aggregate — records neither lost nor duplicated in the fan-in
+    for c in snap["shards"]:
+        assert c["admitted"] == c["drained"] + c["evicted"] \
+            + c["depth"]
+    for key in ("submitted", "admitted", "drained", "evicted"):
+        assert sum(c[key] for c in snap["shards"]) == na[key]
+
+
+# ---------------------------------------------------------------------------
 # static rules: LOCK005 / LINT004
 # ---------------------------------------------------------------------------
 
@@ -650,6 +961,56 @@ def test_lint004_flags_raw_capi_outside_wrappers(tmp_path):
     findings = lint.check_capi_wrappers(str(tmp_path))
     assert [f.code for f in findings] == ["LINT004"], findings
     assert "rogue.py:2" in findings[0].where
+
+
+def test_lock005_and_lint004_cover_shard_group_calls(tmp_path):
+    """The ag_adms_* shard-group C API is covered by the same teeth
+    as ag_adm_*: a group call under the admission lock is LOCK005
+    (the group synchronizes internally — holding the Python lock
+    across it is the elision-defeating nesting), and a raw group call
+    outside the audited wrappers is LINT004."""
+    from agnes_tpu.analysis import lint, lockcheck
+
+    bad = (
+        "class H:\n"
+        "    def f(self):\n"
+        "        with self._admission:\n"
+        "            self.L.ag_adms_submit(0)\n")
+    codes = [f.code for f in lockcheck.check_source(bad)]
+    assert codes == ["LOCK005"], codes
+    pkg = tmp_path / "agnes_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "rogue.py").write_text(
+        "def f(L):\n"
+        "    L.ag_adms_drain_phases(None)\n")
+    findings = lint.check_capi_wrappers(str(tmp_path))
+    assert [f.code for f in findings] == ["LINT004"], findings
+
+
+def test_native_lock_order_registry_matches_source():
+    """The NATIVE_LOCK_ORDER doc registry (lockcheck) doesn't drift
+    from the C++ it documents: every named mutex member exists in the
+    native admission sources, and both are leaf-ranked — the basis
+    for LOCK005's demand that Python hold NOTHING across ag_adms_*
+    calls."""
+    import os
+
+    from agnes_tpu.analysis import lockcheck
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    srcs = ""
+    for rel in ("agnes_tpu/core/native/admission.hpp",
+                "agnes_tpu/core/native/admission_shards.cpp"):
+        with open(os.path.join(repo, rel)) as fh:
+            srcs += fh.read()
+    assert len(lockcheck.NATIVE_LOCK_ORDER) == 2
+    for name, rank, note in lockcheck.NATIVE_LOCK_ORDER:
+        member = name.split("::")[1]
+        assert member in srcs, name
+        assert rank == 2, (name, rank)      # leaf, like cache._mu
+        assert note
+    names = {n for n, _, _ in lockcheck.NATIVE_LOCK_ORDER}
+    assert names == {"AdmQ::mu", "AdmShards::route_mu"}
 
 
 def test_lock_and_capi_rules_clean_on_repo():

@@ -5,9 +5,10 @@ The explorer's value rests on four properties that are easy to break
 silently while refactoring the serve host: (1) replay determinism —
 the same forced schedule must reproduce the identical execution, or
 minimized repros are fiction; (2) pruning soundness — sleep sets must
-not hide terminal states the full tree reaches; (3) bite — the three
-shipped races, resurrected as mutants, must still be caught, their
-schedules ddmin-minimized, and the minimized schedules must replay
+not hide terminal states the full tree reaches; (3) bite — the
+shipped (or review-caught) races, resurrected as mutants, must still
+be caught, their schedules ddmin-minimized, and the minimized
+schedules must replay
 CLEAN on the honest build (a checker that flags honest code is worse
 than none); (4) jax-freedom — the ci.sh [1e] gate slot budget assumes
 zero XLA compiles.  The TSan harness's plain build rides along as a
@@ -74,7 +75,7 @@ def test_sleep_set_pruning_preserves_terminal_states():
     assert not full.violations and not pruned.violations
 
 
-# -- (3) bite: the three shipped races, resurrected ---------------------------
+# -- (3) bite: the shipped (or review-caught) races, resurrected --------------
 
 def test_self_test_catches_minimizes_and_exonerates():
     """Every mutant caught, its schedule ddmin-minimized, and the
@@ -84,6 +85,7 @@ def test_self_test_catches_minimizes_and_exonerates():
     for name, kinds in (("inbox_close_toctou",
                          ("conservation", "atomicity")),
                         ("native_drain_shrink", ("conservation",)),
+                        ("shard_route_lost", ("conservation",)),
                         ("busy_frac_inflight", ("busy_frac",))):
         rec = rep[name]
         assert rec["caught"], (name, rec)
@@ -145,6 +147,10 @@ def test_tsan_admission_harness_plain_build(tmp_path):
         ["g++", "-O1", "-std=c++17", "-pthread", "-o", str(binary),
          os.path.join(REPO, "tests/native/tsan_admission_stress.cpp"),
          os.path.join(REPO, "agnes_tpu/core/native/admission.cpp"),
+         os.path.join(REPO,
+                      "agnes_tpu/core/native/admission_phases.cpp"),
+         os.path.join(REPO,
+                      "agnes_tpu/core/native/admission_shards.cpp"),
          os.path.join(REPO, "agnes_tpu/core/native/sha512.cpp")],
         capture_output=True, text=True, timeout=300)
     assert build.returncode == 0, build.stderr
